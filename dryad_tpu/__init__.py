@@ -1,0 +1,11 @@
+"""dryad_tpu — a TPU-native data-parallel dataflow framework.
+
+A brand-new implementation of the capabilities of Microsoft Research's
+Dryad + DryadLINQ (declarative partitioned queries -> optimized DAG ->
+fault-tolerant distributed execution), designed for TPUs: query stages trace
+to jax.jit/shard_map programs over a device mesh; hash/range/group shuffles
+are XLA collectives over ICI; a host-side DAG scheduler provides replay-based
+fault tolerance.  See SURVEY.md for the reference analysis.
+"""
+
+__version__ = "0.1.0"

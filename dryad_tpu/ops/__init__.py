@@ -1,0 +1,1 @@
+from dryad_tpu.ops import hashing, kernels, text  # noqa: F401

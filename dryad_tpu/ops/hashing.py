@@ -1,0 +1,117 @@
+"""Vectorized 64-bit hashing of record keys (as two uint32 lanes).
+
+Role of reference LinqToDryad/Hash64.cs (the hash behind HashPartition,
+DryadLinqQueryable.cs:275) — but vectorized over a whole Batch so the TPU
+computes every row's hash in one fused XLA op.  TPUs have no fast uint64, so
+a 64-bit hash is carried as an ``(hi, lo)`` pair of uint32 arrays; arithmetic
+wraps mod 2**32, which is exactly what uint32 ops give us.
+
+Strings hash via a masked weighted byte dot-product (MXU-friendly); ints via
+splitmix-style avalanche mixing.  All constants are fixed, so hashes are
+deterministic across runs — required for replay-based fault tolerance
+(SURVEY.md §7 "Determinism for replay").
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.data.columnar import Batch, StringColumn
+
+__all__ = ["hash_column", "hash_columns", "hash_batch_keys"]
+
+_U32 = jnp.uint32
+
+# Deterministic odd weights for byte dot-product hashing (fixed seed).
+_rng = np.random.RandomState(0xD47AD)
+_MAX_HASH_LEN = 512
+_BYTE_W1 = jnp.asarray(_rng.randint(0, 2**31, _MAX_HASH_LEN).astype(np.uint32) * 2 + 1)
+_BYTE_W2 = jnp.asarray(_rng.randint(0, 2**31, _MAX_HASH_LEN).astype(np.uint32) * 2 + 1)
+
+
+def _mix32(x: jax.Array, c1: int, c2: int) -> jax.Array:
+    """xorshift-multiply avalanche (murmur3 finalizer shape)."""
+    x = x.astype(_U32)
+    x = x ^ (x >> 16)
+    x = x * _U32(c1)
+    x = x ^ (x >> 13)
+    x = x * _U32(c2)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _combine(h: Tuple[jax.Array, jax.Array],
+             g: Tuple[jax.Array, jax.Array]) -> Tuple[jax.Array, jax.Array]:
+    """Combine two 64-bit lane-pair hashes (boost::hash_combine style)."""
+    hi = _mix32(h[0] ^ (g[0] + _U32(0x9E3779B9) + (h[0] << 6) + (h[0] >> 2)),
+                0x85EBCA6B, 0xC2B2AE35)
+    lo = _mix32(h[1] ^ (g[1] + _U32(0x9E3779B9) + (h[1] << 6) + (h[1] >> 2)),
+                0xCC9E2D51, 0x1B873593)
+    return hi, lo
+
+
+def _hash_dense(col: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Hash a dense [n] or [n, k] numeric column to (hi, lo) uint32 pairs."""
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        # Canonicalize -0.0 == 0.0, then hash the bit pattern.
+        col = jnp.where(col == 0, jnp.zeros_like(col), col)
+        col = col.astype(jnp.float32)
+        bits = jax.lax.bitcast_convert_type(col, jnp.uint32)
+    elif col.dtype == jnp.bool_:
+        bits = col.astype(_U32)
+    elif col.dtype.itemsize > 4:
+        # 64-bit ints: hash both 32-bit halves so values differing only in
+        # the high word don't collide.
+        lo32 = col.astype(_U32)
+        hi32 = (col >> 32).astype(_U32)
+        bits = jnp.stack([hi32, lo32], axis=-1) if col.ndim == 1 else \
+            jnp.concatenate([hi32, lo32], axis=-1)
+    else:
+        bits = col.astype(_U32)
+    if bits.ndim == 1:
+        bits = bits[:, None]
+    hi = jnp.zeros(bits.shape[0], _U32)
+    lo = jnp.zeros(bits.shape[0], _U32)
+    for j in range(bits.shape[1]):
+        hi, lo = _combine((hi, lo), (_mix32(bits[:, j], 0x85EBCA6B, 0xC2B2AE35),
+                                     _mix32(bits[:, j], 0xCC9E2D51, 0x1B873593)))
+    return hi, lo
+
+
+def _hash_string(col: StringColumn) -> Tuple[jax.Array, jax.Array]:
+    """Masked weighted byte sum — one [n, L] x [L] product per lane."""
+    L = col.max_len
+    if L > _MAX_HASH_LEN:
+        raise ValueError(f"string max_len {L} > hashable {_MAX_HASH_LEN}")
+    mask = (jnp.arange(L, dtype=jnp.int32)[None, :] < col.lengths[:, None])
+    b = jnp.where(mask, col.data, 0).astype(_U32)
+    # (b+1) so that a 0x00 byte differs from padding; wrapping uint32 dot.
+    hi = ((b + mask.astype(_U32)) * _BYTE_W1[:L][None, :]).sum(axis=1, dtype=_U32)
+    lo = ((b + mask.astype(_U32)) * _BYTE_W2[:L][None, :]).sum(axis=1, dtype=_U32)
+    lenmix = (_mix32(col.lengths, 0x85EBCA6B, 0xC2B2AE35),
+              _mix32(col.lengths, 0xCC9E2D51, 0x1B873593))
+    return _combine((_mix32(hi, 0xCC9E2D51, 0x85EBCA6B),
+                     _mix32(lo, 0x1B873593, 0xC2B2AE35)), lenmix)
+
+
+def hash_column(col) -> Tuple[jax.Array, jax.Array]:
+    if isinstance(col, StringColumn):
+        return _hash_string(col)
+    return _hash_dense(col)
+
+
+def hash_columns(cols: Sequence) -> Tuple[jax.Array, jax.Array]:
+    """Combined hash of several columns (row-wise)."""
+    assert cols
+    h = hash_column(cols[0])
+    for c in cols[1:]:
+        h = _combine(h, hash_column(c))
+    return h
+
+
+def hash_batch_keys(batch: Batch, key_names: Sequence[str]) -> Tuple[jax.Array, jax.Array]:
+    return hash_columns([batch.columns[k] for k in key_names])

@@ -1,0 +1,95 @@
+"""Vectorized text ops: tokenization (the WordCount SelectMany kernel).
+
+The reference's WordCount does ``SelectMany(line => line.Split(' '))``
+(reference samples/WordCount.cs.pp) with per-record C# string ops.  On TPU we
+tokenize a whole batch of lines in one fused program: flatten all line bytes
+into one stream (row boundaries act as delimiters), mark token starts with
+elementwise compares, place tokens with a prefix-sum + scatter, and slice
+token bytes with a windowed gather.  No per-row loop, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.data.columnar import Batch, StringColumn
+
+__all__ = ["split_tokens", "lower_ascii"]
+
+
+def lower_ascii(col: StringColumn) -> StringColumn:
+    d = col.data
+    is_upper = (d >= ord("A")) & (d <= ord("Z"))
+    return StringColumn(jnp.where(is_upper, d + 32, d), col.lengths)
+
+
+def _is_delim(b: jax.Array, delims: bytes) -> jax.Array:
+    m = jnp.zeros(b.shape, jnp.bool_)
+    for ch in delims:
+        m = m | (b == ch)
+    return m
+
+
+def split_tokens(batch: Batch, column: str, out_capacity: int,
+                 max_token_len: int = 24,
+                 delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>") -> Batch:
+    """Split a string column into a batch of tokens (one row per token).
+
+    Output batch has a single string column named ``column``; tokens longer
+    than ``max_token_len`` are truncated; tokens beyond ``out_capacity`` are
+    dropped (callers size capacity; executor can check `token_overflow`).
+    """
+    col: StringColumn = batch.columns[column]
+    cap, L = col.capacity, col.max_len
+    valid_row = batch.valid_mask()
+
+    # flatten to one byte stream; bytes past each row's length and rows past
+    # count are forced to delimiter (0x20) so they never join tokens
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_row = (pos < col.lengths[:, None]) & valid_row[:, None]
+    flat = jnp.where(in_row, col.data, ord(" ")).reshape(-1)  # [cap*L]
+    N = cap * L
+
+    nondelim = ~_is_delim(flat, delims)
+    prev_nondelim = jnp.concatenate([jnp.zeros((1,), jnp.bool_), nondelim[:-1]])
+    # row starts break tokens even without explicit delimiters because each
+    # row's tail is padded with spaces; first byte of stream handled by prev=0
+    is_start = nondelim & ~prev_nondelim
+
+    # token id per start; scatter start positions into the output table
+    tid = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    num_tokens = is_start.sum(dtype=jnp.int32)
+    start_pos = jnp.full((out_capacity,), 0, jnp.int32)
+    scatter_idx = jnp.where(is_start & (tid < out_capacity), tid,
+                            out_capacity)  # OOB -> dropped
+    start_pos = start_pos.at[scatter_idx].set(
+        jnp.arange(N, dtype=jnp.int32), mode="drop")
+
+    # token length: run-length of nondelim starting at each position, via a
+    # reverse associative scan
+    def combine(a, b):
+        # run[i] = 0 if delim else run[i+1]+1: segmented suffix sum.  In a
+        # reverse associative_scan the first argument is the element further
+        # to the RIGHT, so the run of the combined span counts from b's left
+        # edge and extends into a only if b's span is all-nondelim.
+        am, ar = a
+        bm, br = b
+        return am & bm, jnp.where(bm, br + ar, br)
+
+    runs = jax.lax.associative_scan(
+        combine, (nondelim, nondelim.astype(jnp.int32)), reverse=True)[1]
+    tok_len_all = jnp.minimum(runs, max_token_len)
+
+    tok_valid = jnp.arange(out_capacity, dtype=jnp.int32) < jnp.minimum(
+        num_tokens, out_capacity)
+    tok_len = jnp.where(tok_valid, jnp.take(tok_len_all, start_pos), 0)
+
+    # windowed gather of token bytes
+    w = jnp.arange(max_token_len, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(start_pos[:, None] + w, 0, N - 1)
+    tok_bytes = jnp.where(w < tok_len[:, None], jnp.take(flat, idx), 0)
+
+    out = Batch({column: StringColumn(tok_bytes, tok_len)},
+                jnp.minimum(num_tokens, out_capacity))
+    return out

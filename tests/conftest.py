@@ -1,0 +1,28 @@
+"""Test fixture: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference runs its
+*real* runtime as local processes (`DryadLinqContext(nProcesses)`,
+reference LinqToDryad/LocalJobSubmission.cs:97-302) so distributed control
+paths are exercised on one box.  Our equivalent: the real executor +
+collectives run over 8 virtual CPU devices via
+``--xla_force_host_platform_device_count``.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,204 @@
+"""Kernel-level tests against numpy oracles (the reference's LocalDebug-
+oracle test pattern, SURVEY.md §4, applied at unit granularity)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.data import Batch, batch_from_numpy, batch_to_numpy
+from dryad_tpu.ops import kernels
+from dryad_tpu.ops.hashing import hash_batch_keys
+from dryad_tpu.ops.text import split_tokens, lower_ascii
+
+
+def make_batch(n=100, cap=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return batch_from_numpy({
+        "k": rng.randint(0, 10, n),
+        "v": rng.randn(n).astype(np.float32),
+        "s": ["item%d" % x for x in rng.randint(0, 7, n)],
+    }, capacity=cap)
+
+
+def test_roundtrip():
+    b = make_batch()
+    out = batch_to_numpy(b)
+    assert len(out["k"]) == 100
+    assert out["s"][0].startswith(b"item")
+
+
+def test_compact():
+    b = make_batch()
+    keep = jnp.asarray(np.asarray(b["k"]) % 2 == 0)
+    out = kernels.compact(b, keep)
+    ref_k = np.asarray(b["k"])[:100]
+    ref_k = ref_k[ref_k % 2 == 0]
+    got = batch_to_numpy(out)
+    np.testing.assert_array_equal(got["k"], ref_k)
+
+
+def test_hash_deterministic_and_spread():
+    b = make_batch()
+    h1 = hash_batch_keys(b, ["s"])
+    h2 = hash_batch_keys(b, ["s"])
+    np.testing.assert_array_equal(np.asarray(h1[0]), np.asarray(h2[0]))
+    # equal strings hash equal; there are only 7 distinct values
+    strs = batch_to_numpy(b)["s"]
+    lo = np.asarray(h1[1])[:100]
+    mapping = {}
+    for s, h in zip(strs, lo):
+        assert mapping.setdefault(s, h) == h
+    assert len(set(mapping.values())) == len(mapping)
+
+
+def test_sort_numeric_and_string():
+    b = make_batch()
+    out = kernels.sort_by_columns(b, [("v", False)])
+    got = batch_to_numpy(out)["v"]
+    np.testing.assert_allclose(got, np.sort(batch_to_numpy(b)["v"]), rtol=1e-6)
+
+    out2 = kernels.sort_by_columns(b, [("s", False), ("v", True)])
+    got2 = batch_to_numpy(out2)
+    ref = sorted(zip(batch_to_numpy(b)["s"], batch_to_numpy(b)["v"]),
+                 key=lambda t: (t[0], -t[1]))
+    assert [r[0] for r in ref] == got2["s"]
+    np.testing.assert_allclose([r[1] for r in ref], got2["v"], rtol=1e-6)
+
+
+def test_group_aggregate():
+    b = make_batch()
+    out = kernels.group_aggregate(
+        b, ["k"], {"n": ("count", None), "sv": ("sum", "v"),
+                   "mn": ("min", "v"), "mx": ("max", "v"),
+                   "avg": ("mean", "v")})
+    got = batch_to_numpy(out)
+    raw = batch_to_numpy(b)
+    import collections
+    groups = collections.defaultdict(list)
+    for k, v in zip(raw["k"], raw["v"]):
+        groups[int(k)].append(v)
+    assert int(out.count) == len(groups)
+    for i, k in enumerate(got["k"]):
+        vals = groups[int(k)]
+        assert got["n"][i] == len(vals)
+        np.testing.assert_allclose(got["sv"][i], np.sum(vals), rtol=1e-5)
+        np.testing.assert_allclose(got["mn"][i], np.min(vals), rtol=1e-6)
+        np.testing.assert_allclose(got["mx"][i], np.max(vals), rtol=1e-6)
+        np.testing.assert_allclose(got["avg"][i], np.mean(vals), rtol=1e-5)
+
+
+def test_group_by_string_key():
+    b = make_batch()
+    out = kernels.group_aggregate(b, ["s"], {"n": ("count", None)})
+    got = batch_to_numpy(out)
+    raw = batch_to_numpy(b)
+    import collections
+    c = collections.Counter(raw["s"])
+    assert int(out.count) == len(c)
+    for s, n in zip(got["s"], got["n"]):
+        assert c[s] == n
+
+
+def test_distinct():
+    b = make_batch()
+    out = kernels.distinct(b, ["k"])
+    got = batch_to_numpy(out)
+    assert sorted(set(got["k"])) == sorted(set(batch_to_numpy(b)["k"]))
+    assert int(out.count) == len(set(batch_to_numpy(b)["k"]))
+
+
+def test_scalar_aggregate():
+    b = make_batch()
+    out = kernels.scalar_aggregate(
+        b, {"n": ("count", None), "s": ("sum", "v"), "m": ("mean", "v"),
+            "lo": ("min", "v"), "hi": ("max", "v")})
+    raw = batch_to_numpy(b)["v"]
+    assert int(out["n"]) == 100
+    np.testing.assert_allclose(float(out["s"]), raw.sum(), rtol=1e-5)
+    np.testing.assert_allclose(float(out["m"]), raw.mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(out["lo"]), raw.min(), rtol=1e-6)
+    np.testing.assert_allclose(float(out["hi"]), raw.max(), rtol=1e-6)
+
+
+def test_hash_join():
+    rng = np.random.RandomState(1)
+    left = batch_from_numpy({"k": rng.randint(0, 8, 50),
+                             "a": np.arange(50)}, capacity=64)
+    right = batch_from_numpy({"k": rng.randint(0, 8, 30),
+                              "b": np.arange(30) * 10}, capacity=32)
+    out, overflow = kernels.hash_join(left, right, ["k"], ["k"], 512)
+    assert not bool(overflow)
+    got = batch_to_numpy(out)
+    lraw, rraw = batch_to_numpy(left), batch_to_numpy(right)
+    expected = set()
+    for i in range(50):
+        for j in range(30):
+            if lraw["k"][i] == rraw["k"][j]:
+                expected.add((int(lraw["a"][i]), int(rraw["b"][j])))
+    got_pairs = set(zip(got["a"].tolist(), got["b"].tolist()))
+    assert got_pairs == expected
+    assert int(out.count) == len(expected)  # a and b values are unique
+
+
+def test_join_string_keys():
+    left = batch_from_numpy({"w": ["a", "b", "c", "a"],
+                             "x": [1, 2, 3, 4]}, capacity=8)
+    right = batch_from_numpy({"w": ["a", "c", "d"],
+                              "y": [10, 20, 30]}, capacity=4)
+    out, overflow = kernels.hash_join(left, right, ["w"], ["w"], 32)
+    got = batch_to_numpy(out)
+    pairs = sorted(zip([s.decode() for s in got["w"]],
+                       got["x"].tolist(), got["y"].tolist()))
+    assert pairs == [("a", 1, 10), ("a", 4, 10), ("c", 3, 20)]
+
+
+def test_concat2():
+    a = batch_from_numpy({"x": [1, 2, 3], "s": ["p", "q", "r"]}, capacity=8)
+    b = batch_from_numpy({"x": [4, 5], "s": ["tt", "u"]}, capacity=4)
+    out = kernels.concat2(a, b)
+    got = batch_to_numpy(out)
+    assert got["x"].tolist() == [1, 2, 3, 4, 5]
+    assert got["s"] == [b"p", b"q", b"r", b"tt", b"u"]
+
+
+def test_split_tokens():
+    b = batch_from_numpy(
+        {"line": ["the quick brown fox", "", "the lazy dog  the"]},
+        capacity=4, str_max_len=32)
+    out = split_tokens(b, "line", out_capacity=16)
+    got = batch_to_numpy(out)
+    assert got["line"] == [b"the", b"quick", b"brown", b"fox",
+                           b"the", b"lazy", b"dog", b"the"]
+
+
+def test_wordcount_composition():
+    lines = ["the quick brown fox jumps over the lazy dog",
+             "The dog barks", "a fox and a dog"]
+    b = batch_from_numpy({"line": lines}, capacity=4, str_max_len=64)
+    toks = split_tokens(b, "line", out_capacity=64)
+    toks = Batch({"line": lower_ascii(toks.columns["line"])}, toks.count)
+    counts = kernels.group_aggregate(toks, ["line"], {"n": ("count", None)})
+    got = batch_to_numpy(counts)
+    import collections
+    ref = collections.Counter(
+        w.lower() for l in lines for w in l.split())
+    assert {k.decode(): int(v) for k, v in zip(got["line"], got["n"])} == dict(ref)
+
+
+def test_jit_composition():
+    """A fused pipeline of kernels compiles to one XLA program."""
+    b = make_batch()
+
+    @jax.jit
+    def stage(b):
+        f = kernels.compact(b, b["v"] > 0)
+        return kernels.group_aggregate(f, ["k"], {"n": ("count", None)})
+
+    out = stage(b)
+    raw = batch_to_numpy(b)
+    import collections
+    ref = collections.Counter(int(k) for k, v in zip(raw["k"], raw["v"]) if v > 0)
+    got = batch_to_numpy(out)
+    assert {int(k): int(n) for k, n in zip(got["k"], got["n"])} == dict(ref)
