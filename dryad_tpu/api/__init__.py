@@ -1,0 +1,1 @@
+from dryad_tpu.api.dataset import Context, Dataset  # noqa: F401

@@ -1,0 +1,235 @@
+"""User-facing lazy Dataset API + execution context.
+
+The counterpart of the reference's `DryadLinqContext` (DryadLinqContext.cs:566)
+and the `IQueryable` operator surface (DryadLinqQueryable.cs — Select/Where/
+GroupBy/Join/OrderBy/Distinct/Union/.../HashPartition/RangePartition/Apply/
+DoWhile/Take/Submit).  A Dataset wraps a logical expr node; terminal calls
+(`collect`, `count`, ...) plan + execute.
+
+`Context(local_debug=True)` is the reference's LocalDebug: terminal calls
+route through the sequential oracle instead of the mesh executor — the same
+semantics contract the reference tests rely on (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dryad_tpu import oracle as _oracle
+from dryad_tpu.exec.data import PData, pdata_from_host, pdata_to_host
+from dryad_tpu.exec.executor import Executor
+from dryad_tpu.parallel.mesh import make_mesh
+from dryad_tpu.plan import expr as E
+from dryad_tpu.plan.planner import plan_query
+
+__all__ = ["Context", "Dataset"]
+
+
+class Context:
+    """Owns the mesh + executor and creates root Datasets."""
+
+    def __init__(self, mesh=None, local_debug: bool = False,
+                 event_log: Optional[Callable[[dict], None]] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.nparts = self.mesh.devices.size
+        self.local_debug = local_debug
+        self.executor = Executor(self.mesh, event_log=event_log)
+
+    # -- dataset constructors ---------------------------------------------
+
+    def from_columns(self, columns: Mapping[str, Any],
+                     capacity: int | None = None,
+                     str_max_len: int = 64) -> "Dataset":
+        """Create a partitioned dataset from host columns (FromEnumerable,
+        DryadLinqContext.cs:1210)."""
+        pdata = pdata_from_host(columns, self.mesh, nparts=self.nparts,
+                                capacity=capacity, str_max_len=str_max_len)
+        node = E.Source(parents=(), data=pdata, _npartitions=self.nparts,
+                        host=dict(columns))
+        return Dataset(self, node)
+
+    def from_pdata(self, pdata: PData,
+                   host: Optional[Mapping[str, Any]] = None,
+                   partitioning: E.Partitioning = E.Partitioning.none()
+                   ) -> "Dataset":
+        node = E.Source(parents=(), data=pdata, _npartitions=self.nparts,
+                        _partitioning=partitioning, host=host)
+        return Dataset(self, node)
+
+    def read_text(self, path: str, column: str = "line",
+                  max_line_len: int = 256) -> "Dataset":
+        """Read a text file as one record per line (FromStore for LineRecord,
+        DryadLinqContext.cs:1176 + LineRecord.cs)."""
+        with open(path, "rb") as f:
+            lines = f.read().splitlines()
+        return self.from_columns({column: lines}, str_max_len=max_line_len)
+
+    # -- iteration ---------------------------------------------------------
+
+    def do_while(self, init: "Dataset",
+                 body: Callable[["Dataset"], "Dataset"],
+                 n_iters: int,
+                 cond: Optional[Callable[[Dict[str, Any]], bool]] = None
+                 ) -> "Dataset":
+        """Iterative DAG execution (reference DoWhile,
+        DryadLinqQueryable.cs:1281, VisitDoWhile DryadLinqQueryGen.cs:3353).
+
+        The loop body is planned ONCE over a placeholder; each iteration
+        binds the previous iteration's materialized output, so XLA programs
+        are compiled once and reused (shapes are stable).  ``cond`` (host
+        predicate on the collected current table) can stop early.
+        """
+        if self.local_debug:
+            cur_host = _oracle.run_oracle(init.node)
+            ph = E.Placeholder(parents=(), name="__loop",
+                               _npartitions=self.nparts)
+            body_node = body(Dataset(self, ph)).node
+            for _ in range(n_iters):
+                cur_host = _oracle.run_oracle(
+                    body_node, bindings={"__loop": cur_host})
+                if cond is not None and not cond(cur_host):
+                    break
+            node = E.Source(parents=(), data=None,
+                            _npartitions=self.nparts, host=cur_host)
+            return Dataset(self, node)
+        cur = init._materialize()
+        ph = E.Placeholder(parents=(), name="__loop", _npartitions=self.nparts,
+                           capacity=cur.capacity)
+        body_ds = body(Dataset(self, ph))
+        graph = plan_query(body_ds.node, self.nparts)
+        for _ in range(n_iters):
+            nxt = self.executor.run(graph, bindings={"__loop": cur})
+            if nxt.capacity != cur.capacity:
+                raise ValueError(
+                    "do_while body must preserve per-partition capacity "
+                    f"({cur.capacity} -> {nxt.capacity}); use explicit "
+                    "capacities on flat_map/join ops inside the loop")
+            cur = nxt
+            if cond is not None and not cond(pdata_to_host(cur)):
+                break
+        return self.from_pdata(cur, host=None)
+
+
+class Dataset:
+    """A lazy, partitioned, columnar dataset (the IQueryable)."""
+
+    def __init__(self, ctx: Context, node: E.Node):
+        self.ctx = ctx
+        self.node = node
+
+    # -- row-local operators ----------------------------------------------
+
+    def select(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+               label: str = "select") -> "Dataset":
+        """Columnwise projection: fn(cols) -> new cols (replaces columns)."""
+        return Dataset(self.ctx, E.Map(parents=(self.node,), fn=fn,
+                                       label=label))
+
+    def where(self, fn: Callable[[Dict[str, Any]], Any],
+              label: str = "where") -> "Dataset":
+        return Dataset(self.ctx, E.Filter(parents=(self.node,), fn=fn,
+                                          label=label))
+
+    def split_words(self, column: str, out_capacity: int,
+                    max_token_len: int = 24,
+                    delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>",
+                    lower: bool = False) -> "Dataset":
+        """Tokenizing SelectMany (the WordCount flat-map)."""
+        return Dataset(self.ctx, E.FlatTokens(
+            parents=(self.node,), column=column, out_capacity=out_capacity,
+            max_token_len=max_token_len, delims=delims, lower=lower))
+
+    def apply_per_partition(self, fn, label: str = "apply",
+                            preserves_partitioning: bool = False) -> "Dataset":
+        """Arbitrary Batch -> Batch function per partition
+        (ApplyPerPartition, DryadLinqQueryable.cs:1084).  Not supported in
+        local_debug (opaque to the oracle)."""
+        return Dataset(self.ctx, E.ApplyPerPartition(
+            parents=(self.node,), fn=fn, label=label,
+            preserves_partitioning=preserves_partitioning))
+
+    def take(self, n: int) -> "Dataset":
+        return Dataset(self.ctx, E.Take(parents=(self.node,), n=n))
+
+    # -- shuffling operators ----------------------------------------------
+
+    def group_by(self, keys: Sequence[str],
+                 aggs: Dict[str, Tuple[str, Optional[str]]]) -> "Dataset":
+        """GroupBy + decomposable aggregates: aggs maps output column ->
+        (kind, value_column), kind in sum/count/min/max/mean/any/all."""
+        return Dataset(self.ctx, E.GroupByAgg(
+            parents=(self.node,), keys=tuple(keys), aggs=dict(aggs)))
+
+    def join(self, other: "Dataset", left_keys: Sequence[str],
+             right_keys: Sequence[str] | None = None, expansion: float = 1.0,
+             broadcast: bool = False) -> "Dataset":
+        return Dataset(self.ctx, E.Join(
+            parents=(self.node, other.node), left_keys=tuple(left_keys),
+            right_keys=tuple(right_keys or left_keys), expansion=expansion,
+            broadcast_right=broadcast))
+
+    def order_by(self, keys: Sequence[Tuple[str, bool]]) -> "Dataset":
+        """Global sort; keys = [(column, descending), ...]."""
+        return Dataset(self.ctx, E.OrderBy(parents=(self.node,),
+                                           keys=tuple(keys)))
+
+    def distinct(self, keys: Sequence[str] = ()) -> "Dataset":
+        return Dataset(self.ctx, E.Distinct(parents=(self.node,),
+                                            keys=tuple(keys)))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self.ctx, E.SetOp(parents=(self.node, other.node),
+                                         op="union"))
+
+    def intersect(self, other: "Dataset") -> "Dataset":
+        return Dataset(self.ctx, E.SetOp(parents=(self.node, other.node),
+                                         op="intersect"))
+
+    def except_(self, other: "Dataset") -> "Dataset":
+        return Dataset(self.ctx, E.SetOp(parents=(self.node, other.node),
+                                         op="except"))
+
+    def concat(self, other: "Dataset") -> "Dataset":
+        return Dataset(self.ctx, E.Concat(parents=(self.node, other.node)))
+
+    def hash_partition(self, keys: Sequence[str]) -> "Dataset":
+        """Explicit repartition (HashPartition, DryadLinqQueryable.cs:275)."""
+        return Dataset(self.ctx, E.HashRepartition(parents=(self.node,),
+                                                   keys=tuple(keys)))
+
+    def range_partition(self, keys: Sequence[str]) -> "Dataset":
+        return Dataset(self.ctx, E.RangeRepartition(parents=(self.node,),
+                                                    keys=tuple(keys)))
+
+    def broadcast(self) -> "Dataset":
+        """Replicate to every partition (small datasets)."""
+        return Dataset(self.ctx, E.Broadcast(parents=(self.node,)))
+
+    # -- terminals ---------------------------------------------------------
+
+    def _materialize(self) -> PData:
+        graph = plan_query(self.node, self.ctx.nparts)
+        return self.ctx.executor.run(graph)
+
+    def collect(self) -> Dict[str, Any]:
+        """Execute and pull all rows to host (Submit + read output)."""
+        if self.ctx.local_debug:
+            return _oracle.run_oracle(self.node)
+        out = pdata_to_host(self._materialize())
+        if isinstance(self.node, E.Take):
+            n = self.node.n
+            out = {k: v[:n] for k, v in out.items()}
+        return out
+
+    def count(self) -> int:
+        if self.ctx.local_debug:
+            t = _oracle.run_oracle(self.node)
+            for v in t.values():
+                return len(v)
+            return 0
+        return self._materialize().total_rows()
+
+    def explain(self) -> str:
+        return plan_query(self.node, self.ctx.nparts).explain()

@@ -1,0 +1,2 @@
+from dryad_tpu.exec.data import PData, pdata_from_host, pdata_to_host  # noqa: F401
+from dryad_tpu.exec.executor import CapacityError, Executor  # noqa: F401

@@ -30,8 +30,8 @@ from dryad_tpu.ops.hashing import hash_batch_keys
 
 __all__ = [
     "compact", "filter_rows", "sort_by_columns", "group_aggregate",
-    "distinct", "scalar_aggregate", "hash_join", "concat2", "take",
-    "AGG_KINDS",
+    "distinct", "scalar_aggregate", "hash_join", "semi_anti_join",
+    "concat2", "take", "AGG_KINDS",
 ]
 
 AGG_KINDS = ("sum", "count", "min", "max", "mean", "any", "all")
@@ -135,22 +135,20 @@ def sort_by_columns(batch: Batch, keys: Sequence[Tuple[str, bool]]) -> Batch:
 # group-by (sort + segment reduce)
 
 
-def _group_segments(batch: Batch, key_names: Sequence[str]):
-    """Sort by key hash; return (sorted batch, seg_id, is_start, num_groups).
+def _hash_sort_segments(hi: jax.Array, lo: jax.Array, valid: jax.Array):
+    """Shared segment machinery: sort rows by 64-bit hash (invalid last),
+    label equal-hash runs among valid rows as segments.
 
-    seg_id for padding rows is set to capacity (out of range — dropped by
-    segment reductions).
+    Returns (order, seg, is_start, num_groups); seg for invalid rows is n
+    (out of range — dropped by segment reductions).
 
     Grouping is by the full 64-bit key hash (both uint32 lanes) without
     true-key verification: two distinct keys colliding in all 64 bits would
     be merged.  P(any collision) ~ n^2/2^64 per partition — negligible at
     per-partition sizes (1e-9 even for 100M-row partitions).
     """
-    hi, lo = hash_batch_keys(batch, key_names)
-    valid = batch.valid_mask()
-    invalid = (~valid).astype(jnp.uint32)
-    order = jnp.lexsort((lo, hi, invalid))
-    sb = batch.gather(order)
+    n = hi.shape[0]
+    order = jnp.lexsort((lo, hi, (~valid).astype(jnp.uint32)))
     shi, slo = jnp.take(hi, order), jnp.take(lo, order)
     svalid = jnp.take(valid, order)
     differs = jnp.concatenate([
@@ -158,10 +156,18 @@ def _group_segments(batch: Batch, key_names: Sequence[str]):
         (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])])
     is_start = svalid & differs
     seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
-    cap = batch.capacity
-    seg = jnp.where(svalid, seg, cap)  # padding -> out-of-range, dropped
+    seg = jnp.where(svalid, seg, n)
     num_groups = is_start.sum(dtype=jnp.int32)
-    return sb, seg, is_start, num_groups
+    return order, seg, is_start, num_groups
+
+
+def _group_segments(batch: Batch, key_names: Sequence[str]):
+    """Sort batch by key hash; return (sorted batch, seg_id, is_start,
+    num_groups).  See _hash_sort_segments for collision semantics."""
+    hi, lo = hash_batch_keys(batch, key_names)
+    order, seg, is_start, num_groups = _hash_sort_segments(
+        hi, lo, batch.valid_mask())
+    return batch.gather(order), seg, is_start, num_groups
 
 
 def _first_row_per_segment(seg: jax.Array, cap: int,
@@ -243,7 +249,7 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
 
 def distinct(batch: Batch, key_names: Sequence[str] | None = None) -> Batch:
     """One representative row per distinct key (all columns kept)."""
-    keys = list(key_names or batch.names)
+    keys = list(key_names) if key_names else sorted(batch.names)
     sb, seg, is_start, num_groups = _group_segments(batch, keys)
     cap = batch.capacity
     return sb.gather(_first_row_per_segment(seg, cap, num_groups),
@@ -383,6 +389,36 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     # conservative: candidate pairs dropped for capacity might have been real
     overflow = total > out_capacity
     return out, overflow
+
+
+def semi_anti_join(left: Batch, right: Batch, left_keys: Sequence[str],
+                   right_keys: Sequence[str], anti: bool = False) -> Batch:
+    """Keep left rows whose key does (semi) / does not (anti) appear in right.
+
+    Exact membership on the full 64-bit hash pair via a merged sort: right
+    hashes are flagged, the union is sorted, and a per-segment max of the
+    flag tells each left row whether its segment contains a right row.
+    Reference semantics: Intersect/Except building blocks
+    (DryadLinqVertex set ops)."""
+    lhi, llo = hash_batch_keys(left, left_keys)
+    rhi, rlo = hash_batch_keys(right, right_keys)
+    lvalid = left.valid_mask()
+    rvalid = right.valid_mask()
+    hi = jnp.concatenate([lhi, rhi])
+    lo = jnp.concatenate([llo, rlo])
+    is_right = jnp.concatenate([jnp.zeros(left.capacity, jnp.int32),
+                                rvalid.astype(jnp.int32)])
+    valid = jnp.concatenate([lvalid, rvalid])
+    n = hi.shape[0]
+    order, seg, _, _ = _hash_sort_segments(hi, lo, valid)
+    has_right = jax.ops.segment_max(jnp.take(is_right, order), seg,
+                                    num_segments=n)
+    row_has_right = jnp.take(has_right, jnp.clip(seg, 0, n - 1)) > 0
+    # scatter back to original positions
+    member = jnp.zeros((n,), jnp.bool_).at[order].set(row_has_right)
+    lmember = member[:left.capacity]
+    keep = lvalid & (~lmember if anti else lmember)
+    return compact(left, keep)
 
 
 # ---------------------------------------------------------------------------

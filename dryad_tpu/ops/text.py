@@ -36,9 +36,11 @@ def split_tokens(batch: Batch, column: str, out_capacity: int,
                  delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>") -> Batch:
     """Split a string column into a batch of tokens (one row per token).
 
-    Output batch has a single string column named ``column``; tokens longer
-    than ``max_token_len`` are truncated; tokens beyond ``out_capacity`` are
-    dropped (callers size capacity; executor can check `token_overflow`).
+    Returns ``(tokens_batch, overflow)``: the batch has a single string
+    column named ``column``; tokens longer than ``max_token_len`` are
+    truncated (semantic); ``overflow`` is True when tokens beyond
+    ``out_capacity`` were dropped (a capacity-planning failure — the
+    executor retries the stage with scaled capacity).
     """
     col: StringColumn = batch.columns[column]
     cap, L = col.capacity, col.max_len
@@ -92,4 +94,4 @@ def split_tokens(batch: Batch, column: str, out_capacity: int,
 
     out = Batch({column: StringColumn(tok_bytes, tok_len)},
                 jnp.minimum(num_tokens, out_capacity))
-    return out
+    return out, num_tokens > out_capacity

@@ -1,0 +1,250 @@
+"""Sequential semantics oracle (the reference's LocalDebug mode).
+
+The reference runs every query twice in tests — cluster mode and
+LINQ-to-objects (`context.LocalDebug = true`, LinqToDryad/DryadLinqQuery.cs:349,
+DryadLinqEnumerable.cs) — and compares.  This module is our LINQ-to-objects:
+a pure numpy/python interpreter of the logical expression DAG, independent of
+JAX, batches, partitions, and collectives.  Tests run each query through both
+paths and compare row multisets (tests/utils.py).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List
+
+import numpy as np
+
+from dryad_tpu.plan import expr as E
+
+__all__ = ["run_oracle"]
+
+Table = Dict[str, Any]  # column name -> np.ndarray | list[bytes]
+
+
+def _nrows(t: Table) -> int:
+    for v in t.values():
+        return len(v)
+    return 0
+
+
+def _row(t: Table, i: int):
+    return {k: (v[i] if isinstance(v, list) else v[i]) for k, v in t.items()}
+
+
+def _take_rows(t: Table, idx) -> Table:
+    out = {}
+    for k, v in t.items():
+        if isinstance(v, list):
+            out[k] = [v[i] for i in idx]
+        else:
+            out[k] = np.asarray(v)[idx]
+    return out
+
+
+def _to_np(cols: Table) -> Table:
+    return {k: (v if isinstance(v, list) else np.asarray(v))
+            for k, v in cols.items()}
+
+
+def _tokenize(line: bytes, delims: bytes, max_len: int, lower: bool):
+    out = []
+    tok = bytearray()
+    for b in line:
+        if b in delims:
+            if tok:
+                out.append(bytes(tok[:max_len]))
+                tok = bytearray()
+        else:
+            tok.append(b)
+    if tok:
+        out.append(bytes(tok[:max_len]))
+    if lower:
+        out = [t.lower() for t in out]
+    return out
+
+
+def _agg(kind: str, vals: List[Any]):
+    if kind == "count":
+        return len(vals)
+    if kind == "sum":
+        return np.sum(vals)
+    if kind == "min":
+        return np.min(vals)
+    if kind == "max":
+        return np.max(vals)
+    if kind == "mean":
+        return float(np.mean(vals))
+    if kind == "any":
+        return bool(np.any(vals))
+    if kind == "all":
+        return bool(np.all(vals))
+    raise ValueError(kind)
+
+
+def _key_of(row: dict, keys) -> tuple:
+    names = keys if keys else sorted(row.keys())
+    out = []
+    for k in names:
+        v = row[k]
+        out.append(v if isinstance(v, bytes) else
+                   (v.item() if hasattr(v, "item") else v))
+    return tuple(out)
+
+
+def run_oracle(root: E.Node, bindings: Dict[str, Table] | None = None) -> Table:
+    bindings = bindings or {}
+    memo: Dict[int, Table] = {}
+
+    def ev(n: E.Node) -> Table:
+        if n.id in memo:
+            return memo[n.id]
+        t = _ev(n)
+        memo[n.id] = t
+        return t
+
+    def _ev(n: E.Node) -> Table:
+        if isinstance(n, E.Source):
+            if n.host is None:
+                raise ValueError("Source has no host data for oracle")
+            return _to_np(n.host)
+        if isinstance(n, E.Placeholder):
+            return _to_np(bindings[n.name])
+        if isinstance(n, E.Map):
+            t = ev(n.parents[0])
+            out = n.fn(dict(t))
+            return {k: (v if isinstance(v, list) else np.asarray(v))
+                    for k, v in out.items()}
+        if isinstance(n, E.Filter):
+            t = ev(n.parents[0])
+            mask = np.asarray(n.fn(dict(t))).astype(bool)
+            return _take_rows(t, np.nonzero(mask)[0])
+        if isinstance(n, E.FlatTokens):
+            t = ev(n.parents[0])
+            toks: List[bytes] = []
+            for line in t[n.column]:
+                toks.extend(_tokenize(line, n.delims, n.max_token_len,
+                                      n.lower))
+            return {n.column: toks}
+        if isinstance(n, E.ApplyPerPartition):
+            raise NotImplementedError(
+                "oracle cannot interpret opaque per-partition functions")
+        if isinstance(n, E.GroupByAgg):
+            t = ev(n.parents[0])
+            nrows = _nrows(t)
+            groups: Dict[tuple, List[int]] = collections.defaultdict(list)
+            order: List[tuple] = []
+            for i in range(nrows):
+                k = _key_of({kk: t[kk][i] for kk in n.keys}, tuple(n.keys))
+                if k not in groups:
+                    order.append(k)
+                groups[k].append(i)
+            out: Table = {k: [] for k in n.keys}
+            for oname in n.aggs:
+                out[oname] = []
+            for k in order:
+                idx = groups[k]
+                for kk, kv in zip(n.keys, k):
+                    out[kk].append(kv)
+                for oname, (kind, col) in n.aggs.items():
+                    vals = [t[col][i] for i in idx] if col else [None] * len(idx)
+                    out[oname].append(_agg(kind, vals))
+            return {k: (v if v and isinstance(v[0], bytes) else np.asarray(v))
+                    for k, v in out.items()}
+        if isinstance(n, E.Join):
+            lt, rt = ev(n.parents[0]), ev(n.parents[1])
+            rmap: Dict[tuple, List[int]] = collections.defaultdict(list)
+            for j in range(_nrows(rt)):
+                rmap[_key_of({k: rt[k][j] for k in n.right_keys},
+                             tuple(n.right_keys))].append(j)
+            rkeyset = set(n.right_keys)
+            rextra = [k for k in rt.keys() if k not in rkeyset]
+            out_names = list(lt.keys()) + [
+                (k if k not in lt else k + "_r") for k in rextra]
+            out: Table = {k: [] for k in out_names}
+            for i in range(_nrows(lt)):
+                k = _key_of({kk: lt[kk][i] for kk in n.left_keys},
+                            tuple(n.left_keys))
+                for j in rmap.get(k, ()):
+                    for kk in lt.keys():
+                        out[kk].append(lt[kk][i])
+                    for kk in rextra:
+                        name = kk if kk not in lt else kk + "_r"
+                        out[name].append(rt[kk][j])
+            return {k: (v if v and isinstance(v[0], bytes) else np.asarray(v))
+                    for k, v in out.items()}
+        if isinstance(n, E.OrderBy):
+            t = ev(n.parents[0])
+            nrows = _nrows(t)
+            # lexicographic multi-key sort via successive stable sorts from
+            # the least significant key (handles bytes descending exactly)
+            idx = list(range(nrows))
+            for col, desc in reversed(n.keys):
+                vals = t[col]
+                idx.sort(key=lambda i: vals[i], reverse=desc)
+            return _take_rows(t, idx)
+        if isinstance(n, E.Distinct):
+            t = ev(n.parents[0])
+            seen = set()
+            idx = []
+            keys = tuple(n.keys) or tuple(sorted(t.keys()))
+            for i in range(_nrows(t)):
+                k = _key_of({kk: t[kk][i] for kk in keys}, keys)
+                if k not in seen:
+                    seen.add(k)
+                    idx.append(i)
+            return _take_rows(t, idx)
+        if isinstance(n, E.SetOp):
+            lt, rt = ev(n.parents[0]), ev(n.parents[1])
+            names = list(lt.keys())
+            lrows = [_key_of({k: lt[k][i] for k in names}, tuple(names))
+                     for i in range(_nrows(lt))]
+            rrows = {_key_of({k: rt[k][i] for k in names}, tuple(names))
+                     for i in range(_nrows(rt))}
+            seen = set()
+            idx = []
+            for i, k in enumerate(lrows):
+                if k in seen:
+                    continue
+                if n.op == "union":
+                    seen.add(k)
+                    idx.append(i)
+                elif n.op == "intersect" and k in rrows:
+                    seen.add(k)
+                    idx.append(i)
+                elif n.op == "except" and k not in rrows:
+                    seen.add(k)
+                    idx.append(i)
+            out = _take_rows(lt, idx)
+            if n.op == "union":
+                extra = []
+                for i in range(_nrows(rt)):
+                    k = _key_of({kk: rt[kk][i] for kk in names}, tuple(names))
+                    if k not in seen:
+                        seen.add(k)
+                        extra.append(i)
+                radd = _take_rows(rt, extra)
+                out = {k: (list(out[k]) + list(radd[k])
+                           if isinstance(out[k], list)
+                           else np.concatenate([out[k], radd[k]]))
+                       for k in names}
+            return out
+        if isinstance(n, E.Concat):
+            lt, rt = ev(n.parents[0]), ev(n.parents[1])
+            return {k: (list(lt[k]) + list(rt[k]) if isinstance(lt[k], list)
+                        else np.concatenate([lt[k], rt[k]]))
+                    for k in lt.keys()}
+        if isinstance(n, (E.HashRepartition, E.RangeRepartition)):
+            return ev(n.parents[0])
+        if isinstance(n, E.Broadcast):
+            t = ev(n.parents[0])
+            reps = n.parents[0].npartitions
+            return {k: (list(v) * reps if isinstance(v, list)
+                        else np.tile(v, (reps,) + (1,) * (v.ndim - 1)))
+                    for k, v in t.items()}
+        if isinstance(n, E.Take):
+            t = ev(n.parents[0])
+            return _take_rows(t, range(min(n.n, _nrows(t))))
+        raise TypeError(f"oracle: unhandled node {type(n).__name__}")
+
+    return ev(root)

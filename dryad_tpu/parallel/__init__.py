@@ -1,0 +1,1 @@
+from dryad_tpu.parallel import mesh, shuffle  # noqa: F401

@@ -1,0 +1,36 @@
+"""Device mesh handling.
+
+The reference's cluster topology tree (GraphManager/kernel/DrResources.h:23 —
+Core/Socket/Computer/Rack/Cluster levels feeding locality-aware scheduling)
+maps on TPU to the ICI mesh: partitions ride the ``dp`` axis, and the
+hierarchical aggregation trees of DrDynamicAggregateManager (machine -> pod
+-> overall) become collectives over mesh sub-axes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PARTITION_AXIS = "dp"
+
+__all__ = ["PARTITION_AXIS", "make_mesh", "partition_spec", "batch_sharding"]
+
+
+def make_mesh(devices=None, n: int | None = None) -> Mesh:
+    """1-D partition mesh over the given (or all) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.asarray(devs), (PARTITION_AXIS,))
+
+
+def partition_spec() -> PartitionSpec:
+    return PartitionSpec(PARTITION_AXIS)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for stacked per-partition data: leading dim over dp."""
+    return NamedSharding(mesh, PartitionSpec(PARTITION_AXIS))

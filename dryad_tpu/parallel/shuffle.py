@@ -1,0 +1,173 @@
+"""Sharded exchanges: hash/range repartition and broadcast as XLA collectives.
+
+This module replaces the reference's entire shuffle transport (SURVEY.md
+§2.8: producer temp files + GM URI rewriting (kernel/DrCluster.cpp:553-569) +
+ranged HTTP GETs (managedchannel/HttpReader.cs:78-105) served by
+ProcessService FileServer) with in-HBM ``all_to_all`` over the ICI mesh, and
+the dynamic broadcast tree (DrDynamicBroadcast.h:23) with ``all_gather``.
+
+All functions here run INSIDE ``shard_map`` over the partition axis: they
+take the calling device's partition Batch and return the post-exchange
+partition Batch plus an overflow flag.  Capacities are static; skew beyond
+the per-destination capacity sets the overflow flag (checked host-side by the
+executor, which re-plans with a larger capacity — the moral equivalent of
+DrDynamicDistributionManager's runtime repartitioning).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.data.columnar import Batch, StringColumn
+from dryad_tpu.ops.hashing import hash_batch_keys
+from dryad_tpu.ops.kernels import sort_lanes_for
+from dryad_tpu.parallel.mesh import PARTITION_AXIS
+
+__all__ = ["exchange_by_dest", "hash_exchange", "range_exchange",
+           "broadcast_gather", "range_dest_lane"]
+
+
+def _axis_size() -> int:
+    return jax.lax.axis_size(PARTITION_AXIS)
+
+
+def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
+                     send_slack: int = 2) -> Tuple[Batch, jax.Array]:
+    """Send each valid row to partition ``dest[row]``; return the rows
+    received by this partition, compacted, plus an overflow flag.
+
+    Implementation: stable-sort rows by destination, scatter into a
+    [D, C] send buffer (C = per-destination slot count), ``all_to_all``
+    over the partition axis, then compact received chunks.
+    """
+    D = _axis_size()
+    cap = batch.capacity
+    valid = batch.valid_mask()
+    dest = jnp.where(valid, dest.astype(jnp.int32), D)  # invalid -> sentinel
+
+    # per-destination slot capacity in the send buffer: worst-case a single
+    # destination receives this partition's whole batch, but sizing for that
+    # squares the buffer; default slack of 2x even spread, scaled up by the
+    # executor's overflow retry (send_slack grows with the capacity scale).
+    C = max(1, min(cap, -(-send_slack * cap // D)))
+
+    order = jnp.argsort(dest, stable=True)
+    sdest = jnp.take(dest, order)
+    sb = batch.gather(order)
+    counts = jnp.bincount(jnp.minimum(sdest, D), length=D + 1)[:D]
+    offsets = jnp.cumsum(counts) - counts  # exclusive prefix
+
+    # send slot (d, j) <- sorted row offsets[d] + j  (j < counts[d])
+    d_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
+    j_idx = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
+    src = jnp.take(offsets, d_idx) + j_idx
+    slot_filled = j_idx < jnp.take(counts, d_idx)
+    src = jnp.clip(src, 0, cap - 1)
+    send = sb.gather(src)  # [D*C] rows, garbage where not slot_filled
+    send_counts = jnp.minimum(counts, C)  # rows actually shipped per dest
+    send_overflow = (counts > C).any()
+
+    # all_to_all: split leading dim into D chunks, exchange, concat
+    def a2a(x):
+        return jax.lax.all_to_all(x, PARTITION_AXIS, 0, 0, tiled=True)
+
+    recv_cols = {}
+    for k, v in send.columns.items():
+        if isinstance(v, StringColumn):
+            recv_cols[k] = StringColumn(a2a(v.data), a2a(v.lengths))
+        else:
+            recv_cols[k] = a2a(v)
+    recv_counts = jax.lax.all_to_all(
+        send_counts, PARTITION_AXIS, 0, 0, tiled=True)  # [D]
+
+    # compact received rows: row (s, j) valid iff j < recv_counts[s]
+    s_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
+    jj = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
+    rvalid = jj < jnp.take(recv_counts, s_idx)
+    recv = Batch(recv_cols, rvalid.sum(dtype=jnp.int32))
+    perm = jnp.argsort(~rvalid, stable=True)
+    total = rvalid.sum(dtype=jnp.int32)
+
+    if out_capacity >= D * C:
+        out = recv.gather(perm).pad_to(out_capacity)
+        recv_overflow = jnp.zeros((), jnp.bool_)
+    else:
+        out = recv.gather(perm[:out_capacity])
+        recv_overflow = total > out_capacity
+    out = out.with_count(jnp.minimum(total, out_capacity))
+
+    overflow = send_overflow | recv_overflow
+    # any shard overflowing poisons the whole exchange
+    overflow = jax.lax.psum(overflow.astype(jnp.int32), PARTITION_AXIS) > 0
+    return out, overflow
+
+
+def hash_exchange(batch: Batch, keys: Sequence[str], out_capacity: int,
+                  send_slack: int = 2) -> Tuple[Batch, jax.Array]:
+    """Repartition rows by key hash (HashPartition / shuffle-for-GroupBy)."""
+    D = _axis_size()
+    _, lo = hash_batch_keys(batch, keys)
+    dest = (lo % jnp.uint32(D)).astype(jnp.int32)
+    return exchange_by_dest(batch, dest, out_capacity, send_slack)
+
+
+def range_dest_lane(col) -> jax.Array:
+    """uint32 ordering lane used for range partitioning decisions.
+
+    The FIRST sort lane of the column (see ops.kernels.sort_lanes_for):
+    order-preserving for numerics; for strings it is the first 4 bytes, so
+    rows equal in the lane stay together (same destination) and global order
+    across partitions is still correct after local full-key sorts.
+    """
+    return sort_lanes_for(col, descending=False)[0]
+
+
+def range_exchange(batch: Batch, key: str, bounds: jax.Array,
+                   out_capacity: int, descending: bool = False,
+                   send_slack: int = 2) -> Tuple[Batch, jax.Array]:
+    """Repartition by range: row -> searchsorted(bounds, lane(key)).
+
+    ``bounds`` is a [D-1] uint32 array of split points over the ordering
+    lane, computed host-side from samples (the reference computes these in a
+    sampling stage: DryadLinqSampler.cs:42 + DrDynamicRangeDistributor.h:23).
+    """
+    D = _axis_size()
+    lane = range_dest_lane(batch.columns[key])
+    dest = jnp.searchsorted(bounds, lane, side="right").astype(jnp.int32)
+    if descending:
+        dest = (D - 1) - dest
+    return exchange_by_dest(batch, dest, out_capacity, send_slack)
+
+
+def broadcast_gather(batch: Batch, out_capacity: int) -> Tuple[Batch, jax.Array]:
+    """Replicate all partitions' rows to every partition (all_gather +
+    compact).  Used for broadcast joins and k-means centroids."""
+    D = _axis_size()
+    cap = batch.capacity
+
+    def ag(x):
+        return jax.lax.all_gather(x, PARTITION_AXIS, axis=0, tiled=True)
+
+    cols = {}
+    for k, v in batch.columns.items():
+        if isinstance(v, StringColumn):
+            cols[k] = StringColumn(ag(v.data), ag(v.lengths))
+        else:
+            cols[k] = ag(v)
+    counts = jax.lax.all_gather(batch.count, PARTITION_AXIS)  # [D]
+    s_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), cap)
+    jj = jnp.tile(jnp.arange(cap, dtype=jnp.int32), D)
+    rvalid = jj < jnp.take(counts, s_idx)
+    total = rvalid.sum(dtype=jnp.int32)
+    merged = Batch(cols, total)
+    perm = jnp.argsort(~rvalid, stable=True)
+    if out_capacity >= D * cap:
+        out = merged.gather(perm).pad_to(out_capacity)
+        overflow = jnp.zeros((), jnp.bool_)
+    else:
+        out = merged.gather(perm[:out_capacity])
+        overflow = total > out_capacity
+    return out.with_count(jnp.minimum(total, out_capacity)), overflow

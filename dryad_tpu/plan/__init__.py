@@ -1,0 +1,3 @@
+from dryad_tpu.plan import expr  # noqa: F401
+from dryad_tpu.plan.planner import plan_query  # noqa: F401
+from dryad_tpu.plan.stages import StageGraph  # noqa: F401

@@ -1,0 +1,297 @@
+"""Logical query expression DAG.
+
+The counterpart of the reference's plan node model
+(LinqToDryad/DryadLinqQueryNode.cs:39 — `QueryNodeType` with 33 node kinds,
+`DLinqQueryNode` carrying partition count/scheme/channel info).  A user's
+``Dataset`` method chain builds this DAG lazily; the planner
+(dryad_tpu/plan/planner.py) lowers it to physical stages.
+
+Unlike the reference — whose nodes emit C# vertex code strings
+(DryadLinqCodeGen.cs) — our nodes carry Python callables over columnar
+Batches that will be traced and fused by XLA inside each stage's jit.
+
+Partitioning metadata (`Partitioning`) mirrors the reference's partition-info
+tracking used for shuffle elimination (DryadLinqQueryNode partition info /
+`AssumeHashPartition`, DryadLinqQueryable.cs:3408).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "Partitioning", "Node", "Source", "Placeholder", "Map", "Filter",
+    "FlatTokens", "GroupByAgg", "Join", "OrderBy", "Distinct", "Concat",
+    "HashRepartition", "RangeRepartition", "Broadcast", "ApplyPerPartition",
+    "Take", "SetOp", "walk",
+]
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass(frozen=True)
+class Partitioning:
+    """How a dataset's rows are distributed over partitions."""
+
+    kind: str  # "none" | "hash" | "range" | "replicated" | "single"
+    keys: Tuple[str, ...] = ()
+
+    @staticmethod
+    def none() -> "Partitioning":
+        return Partitioning("none")
+
+
+class Node:
+    """Base logical node.  Subclasses are dataclasses with `parents`."""
+
+    id: int
+    parents: Tuple["Node", ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "id", next(_ids))
+
+    @property
+    def npartitions(self) -> int:
+        return self.parents[0].npartitions
+
+    @property
+    def partitioning(self) -> Partitioning:
+        """Partitioning of the output; default: destroyed by the op unless
+        the op is row-local (preserves parent partitioning)."""
+        return self.parents[0].partitioning
+
+
+def _node(cls):
+    return dataclasses.dataclass(frozen=True, eq=False)(cls)
+
+
+@_node
+class Source(Node):
+    """Materialized input: a PBatch handle (exec.data.PartitionedData) or a
+    store reference resolved by the executor.  Reference: DLinqInputNode
+    (DryadLinqQueryNode.cs:837)."""
+
+    parents: Tuple[Node, ...]
+    data: Any
+    _npartitions: int
+    _partitioning: Partitioning = Partitioning.none()
+    host: Any = None  # host-side copy of the columns, for the oracle
+
+    @property
+    def npartitions(self) -> int:
+        return self._npartitions
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return self._partitioning
+
+
+@_node
+class Placeholder(Node):
+    """Loop-carried input for do_while bodies; bound at execution time."""
+
+    parents: Tuple[Node, ...]
+    name: str
+    _npartitions: int
+    capacity: int = 0
+    _partitioning: Partitioning = Partitioning.none()
+
+    @property
+    def npartitions(self) -> int:
+        return self._npartitions
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return self._partitioning
+
+
+@_node
+class Map(Node):
+    """Columnwise projection/transform: fn(cols) -> cols.
+    Reference: DLinqSelectNode (DryadLinqQueryNode.cs:1155)."""
+
+    parents: Tuple[Node, ...]
+    fn: Callable
+    label: str = "map"
+
+
+@_node
+class Filter(Node):
+    """fn(cols) -> bool mask.  Reference: Where."""
+
+    parents: Tuple[Node, ...]
+    fn: Callable
+    label: str = "where"
+
+
+@_node
+class FlatTokens(Node):
+    """Tokenizing SelectMany over a string column (the WordCount kernel)."""
+
+    parents: Tuple[Node, ...]
+    column: str
+    out_capacity: int
+    max_token_len: int = 24
+    delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>"
+    lower: bool = False
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning.none()
+
+
+@_node
+class ApplyPerPartition(Node):
+    """Arbitrary per-partition Batch -> Batch function (escape hatch).
+    Reference: ApplyPerPartition (DryadLinqQueryable.cs:1084)."""
+
+    parents: Tuple[Node, ...]
+    fn: Callable
+    label: str = "apply"
+    preserves_partitioning: bool = False
+
+    @property
+    def partitioning(self) -> Partitioning:
+        if self.preserves_partitioning:
+            return self.parents[0].partitioning
+        return Partitioning.none()
+
+
+@_node
+class GroupByAgg(Node):
+    """GroupBy + decomposable aggregation.
+    aggs: out_name -> (kind, value_col | None).
+    Reference: DLinqGroupByNode (DryadLinqQueryNode.cs:1581) +
+    IDecomposable (IDecomposable.cs:34)."""
+
+    parents: Tuple[Node, ...]
+    keys: Tuple[str, ...]
+    aggs: Dict[str, Tuple[str, Optional[str]]]
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", tuple(self.keys))
+
+
+@_node
+class Join(Node):
+    """Inner equi-join.  Reference: DLinqJoinNode (DryadLinqQueryNode.cs:2053)."""
+
+    parents: Tuple[Node, ...]  # (left, right)
+    left_keys: Tuple[str, ...]
+    right_keys: Tuple[str, ...]
+    expansion: float = 1.0  # out_capacity multiplier over left capacity
+    broadcast_right: bool = False
+
+    @property
+    def npartitions(self) -> int:
+        return self.parents[0].npartitions
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", tuple(self.left_keys))
+
+
+@_node
+class OrderBy(Node):
+    """Global sort via sampling + range partition + local sort.
+    Reference: DLinqOrderByNode; sampling DryadLinqSampler.cs:42."""
+
+    parents: Tuple[Node, ...]
+    keys: Tuple[Tuple[str, bool], ...]  # (column, descending)
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("range", tuple(k for k, _ in self.keys))
+
+
+@_node
+class Distinct(Node):
+    parents: Tuple[Node, ...]
+    keys: Tuple[str, ...]  # empty = all columns
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", tuple(self.keys))
+
+
+@_node
+class SetOp(Node):
+    """Union/Intersect/Except with set semantics (dedup), over all columns."""
+
+    parents: Tuple[Node, ...]  # (left, right)
+    op: str  # "union" | "intersect" | "except"
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", ())
+
+
+@_node
+class Concat(Node):
+    parents: Tuple[Node, ...]  # (left, right)
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning.none()
+
+
+@_node
+class HashRepartition(Node):
+    """Explicit HashPartition (DryadLinqQueryable.cs:275)."""
+
+    parents: Tuple[Node, ...]
+    keys: Tuple[str, ...]
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", tuple(self.keys))
+
+
+@_node
+class RangeRepartition(Node):
+    """Explicit RangePartition (DryadLinqQueryable.cs:518)."""
+
+    parents: Tuple[Node, ...]
+    keys: Tuple[str, ...]
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("range", tuple(self.keys))
+
+
+@_node
+class Broadcast(Node):
+    """Replicate a (small) dataset to every partition.
+    Reference: DrDynamicBroadcastManager (DrDynamicBroadcast.h:23)."""
+
+    parents: Tuple[Node, ...]
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("replicated")
+
+
+@_node
+class Take(Node):
+    parents: Tuple[Node, ...]
+    n: int
+
+
+def walk(root: Node):
+    """Topological (parents-first) walk, each node once."""
+    seen = set()
+    order = []
+
+    def visit(n: Node):
+        if n.id in seen:
+            return
+        seen.add(n.id)
+        for p in n.parents:
+            visit(p)
+        order.append(n)
+
+    visit(root)
+    return order

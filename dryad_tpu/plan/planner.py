@@ -1,0 +1,304 @@
+"""Logical -> physical lowering.
+
+The counterpart of the reference's three query-gen phases
+(DryadLinqQueryGen.cs: phase1 node creation :269, phase2 pipelining into
+supernodes + Tee insertion :391-456, phase3 :459) plus GraphBuilder's dynamic
+manager wiring (GraphBuilder.cs:620-729).  Our phases:
+
+1. walk the expression DAG, counting consumers;
+2. grow "fragments" (chains of local ops) along each edge — the supernode
+   pipelining: everything row-local fuses into one stage program;
+3. cut stages at exchange points (group-by, join, repartition, sort) and at
+   fan-out (Tee: a multiply-consumed node is materialized once);
+4. lower aggregations into partial + exchange + final (the IDecomposable /
+   PARTIALAGGR pattern), sorts into sample -> range exchange -> local sort
+   (the RANGEDISTRIBUTOR pattern), small-side joins into broadcast
+   (BROADCAST pattern).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from dryad_tpu.plan import expr as E
+from dryad_tpu.plan.stages import Exchange, Leg, Stage, StageGraph, StageOp
+
+__all__ = ["Planner", "plan_query"]
+
+
+@dataclasses.dataclass
+class Fragment:
+    src: Any  # int stage id | ("source", data) | ("placeholder", name)
+    ops: List[StageOp]
+    capacity: int
+    partitioning: E.Partitioning
+
+
+# Decomposition of aggregates into partial (pre-shuffle) and final
+# (post-shuffle) parts — reference IDecomposable.cs:34
+# (Initialize/Seed/Accumulate/RecursiveAccumulate/FinalReduce).
+def _decompose_aggs(aggs: Dict[str, Tuple[str, Optional[str]]]):
+    partial: Dict[str, Tuple[str, Optional[str]]] = {}
+    final: Dict[str, Tuple[str, Optional[str]]] = {}
+    mean_cols: List[str] = []
+    for out, (kind, col) in aggs.items():
+        if kind == "count":
+            partial[out] = ("count", None)
+            final[out] = ("sum", out)
+        elif kind in ("sum", "min", "max", "any", "all"):
+            partial[out] = (kind, col)
+            merge_kind = "sum" if kind == "sum" else kind
+            final[out] = (merge_kind, out)
+        elif kind == "mean":
+            partial[out + "__sum"] = ("sum", col)
+            partial[out + "__cnt"] = ("count", None)
+            final[out + "__sum"] = ("sum", out + "__sum")
+            final[out + "__cnt"] = ("sum", out + "__cnt")
+            mean_cols.append(out)
+        else:
+            raise ValueError(f"aggregate kind {kind!r} not decomposable")
+    return partial, final, mean_cols
+
+
+def _mean_post_fn(mean_cols: List[str]):
+    import jax.numpy as jnp
+
+    def fn(cols):
+        out = dict(cols)
+        for m in mean_cols:
+            s = out.pop(m + "__sum")
+            c = out.pop(m + "__cnt")
+            cf = jnp.maximum(c, 1)
+            out[m] = s / cf if jnp.issubdtype(s.dtype, jnp.floating) \
+                else s.astype(jnp.float32) / cf
+        return out
+
+    return fn
+
+
+class Planner:
+    def __init__(self, npartitions: int):
+        self.nparts = npartitions
+        self.stages: List[Stage] = []
+        self.frags: Dict[int, Fragment] = {}
+        self.consumers: Dict[int, int] = {}
+
+    # -- stage helpers -----------------------------------------------------
+
+    def _new_stage(self, legs: List[Leg], body: List[StageOp],
+                   label: str) -> Stage:
+        st = Stage(id=len(self.stages), legs=legs, body=body, label=label)
+        self.stages.append(st)
+        return st
+
+    def _materialize(self, frag: Fragment, label: str = "tee") -> Tuple[int, Fragment]:
+        """Ensure the fragment is a stage output; return (stage_id, fresh frag)."""
+        if isinstance(frag.src, int) and not frag.ops:
+            return frag.src, frag
+        st = self._new_stage([Leg(frag.src, frag.ops, None)], [], label)
+        nf = Fragment(st.id, [], frag.capacity, frag.partitioning)
+        return st.id, nf
+
+    # -- main --------------------------------------------------------------
+
+    def plan(self, root: E.Node) -> StageGraph:
+        order = E.walk(root)
+        for n in order:
+            for p in n.parents:
+                self.consumers[p.id] = self.consumers.get(p.id, 0) + 1
+        for n in order:
+            frag = self._lower(n)
+            if self.consumers.get(n.id, 0) > 1:
+                _, frag = self._materialize(frag, label=f"tee:{type(n).__name__}")
+            self.frags[n.id] = frag
+        out_id, _ = self._materialize(self.frags[root.id], label="output")
+        return StageGraph(self.stages, out_id)
+
+    def _frag(self, n: E.Node) -> Fragment:
+        f = self.frags[n.id]
+        # fragments are single-use unless materialized; copy op list
+        return Fragment(f.src, list(f.ops), f.capacity, f.partitioning)
+
+    def _lower(self, n: E.Node) -> Fragment:
+        if isinstance(n, E.Source):
+            cap = getattr(n.data, "capacity", None)
+            if cap is None:
+                raise ValueError("Source.data must expose .capacity")
+            return Fragment(("source", n.data), [], cap, n.partitioning)
+
+        if isinstance(n, E.Placeholder):
+            cap = getattr(n, "capacity", None) or 0
+            return Fragment(("placeholder", n.name), [], cap, n.partitioning)
+
+        if isinstance(n, E.Map):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("fn", {"fn": n.fn, "label": n.label}))
+            return f
+
+        if isinstance(n, E.Filter):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("filter", {"fn": n.fn, "label": n.label}))
+            return f
+
+        if isinstance(n, E.FlatTokens):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("flat_tokens", {
+                "column": n.column, "out_capacity": n.out_capacity,
+                "max_token_len": n.max_token_len, "delims": n.delims,
+                "lower": n.lower}))
+            f.capacity = n.out_capacity
+            f.partitioning = E.Partitioning.none()
+            return f
+
+        if isinstance(n, E.ApplyPerPartition):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("apply", {"fn": n.fn, "label": n.label}))
+            f.partitioning = n.partitioning
+            return f
+
+        if isinstance(n, E.Take):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("take", {"n": n.n, "global": True}))
+            return f
+
+        if isinstance(n, E.GroupByAgg):
+            f = self._frag(n.parents[0])
+            keys = tuple(n.keys)
+            if f.partitioning.kind == "hash" and f.partitioning.keys == keys:
+                # partition elimination: already co-located by these keys
+                f.ops.append(StageOp("group", {"keys": keys, "aggs": dict(n.aggs)}))
+                return f
+            partial, final, mean_cols = _decompose_aggs(n.aggs)
+            f.ops.append(StageOp("group", {"keys": keys, "aggs": partial}))
+            ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
+            body: List[StageOp] = [StageOp("group", {"keys": keys, "aggs": final})]
+            if mean_cols:
+                body.append(StageOp("fn", {"fn": _mean_post_fn(mean_cols),
+                                           "label": "mean-finalize"}))
+            st = self._new_stage([Leg(f.src, f.ops, ex)], body, "groupby")
+            return Fragment(st.id, [], f.capacity,
+                            E.Partitioning("hash", keys))
+
+        if isinstance(n, E.Distinct):
+            f = self._frag(n.parents[0])
+            keys = tuple(n.keys)
+            if f.partitioning.kind == "hash" and f.partitioning.keys == keys \
+                    and keys:
+                f.ops.append(StageOp("distinct", {"keys": keys}))
+                return f
+            f.ops.append(StageOp("distinct", {"keys": keys}))
+            ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
+            st = self._new_stage(
+                [Leg(f.src, f.ops, ex)],
+                [StageOp("distinct", {"keys": keys})], "distinct")
+            return Fragment(st.id, [], f.capacity, E.Partitioning("hash", keys))
+
+        if isinstance(n, E.Join):
+            lf = self._frag(n.parents[0])
+            rf = self._frag(n.parents[1])
+            lkeys, rkeys = tuple(n.left_keys), tuple(n.right_keys)
+            out_cap = max(1, int(lf.capacity * n.expansion))
+            if n.broadcast_right:
+                rex = Exchange("broadcast",
+                               out_capacity=rf.capacity * self.nparts)
+                lex = None
+            else:
+                lex = None if (lf.partitioning.kind == "hash"
+                               and lf.partitioning.keys == lkeys) else \
+                    Exchange("hash", keys=lkeys, out_capacity=lf.capacity)
+                rex = None if (rf.partitioning.kind == "hash"
+                               and rf.partitioning.keys == rkeys) else \
+                    Exchange("hash", keys=rkeys, out_capacity=rf.capacity)
+            st = self._new_stage(
+                [Leg(lf.src, lf.ops, lex), Leg(rf.src, rf.ops, rex)],
+                [StageOp("join", {"left_keys": lkeys, "right_keys": rkeys,
+                                  "out_capacity": out_cap})], "join")
+            # broadcast join keeps the LEFT side's distribution (each
+            # partition holds matches for its own left rows only)
+            out_part = lf.partitioning if n.broadcast_right \
+                else E.Partitioning("hash", lkeys)
+            return Fragment(st.id, [], out_cap, out_part)
+
+        if isinstance(n, E.OrderBy):
+            f = self._frag(n.parents[0])
+            src_id, f = self._materialize(f, label="sort-input")
+            primary, desc = n.keys[0]
+            ex = Exchange("range", keys=(primary,), out_capacity=f.capacity,
+                          descending=desc, bounds_from=src_id,
+                          bounds_key=primary)
+            st = self._new_stage(
+                [Leg(src_id, [], ex)],
+                [StageOp("sort", {"keys": tuple(n.keys)})], "orderby")
+            return Fragment(st.id, [], f.capacity,
+                            E.Partitioning("range",
+                                           tuple(k for k, _ in n.keys)))
+
+        if isinstance(n, E.SetOp):
+            lf = self._frag(n.parents[0])
+            rf = self._frag(n.parents[1])
+            lf.ops.append(StageOp("distinct", {"keys": ()}))
+            if n.op != "union":
+                rf.ops.append(StageOp("distinct", {"keys": ()}))
+            lex = Exchange("hash", keys=(), out_capacity=lf.capacity)
+            rex = Exchange("hash", keys=(), out_capacity=rf.capacity)
+            # the per-leg distinct dedups within a partition; after the
+            # exchange, copies arriving from different partitions are
+            # co-located, so a post-exchange distinct finishes the dedup
+            if n.op == "union":
+                body = [StageOp("concat", {}), StageOp("distinct", {"keys": ()})]
+                cap = lf.capacity + rf.capacity
+            elif n.op == "intersect":
+                body = [StageOp("semi_anti", {"anti": False}),
+                        StageOp("distinct", {"keys": ()})]
+                cap = lf.capacity
+            elif n.op == "except":
+                body = [StageOp("semi_anti", {"anti": True}),
+                        StageOp("distinct", {"keys": ()})]
+                cap = lf.capacity
+            else:
+                raise ValueError(n.op)
+            st = self._new_stage(
+                [Leg(lf.src, lf.ops, lex), Leg(rf.src, rf.ops, rex)],
+                body, n.op)
+            return Fragment(st.id, [], cap, E.Partitioning("hash", ()))
+
+        if isinstance(n, E.Concat):
+            lf = self._frag(n.parents[0])
+            rf = self._frag(n.parents[1])
+            st = self._new_stage(
+                [Leg(lf.src, lf.ops, None), Leg(rf.src, rf.ops, None)],
+                [StageOp("concat", {})], "concat")
+            return Fragment(st.id, [], lf.capacity + rf.capacity,
+                            E.Partitioning.none())
+
+        if isinstance(n, E.HashRepartition):
+            f = self._frag(n.parents[0])
+            ex = Exchange("hash", keys=tuple(n.keys), out_capacity=f.capacity)
+            st = self._new_stage([Leg(f.src, f.ops, ex)], [], "hashpartition")
+            return Fragment(st.id, [], f.capacity,
+                            E.Partitioning("hash", tuple(n.keys)))
+
+        if isinstance(n, E.RangeRepartition):
+            f = self._frag(n.parents[0])
+            src_id, f = self._materialize(f, label="range-input")
+            key = n.keys[0]
+            ex = Exchange("range", keys=(key,), out_capacity=f.capacity,
+                          bounds_from=src_id, bounds_key=key)
+            st = self._new_stage([Leg(src_id, [], ex)], [], "rangepartition")
+            return Fragment(st.id, [], f.capacity,
+                            E.Partitioning("range", tuple(n.keys)))
+
+        if isinstance(n, E.Broadcast):
+            f = self._frag(n.parents[0])
+            ex = Exchange("broadcast",
+                          out_capacity=f.capacity * self.nparts)
+            st = self._new_stage([Leg(f.src, f.ops, ex)], [], "broadcast")
+            return Fragment(st.id, [], f.capacity * self.nparts,
+                            E.Partitioning("replicated"))
+
+        raise TypeError(f"planner: unhandled node {type(n).__name__}")
+
+
+def plan_query(root: E.Node, npartitions: int) -> StageGraph:
+    return Planner(npartitions).plan(root)

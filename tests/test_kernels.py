@@ -167,17 +167,24 @@ def test_split_tokens():
     b = batch_from_numpy(
         {"line": ["the quick brown fox", "", "the lazy dog  the"]},
         capacity=4, str_max_len=32)
-    out = split_tokens(b, "line", out_capacity=16)
+    out, overflow = split_tokens(b, "line", out_capacity=16)
+    assert not bool(overflow)
     got = batch_to_numpy(out)
     assert got["line"] == [b"the", b"quick", b"brown", b"fox",
                            b"the", b"lazy", b"dog", b"the"]
+    # overflow probe: capacity smaller than token count flags and keeps the
+    # first out_capacity tokens intact
+    small, of2 = split_tokens(b, "line", out_capacity=4)
+    assert bool(of2)
+    got2 = batch_to_numpy(small)
+    assert got2["line"] == [b"the", b"quick", b"brown", b"fox"]
 
 
 def test_wordcount_composition():
     lines = ["the quick brown fox jumps over the lazy dog",
              "The dog barks", "a fox and a dog"]
     b = batch_from_numpy({"line": lines}, capacity=4, str_max_len=64)
-    toks = split_tokens(b, "line", out_capacity=64)
+    toks, _ = split_tokens(b, "line", out_capacity=64)
     toks = Batch({"line": lower_ascii(toks.columns["line"])}, toks.count)
     counts = kernels.group_aggregate(toks, ["line"], {"n": ("count", None)})
     got = batch_to_numpy(counts)

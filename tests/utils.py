@@ -1,0 +1,45 @@
+"""Test comparison helpers — the Utils.Validate.Check of the reference
+(DryadLinqTests/Utils.cs:305): compare executor output against the oracle as
+row multisets (most operators are order-insensitive) or exactly (sorts)."""
+
+import collections
+
+import numpy as np
+
+
+def rows_of(table):
+    names = sorted(table.keys())
+    n = None
+    for v in table.values():
+        n = len(v)
+        break
+    rows = []
+    for i in range(n):
+        row = []
+        for k in names:
+            v = table[k][i]
+            if isinstance(v, bytes):
+                row.append(v)
+            elif isinstance(v, (float, np.floating)):
+                row.append(round(float(v), 4))
+            elif hasattr(v, "item"):
+                item = v.item()
+                row.append(round(item, 4) if isinstance(item, float) else item)
+            else:
+                row.append(v)
+        rows.append(tuple(row))
+    return rows
+
+
+def assert_same_rows(got, expected, ordered=False):
+    g, e = rows_of(got), rows_of(expected)
+    if ordered:
+        assert g == e, f"ordered mismatch:\n got[:5]={g[:5]}\n exp[:5]={e[:5]}"
+    else:
+        cg, ce = collections.Counter(g), collections.Counter(e)
+        if cg != ce:
+            missing = list((ce - cg).items())[:5]
+            extra = list((cg - ce).items())[:5]
+            raise AssertionError(
+                f"row multiset mismatch: missing={missing} extra={extra} "
+                f"(got {len(g)} rows, expected {len(e)})")
