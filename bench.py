@@ -1,0 +1,87 @@
+"""Benchmark driver: WordCount rows/sec/chip (BASELINE.md config 1) with
+TeraSort + GroupByReduce details.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The reference repo publishes no numbers (BASELINE.md) — vs_baseline is
+reported against the north-star placeholder 1.0 until a measured reference
+exists.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench(fn, warmup=1, iters=3):
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.time()
+        fn()
+        best = min(best, time.time() - t0)
+    return best
+
+
+def main():
+    import jax
+
+    from dryad_tpu import Context
+    from dryad_tpu.apps import terasort, wordcount
+    from dryad_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices())
+    nchips = mesh.devices.size
+    ctx = Context(mesh=mesh)
+
+    # ---- WordCount ----
+    n_lines = 100_000
+    rng = np.random.RandomState(0)
+    vocab = np.array(["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
+                      "eta", "theta", "iota", "kappa", "lam", "mu"])
+    words_per_line = 8
+    idx = rng.randint(0, len(vocab), (n_lines, words_per_line))
+    lines = [" ".join(vocab[i]) for i in idx]
+
+    ds = ctx.from_columns({"line": lines}, str_max_len=96)
+    per_part = -(-n_lines // nchips)
+    q = wordcount.wordcount_query(
+        ds, tokens_per_partition=per_part * (words_per_line + 2))
+
+    def run_wc():
+        return q.collect()
+
+    wc_s = _bench(run_wc)
+    wc_rows_per_sec_chip = n_lines / wc_s / nchips
+
+    # ---- TeraSort (detail) ----
+    n_sort = 200_000
+    recs = terasort.gen_records(n_sort)
+    tds = ctx.from_columns(recs, str_max_len=10)
+    tq = terasort.terasort_query(tds)
+
+    def run_ts():
+        return tq.collect()
+
+    ts_s = _bench(run_ts)
+    ts_rows_per_sec_chip = n_sort / ts_s / nchips
+
+    print(json.dumps({
+        "metric": "WordCount rows/sec/chip",
+        "value": round(wc_rows_per_sec_chip, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": 1.0,
+        "details": {
+            "n_chips": nchips,
+            "wordcount_wall_s": round(wc_s, 4),
+            "wordcount_lines": n_lines,
+            "terasort_rows_per_sec_chip": round(ts_rows_per_sec_chip, 1),
+            "terasort_wall_s": round(ts_s, 4),
+            "terasort_rows": n_sort,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -153,6 +153,19 @@ class Dataset:
     def take(self, n: int) -> "Dataset":
         return Dataset(self.ctx, E.Take(parents=(self.node,), n=n))
 
+    def with_capacity(self, capacity: int) -> "Dataset":
+        """Coerce per-partition capacity (shape-stabilize do_while bodies)."""
+        return Dataset(self.ctx, E.WithCapacity(parents=(self.node,),
+                                                capacity=capacity))
+
+    def cross_apply(self, other: "Dataset", fn, host_fn=None,
+                    label: str = "cross_apply") -> "Dataset":
+        """fn(left_batch, right_batch) with ``other`` broadcast to every
+        partition; host_fn(table_l, table_r) is the oracle equivalent."""
+        return Dataset(self.ctx, E.CrossApply(
+            parents=(self.node, other.node), fn=fn, host_fn=host_fn,
+            label=label))
+
     # -- shuffling operators ----------------------------------------------
 
     def group_by(self, keys: Sequence[str],

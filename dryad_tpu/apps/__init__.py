@@ -1,0 +1,2 @@
+from dryad_tpu.apps import (groupbyreduce, kmeans, pagerank,  # noqa: F401
+                            terasort, wordcount)

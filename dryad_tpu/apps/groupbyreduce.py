@@ -1,0 +1,31 @@
+"""GroupByReduce — BASELINE.md config 3.
+
+Associative aggregation through the full IDecomposable path (reference
+IDecomposable.cs:34 + DrDynamicAggregateManager trees): per-partition
+combine, hash-exchange of partials, merge — all planned automatically by
+GroupByAgg's decomposition (plan/planner.py _decompose_aggs)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dryad_tpu.api.dataset import Context, Dataset
+
+__all__ = ["gen_pairs", "groupbyreduce_query", "groupbyreduce"]
+
+
+def gen_pairs(n: int, n_keys: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {"k": rng.randint(0, n_keys, n).astype(np.int32),
+            "v": rng.randn(n).astype(np.float32)}
+
+
+def groupbyreduce_query(ds: Dataset) -> Dataset:
+    return ds.group_by(["k"], {
+        "n": ("count", None), "s": ("sum", "v"), "m": ("mean", "v"),
+        "lo": ("min", "v"), "hi": ("max", "v")})
+
+
+def groupbyreduce(ctx: Context, n: int, n_keys: int, seed: int = 0):
+    ds = ctx.from_columns(gen_pairs(n, n_keys, seed))
+    return groupbyreduce_query(ds).collect()

@@ -1,0 +1,93 @@
+"""k-means on dense vectors — BASELINE.md config 5.
+
+The reference shape: Apply/Fork + broadcast/all-reduce ML loop.  Here the
+centroid table is broadcast (all_gather over ICI) to every partition each
+iteration, the assignment step is a [cap, k] distance matmul (MXU work), and
+the reduction is group-by mean — the IDecomposable combiner path — giving
+the broadcast + all-reduce structure natively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from dryad_tpu.api.dataset import Context, Dataset
+from dryad_tpu.data.columnar import Batch
+
+__all__ = ["gen_points", "kmeans", "kmeans_numpy"]
+
+
+def gen_points(n: int, dim: int, k: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, dim).astype(np.float32) * 5
+    assign = rng.randint(0, k, n)
+    pts = centers[assign] + rng.randn(n, dim).astype(np.float32)
+    return {"x": pts}, centers
+
+
+def _assign_fn(points: Batch, cents: Batch) -> Batch:
+    """Nearest-centroid assignment: one [cap, k] distance matrix via matmul
+    (||p-c||^2 = ||p||^2 - 2 p.c + ||c||^2; argmin ignores ||p||^2)."""
+    x = points.columns["x"]  # [cap, dim]
+    c = cents.columns["cx"]  # [kcap, dim]
+    kvalid = jnp.arange(c.shape[0]) < cents.count
+    dots = x @ c.T  # [cap, kcap] — MXU
+    c2 = jnp.sum(c * c, axis=1)
+    d = c2[None, :] - 2.0 * dots
+    d = jnp.where(kvalid[None, :], d, jnp.inf)
+    # centroid rows arrive in arbitrary (hash) order after the first
+    # iteration — map the argmin row back to its actual centroid id
+    row = jnp.argmin(d, axis=1)
+    cid = jnp.take(cents.columns["cid"], row).astype(jnp.int32)
+    return Batch({"cid": cid, "x": x}, points.count)
+
+
+def _assign_host(points: dict, cents: dict) -> dict:
+    x = np.asarray(points["x"])
+    c = np.asarray(cents["cx"])
+    d = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    cid = np.asarray(cents["cid"])[d.argmin(1)].astype(np.int32)
+    return {"cid": cid, "x": x}
+
+
+def kmeans(ctx: Context, points: dict, k: int, n_iters: int = 10,
+           init_centers: np.ndarray | None = None) -> np.ndarray:
+    dim = np.asarray(points["x"]).shape[1]
+    if init_centers is None:
+        init_centers = np.asarray(points["x"])[:k].copy()
+    pts = ctx.from_columns(points)
+    cents0 = ctx.from_columns(
+        {"cid": np.arange(k, dtype=np.int32),
+         "cx": np.asarray(init_centers, np.float32)})
+    # centroids are hash-distributed; any partition may hold several cids,
+    # so size for the worst case (k is small)
+    k_cap = k
+
+    def body(cents: Dataset) -> Dataset:
+        assigned = pts.cross_apply(cents, _assign_fn, host_fn=_assign_host,
+                                   label="assign")
+        new_cents = (assigned.group_by(["cid"], {"cx": ("mean", "x")})
+                     .with_capacity(k_cap))
+        return new_cents
+
+    out = ctx.do_while(cents0.with_capacity(k_cap), body, n_iters=n_iters)
+    t = out.collect()
+    order = np.argsort(t["cid"])
+    return np.asarray(t["cx"])[order]
+
+
+def kmeans_numpy(points: dict, k: int, n_iters: int = 10,
+                 init_centers: np.ndarray | None = None):
+    x = np.asarray(points["x"])
+    c = np.asarray(init_centers if init_centers is not None else x[:k].copy(),
+                   np.float64)
+    for _ in range(n_iters):
+        d = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        a = d.argmin(1)
+        for j in range(k):
+            sel = x[a == j]
+            if len(sel):
+                c[j] = sel.mean(0)
+    return c

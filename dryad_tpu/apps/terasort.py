@@ -1,0 +1,39 @@
+"""TeraSort — BASELINE.md config 2.
+
+The reference path: DryadLinqSampler (DryadLinqSampler.cs:42) samples keys,
+DrDynamicRangeDistributionManager picks split points, a range-partition
+shuffle redistributes, and each partition sorts locally.  Here: the planner's
+OrderBy lowering does exactly that with an all-to-all over ICI
+(plan/planner.py OrderBy; parallel/shuffle.range_exchange).
+
+TeraSort records are 10-byte keys + 90-byte payloads; we carry them as a
+string key column plus a payload column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dryad_tpu.api.dataset import Context, Dataset
+
+__all__ = ["gen_records", "terasort_query", "terasort"]
+
+
+def gen_records(n: int, seed: int = 0, key_len: int = 10):
+    """Random printable keys (TeraGen equivalent)."""
+    rng = np.random.RandomState(seed)
+    keys_arr = rng.randint(ord(" "), ord("~") + 1, (n, key_len),
+                           dtype=np.uint8)
+    keys = [bytes(k) for k in keys_arr]
+    payload = rng.randint(0, 2**31, n).astype(np.int32)
+    return {"key": keys, "payload": payload}
+
+
+def terasort_query(ds: Dataset) -> Dataset:
+    return ds.order_by([("key", False)])
+
+
+def terasort(ctx: Context, n: int, seed: int = 0):
+    recs = gen_records(n, seed)
+    ds = ctx.from_columns(recs, str_max_len=10)
+    return terasort_query(ds).collect()

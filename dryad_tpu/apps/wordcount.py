@@ -1,0 +1,28 @@
+"""WordCount — BASELINE.md config 1.
+
+The canonical reference sample (samples/WordCount.cs.pp):
+SelectMany(split) -> GroupBy(word) -> Count -> ToStore, as a dryad_tpu
+query: tokenize -> group_by count.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from dryad_tpu.api.dataset import Context, Dataset
+
+__all__ = ["wordcount_query", "wordcount"]
+
+
+def wordcount_query(ds: Dataset, column: str = "line",
+                    tokens_per_partition: int = 1 << 16,
+                    max_token_len: int = 24, lower: bool = True) -> Dataset:
+    return (ds.split_words(column, out_capacity=tokens_per_partition,
+                           max_token_len=max_token_len, lower=lower)
+              .group_by([column], {"n": ("count", None)}))
+
+
+def wordcount(ctx: Context, lines: Sequence[bytes | str],
+              max_line_len: int = 256, **kw):
+    ds = ctx.from_columns({"line": list(lines)}, str_max_len=max_line_len)
+    return wordcount_query(ds, **kw).collect()

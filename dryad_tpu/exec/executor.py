@@ -94,6 +94,15 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
         return local, no
     if k == "apply":
         return p["fn"](b), no
+    if k == "recap":
+        cap = p["capacity"]
+        if cap >= b.capacity:
+            return b.pad_to(cap), no
+        trunc = jax.tree.map(
+            lambda x: x[:cap] if x.ndim else x, b)
+        return trunc.with_count(jnp.minimum(b.count, cap)), b.count > cap
+    if k == "apply2":
+        return p["fn"](b, others[0]), no
     if k == "join":
         right = others[0]
         out, of = kernels.hash_join(
@@ -163,7 +172,7 @@ class Executor:
             cur = outs[0]
             rest = outs[1:]
             for op in stage.body:
-                if op.kind in ("join", "semi_anti", "concat"):
+                if op.kind in ("join", "semi_anti", "concat", "apply2"):
                     cur, of = _apply_op(cur, op, scale, rest)
                     rest = []
                 else:

@@ -225,9 +225,10 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
             else:
                 c = jax.ops.segment_sum(
                     jnp.ones((cap,), jnp.int32), seg, num_segments=cap)
-                out = s / jnp.maximum(c, 1).astype(s.dtype) \
+                c = jnp.maximum(c, 1).reshape((cap,) + (1,) * (s.ndim - 1))
+                out = s / c.astype(s.dtype) \
                     if jnp.issubdtype(s.dtype, jnp.floating) \
-                    else s.astype(jnp.float32) / jnp.maximum(c, 1)
+                    else s.astype(jnp.float32) / c
         elif kind == "min":
             out = jax.ops.segment_min(sb.columns[vname], seg, num_segments=cap)
         elif kind == "max":

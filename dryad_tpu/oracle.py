@@ -68,13 +68,13 @@ def _agg(kind: str, vals: List[Any]):
     if kind == "count":
         return len(vals)
     if kind == "sum":
-        return np.sum(vals)
+        return np.sum(vals, axis=0)
     if kind == "min":
-        return np.min(vals)
+        return np.min(vals, axis=0)
     if kind == "max":
-        return np.max(vals)
+        return np.max(vals, axis=0)
     if kind == "mean":
-        return float(np.mean(vals))
+        return np.mean(vals, axis=0)
     if kind == "any":
         return bool(np.any(vals))
     if kind == "all":
@@ -245,6 +245,16 @@ def run_oracle(root: E.Node, bindings: Dict[str, Table] | None = None) -> Table:
         if isinstance(n, E.Take):
             t = ev(n.parents[0])
             return _take_rows(t, range(min(n.n, _nrows(t))))
+        if isinstance(n, E.WithCapacity):
+            return ev(n.parents[0])
+        if isinstance(n, E.CrossApply):
+            if n.host_fn is None:
+                raise NotImplementedError(
+                    "cross_apply without host_fn is opaque to the oracle")
+            lt, rt = ev(n.parents[0]), ev(n.parents[1])
+            out = n.host_fn(dict(lt), dict(rt))
+            return {k: (v if isinstance(v, list) else np.asarray(v))
+                    for k, v in out.items()}
         raise TypeError(f"oracle: unhandled node {type(n).__name__}")
 
     return ev(root)

@@ -25,7 +25,7 @@ __all__ = [
     "Partitioning", "Node", "Source", "Placeholder", "Map", "Filter",
     "FlatTokens", "GroupByAgg", "Join", "OrderBy", "Distinct", "Concat",
     "HashRepartition", "RangeRepartition", "Broadcast", "ApplyPerPartition",
-    "Take", "SetOp", "walk",
+    "Take", "SetOp", "WithCapacity", "CrossApply", "walk",
 ]
 
 _ids = itertools.count()
@@ -278,6 +278,37 @@ class Broadcast(Node):
 class Take(Node):
     parents: Tuple[Node, ...]
     n: int
+
+
+@_node
+class WithCapacity(Node):
+    """Coerce per-partition capacity (pad or truncate-with-overflow-check).
+    Needed so do_while loop bodies keep shapes stable across iterations."""
+
+    parents: Tuple[Node, ...]
+    capacity: int
+
+
+@_node
+class CrossApply(Node):
+    """Binary per-partition op: fn(left_batch, right_broadcast_batch) ->
+    Batch.  The right side is replicated to every partition (small data).
+    host_fn(table_l, table_r) -> table is the oracle's interpretation.
+    Reference: the Apply overloads taking a second source
+    (DryadLinqQueryable.cs:930-1045)."""
+
+    parents: Tuple[Node, ...]  # (left, right)
+    fn: Any
+    host_fn: Any = None
+    label: str = "cross_apply"
+
+    @property
+    def npartitions(self) -> int:
+        return self.parents[0].npartitions
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning.none()
 
 
 def walk(root: Node):

@@ -69,8 +69,9 @@ def _mean_post_fn(mean_cols: List[str]):
         for m in mean_cols:
             s = out.pop(m + "__sum")
             c = out.pop(m + "__cnt")
-            cf = jnp.maximum(c, 1)
-            out[m] = s / cf if jnp.issubdtype(s.dtype, jnp.floating) \
+            cf = jnp.maximum(c, 1).reshape(c.shape + (1,) * (s.ndim - 1))
+            out[m] = s / cf.astype(s.dtype) \
+                if jnp.issubdtype(s.dtype, jnp.floating) \
                 else s.astype(jnp.float32) / cf
         return out
 
@@ -161,6 +162,23 @@ class Planner:
             f = self._frag(n.parents[0])
             f.ops.append(StageOp("take", {"n": n.n, "global": True}))
             return f
+
+        if isinstance(n, E.WithCapacity):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("recap", {"capacity": n.capacity}))
+            f.capacity = n.capacity
+            return f
+
+        if isinstance(n, E.CrossApply):
+            lf = self._frag(n.parents[0])
+            rf = self._frag(n.parents[1])
+            rex = Exchange("broadcast",
+                           out_capacity=rf.capacity * self.nparts)
+            st = self._new_stage(
+                [Leg(lf.src, lf.ops, None), Leg(rf.src, rf.ops, rex)],
+                [StageOp("apply2", {"fn": n.fn, "label": n.label})],
+                "cross_apply")
+            return Fragment(st.id, [], lf.capacity, E.Partitioning.none())
 
         if isinstance(n, E.GroupByAgg):
             f = self._frag(n.parents[0])

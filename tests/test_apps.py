@@ -1,0 +1,71 @@
+"""The five BASELINE.md configs, validated against independent references."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context
+from dryad_tpu.apps import (groupbyreduce, kmeans, pagerank, terasort,
+                            wordcount)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return Context()
+
+
+def test_wordcount(ctx):
+    rng = np.random.RandomState(0)
+    vocab = ["alpha", "beta", "Gamma", "delta", "epsilon"]
+    lines = [" ".join(rng.choice(vocab, rng.randint(1, 10)))
+             for _ in range(300)]
+    got = wordcount.wordcount(ctx, lines)
+    ref = collections.Counter(w.lower() for l in lines for w in l.split())
+    assert {k.decode(): int(v)
+            for k, v in zip(got["line"], got["n"])} == dict(ref)
+
+
+def test_terasort(ctx):
+    n = 3000
+    got = terasort.terasort(ctx, n)
+    recs = terasort.gen_records(n)
+    ref = sorted(zip(recs["key"], recs["payload"].tolist()))
+    assert got["key"] == [k for k, _ in ref]
+    assert got["payload"].tolist() == [p for _, p in ref]
+
+
+def test_groupbyreduce(ctx):
+    n, n_keys = 5000, 40
+    got = groupbyreduce.groupbyreduce(ctx, n, n_keys)
+    pairs = groupbyreduce.gen_pairs(n, n_keys)
+    groups = collections.defaultdict(list)
+    for k, v in zip(pairs["k"], pairs["v"]):
+        groups[int(k)].append(v)
+    assert len(got["k"]) == len(groups)
+    for i, k in enumerate(got["k"]):
+        vals = np.asarray(groups[int(k)])
+        assert got["n"][i] == len(vals)
+        np.testing.assert_allclose(got["s"][i], vals.sum(), rtol=2e-4)
+        np.testing.assert_allclose(got["m"][i], vals.mean(), rtol=2e-4)
+        np.testing.assert_allclose(got["lo"][i], vals.min(), rtol=1e-6)
+        np.testing.assert_allclose(got["hi"][i], vals.max(), rtol=1e-6)
+
+
+def test_pagerank(ctx):
+    n_nodes, n_edges = 64, 400
+    edges = pagerank.gen_graph(n_nodes, n_edges)
+    got = pagerank.pagerank(ctx, edges, n_nodes, n_iters=10)
+    ref = pagerank.pagerank_numpy(edges, n_nodes, n_iters=10)
+    order = np.argsort(got["node"])
+    np.testing.assert_allclose(np.asarray(got["rank"])[order], ref,
+                               rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(got["rank"]).sum(), 1.0, rtol=1e-2)
+
+
+def test_kmeans(ctx):
+    pts, true_centers = kmeans.gen_points(2000, dim=8, k=5, seed=1)
+    init = np.asarray(pts["x"])[:5].copy()
+    got = kmeans.kmeans(ctx, pts, k=5, n_iters=8, init_centers=init)
+    ref = kmeans.kmeans_numpy(pts, k=5, n_iters=8, init_centers=init)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
