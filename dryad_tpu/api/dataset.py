@@ -31,10 +31,12 @@ class Context:
     """Owns the mesh + executor and creates root Datasets."""
 
     def __init__(self, mesh=None, local_debug: bool = False,
-                 event_log: Optional[Callable[[dict], None]] = None):
+                 event_log: Optional[Callable[[dict], None]] = None,
+                 spill_dir: Optional[str] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.nparts = self.mesh.devices.size
         self.local_debug = local_debug
+        self.spill_dir = spill_dir
         self.executor = Executor(self.mesh, event_log=event_log)
 
     # -- dataset constructors ---------------------------------------------
@@ -65,6 +67,21 @@ class Context:
         with open(path, "rb") as f:
             lines = f.read().splitlines()
         return self.from_columns({column: lines}, str_max_len=max_line_len)
+
+    def from_store(self, path: str, capacity: int | None = None) -> "Dataset":
+        """Load a persisted dataset (FromStore, DryadLinqContext.cs:1176).
+        Persisted partitioning metadata is honored for shuffle elimination
+        (AssumeHashPartition parity, DryadLinqQueryable.cs:3408)."""
+        from dryad_tpu.io.store import read_store, store_meta
+        meta = store_meta(path)
+        pdata = read_store(path, self.mesh, capacity=capacity)
+        pmeta = meta.get("partitioning", {"kind": "none"})
+        part = E.Partitioning(pmeta.get("kind", "none"),
+                              tuple(pmeta.get("keys", ())))
+        # re-blocking across a different mesh size destroys hash placement
+        if meta["npartitions"] != self.nparts:
+            part = E.Partitioning.none()
+        return self.from_pdata(pdata, partitioning=part)
 
     # -- iteration ---------------------------------------------------------
 
@@ -224,7 +241,7 @@ class Dataset:
 
     def _materialize(self) -> PData:
         graph = plan_query(self.node, self.ctx.nparts)
-        return self.ctx.executor.run(graph)
+        return self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
 
     def collect(self) -> Dict[str, Any]:
         """Execute and pull all rows to host (Submit + read output)."""
@@ -235,6 +252,15 @@ class Dataset:
             n = self.node.n
             out = {k: v[:n] for k, v in out.items()}
         return out
+
+    def to_store(self, path: str) -> None:
+        """Execute and persist (ToStore + Submit,
+        DryadLinqQueryable.cs:3909,4032)."""
+        from dryad_tpu.io.store import write_store
+        pd = self._materialize()
+        part = self.node.partitioning
+        write_store(path, pd, partitioning={"kind": part.kind,
+                                            "keys": list(part.keys)})
 
     def count(self) -> int:
         if self.ctx.local_debug:

@@ -216,12 +216,12 @@ class Executor:
     # -- execution ---------------------------------------------------------
 
     def run(self, graph: StageGraph,
-            bindings: Optional[Dict[str, PData]] = None) -> PData:
-        bindings = bindings or {}
-        results: Dict[int, PData] = {}
-        for stage in graph.topo_order():
-            results[stage.id] = self._run_stage(stage, results, bindings)
-        return results[graph.out_stage]
+            bindings: Optional[Dict[str, PData]] = None,
+            spill_dir: Optional[str] = None) -> PData:
+        """Execute a graph with lineage-tracked recovery (exec.recovery.Run).
+        With spill_dir, stage outputs are durably materialized."""
+        from dryad_tpu.exec.recovery import Run
+        return Run(self, graph, bindings, spill_dir=spill_dir).output()
 
     def _leg_input(self, leg, results, bindings) -> PData:
         if isinstance(leg.src, int):

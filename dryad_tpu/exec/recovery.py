@@ -1,0 +1,113 @@
+"""Replay-based fault tolerance: lineage-tracked runs with on-demand
+recomputation and optional durable materialization.
+
+The reference's model (SURVEY.md §3.5): deterministic vertices re-execute
+from their (materialized, re-readable) inputs on failure —
+`ReactToFailedVertex` rebuilds a new execution version (DrVertex.h:184),
+bounded by a failure budget (DrFailureDictionary, DrGraph.cpp:39); durability
+comes from materialized intermediate files.
+
+Here: a ``Run`` memoizes stage outputs and records lineage (stage -> input
+stages).  Losing an output (device OOM, preemption, or test fault injection)
+just invalidates the memo entry; re-requesting it recomputes transitively
+from surviving ancestors — stages are deterministic (fixed hash constants,
+seeded sampling), so replay is exact.  With ``spill_dir`` set, every stage
+output is also persisted as a columnar store; recovery then reloads from
+disk instead of recomputing, and a NEW process can resume the run
+(checkpoint/resume, which the reference lacks — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from dryad_tpu.exec.data import PData
+from dryad_tpu.plan.stages import StageGraph
+
+__all__ = ["Run", "FailureBudgetExceeded"]
+
+
+class FailureBudgetExceeded(RuntimeError):
+    pass
+
+
+class Run:
+    """One execution of a StageGraph with lineage-based recovery."""
+
+    def __init__(self, executor, graph: StageGraph,
+                 bindings: Optional[Dict[str, PData]] = None,
+                 spill_dir: Optional[str] = None,
+                 failure_budget: int = 16):
+        self.ex = executor
+        self.graph = graph
+        self.bindings = bindings or {}
+        self.spill_dir = spill_dir
+        self.failure_budget = failure_budget
+        self.failures = 0
+        self._results: Dict[int, PData] = {}
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    # -- public ------------------------------------------------------------
+
+    def output(self) -> PData:
+        return self.result(self.graph.out_stage)
+
+    def result(self, sid: int) -> PData:
+        if sid in self._results:
+            return self._results[sid]
+        spilled = self._load_spill(sid)
+        if spilled is not None:
+            self._results[sid] = spilled
+            return spilled
+        stage = self.graph.stage(sid)
+        # ensure inputs (recursively replays lost ancestors)
+        for dep in stage.input_stage_ids():
+            self.result(dep)
+        out = self.ex._run_stage(stage, self._results, self.bindings)
+        self._results[sid] = out
+        self._save_spill(sid, out)
+        return out
+
+    def invalidate(self, sid: int, count_failure: bool = True,
+                   drop_spill: bool = False) -> None:
+        """Report a lost stage output (fault injection / preemption)."""
+        if count_failure:
+            self.failures += 1
+            self.ex._event({"event": "stage_replay", "stage": sid,
+                            "label": self.graph.stage(sid).label,
+                            "failures": self.failures})
+            if self.failures > self.failure_budget:
+                raise FailureBudgetExceeded(
+                    f"{self.failures} failures > budget "
+                    f"{self.failure_budget}")
+        self._results.pop(sid, None)
+        if drop_spill and self.spill_dir:
+            import shutil
+            p = self._spill_path(sid)
+            if os.path.exists(p):
+                shutil.rmtree(p)
+
+    # -- spill -------------------------------------------------------------
+
+    def _spill_path(self, sid: int) -> str:
+        return os.path.join(self.spill_dir, f"stage-{sid:04d}")
+
+    def _save_spill(self, sid: int, pd: PData) -> None:
+        if not self.spill_dir:
+            return
+        from dryad_tpu.io.store import write_store
+        write_store(self._spill_path(sid), pd)
+        self.ex._event({"event": "stage_spilled", "stage": sid})
+
+    def _load_spill(self, sid: int) -> Optional[PData]:
+        if not self.spill_dir:
+            return None
+        p = self._spill_path(sid)
+        if not os.path.exists(p):
+            return None
+        from dryad_tpu.io.store import read_store
+        pd = read_store(p, self.ex.mesh)
+        self.ex._event({"event": "stage_restored", "stage": sid})
+        return pd

@@ -1,0 +1,1 @@
+from dryad_tpu.io.store import read_store, store_meta, write_store  # noqa: F401

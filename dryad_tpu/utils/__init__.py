@@ -1,0 +1,1 @@
+from dryad_tpu.utils.events import EventLog, job_report  # noqa: F401

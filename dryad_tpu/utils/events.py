@@ -1,0 +1,74 @@
+"""Job event log — the Calypso reporter equivalent.
+
+The reference streams timestamped key=value vertex/process/topology events to
+``calypso.log`` on the job's DFS dir (GraphManager/reporting/
+DrCalypsoReporting.cpp:163-187, attached at LinqToDryadJM.cs:81-83), consumed
+by JobBrowser.  Here: structured JSONL with the same role — every stage
+execution, retry, replay, and spill is an event; ``job_report`` renders the
+per-stage summary (the JobBrowser per-stage table).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["EventLog", "job_report"]
+
+
+class EventLog:
+    """In-memory + optional JSONL-file event sink."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.events: List[Dict[str, Any]] = []
+        self._f = open(path, "a") if path else None
+
+    def __call__(self, event: Dict[str, Any]) -> None:
+        e = dict(event)
+        e.setdefault("ts", round(time.time(), 4))
+        self.events.append(e)
+        if self._f is not None:
+            self._f.write(json.dumps(e) + "\n")
+            self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def of_type(self, kind: str) -> List[Dict[str, Any]]:
+        return [e for e in self.events if e.get("event") == kind]
+
+
+def job_report(events) -> str:
+    """Render a per-stage execution summary from an event stream."""
+    if isinstance(events, EventLog):
+        events = events.events
+    stages: Dict[Any, Dict[str, Any]] = {}
+    order = []
+    for e in events:
+        if e.get("event") in ("stage_done", "stage_replay", "stage_retry"):
+            sid = e.get("stage")
+            if sid not in stages:
+                stages[sid] = {"label": e.get("label", "?"), "runs": 0,
+                               "retries": 0, "replays": 0, "wall_s": 0.0,
+                               "scale": 1}
+                order.append(sid)
+            s = stages[sid]
+            if e["event"] == "stage_done":
+                s["runs"] += 1
+                s["wall_s"] += e.get("wall_s", 0.0)
+                s["scale"] = max(s["scale"], e.get("scale", 1))
+                if e.get("overflow"):
+                    s["retries"] += 1
+            elif e["event"] == "stage_replay":
+                s["replays"] += 1
+    lines = [f"{'stage':>6} {'label':<16} {'runs':>4} {'retries':>7} "
+             f"{'replays':>7} {'scale':>5} {'wall_s':>8}"]
+    for sid in order:
+        s = stages[sid]
+        lines.append(f"{sid:>6} {s['label']:<16} {s['runs']:>4} "
+                     f"{s['retries']:>7} {s['replays']:>7} {s['scale']:>5} "
+                     f"{s['wall_s']:>8.3f}")
+    return "\n".join(lines)

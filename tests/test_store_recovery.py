@@ -1,0 +1,113 @@
+"""Storage roundtrip, replay recovery, spill/resume, event log tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context
+from dryad_tpu.exec.recovery import FailureBudgetExceeded, Run
+from dryad_tpu.io.store import read_store, store_meta, write_store
+from dryad_tpu.exec.data import pdata_to_host
+from dryad_tpu.plan.planner import plan_query
+from dryad_tpu.utils.events import EventLog, job_report
+from tests.utils import assert_same_rows
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return Context()
+
+
+def _mk(ctx, n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    cols = {"k": rng.randint(0, 9, n).astype(np.int32),
+            "v": rng.randn(n).astype(np.float32),
+            "s": ["id%d" % i for i in rng.randint(0, 30, n)]}
+    return ctx.from_columns(cols, capacity=64), cols
+
+
+def test_store_roundtrip(ctx, tmp_path):
+    ds, cols = _mk(ctx)
+    path = str(tmp_path / "data")
+    ds.to_store(path)
+    meta = store_meta(path)
+    assert meta["npartitions"] == ctx.nparts
+    back = ctx.from_store(path).collect()
+    exp = {k: ([s.encode() for s in v] if isinstance(v, list)
+               else np.asarray(v)) for k, v in cols.items()}
+    assert_same_rows(back, exp)
+
+
+def test_store_preserves_partitioning(ctx, tmp_path):
+    ds, _ = _mk(ctx)
+    path = str(tmp_path / "hashed")
+    ds.hash_partition(["k"]).to_store(path)
+    assert store_meta(path)["partitioning"] == {"kind": "hash", "keys": ["k"]}
+    loaded = ctx.from_store(path)
+    # shuffle elimination: group on same keys needs no hash exchange
+    plan = loaded.group_by(["k"], {"n": ("count", None)}).explain()
+    assert "=>hash" not in plan
+
+
+def test_replay_recovery(ctx):
+    ds, cols = _mk(ctx)
+    q = (ds.where(lambda c: c["v"] > 0)
+           .group_by(["k"], {"n": ("count", None)}))
+    graph = plan_query(q.node, ctx.nparts)
+    run = Run(ctx.executor, graph)
+    out1 = pdata_to_host(run.output())
+    # lose an intermediate AND the output; recompute transitively
+    for sid in list(run._results.keys()):
+        run.invalidate(sid)
+    out2 = pdata_to_host(run.output())
+    assert_same_rows(out2, out1)
+
+
+def test_failure_budget(ctx):
+    ds, _ = _mk(ctx)
+    graph = plan_query(
+        ds.group_by(["k"], {"n": ("count", None)}).node, ctx.nparts)
+    run = Run(ctx.executor, graph, failure_budget=2)
+    run.output()
+    with pytest.raises(FailureBudgetExceeded):
+        for _ in range(4):
+            run.invalidate(graph.out_stage)
+            run.output()
+
+
+def test_spill_and_resume(ctx, tmp_path):
+    """A fresh Run (new process equivalent) resumes from spilled stages."""
+    ds, _ = _mk(ctx)
+    q = ds.group_by(["k"], {"n": ("count", None)})
+    graph = plan_query(q.node, ctx.nparts)
+    spill = str(tmp_path / "spill")
+    run1 = Run(ctx.executor, graph, spill_dir=spill)
+    out1 = pdata_to_host(run1.output())
+    assert os.path.exists(os.path.join(spill, "stage-0000"))
+    # resume: new Run with same graph + spill dir loads, not recomputes
+    log = EventLog()
+    old_event = ctx.executor._event
+    ctx.executor._event = log
+    try:
+        run2 = Run(ctx.executor, graph, spill_dir=spill)
+        out2 = pdata_to_host(run2.output())
+    finally:
+        ctx.executor._event = old_event
+    assert_same_rows(out2, out1)
+    assert len(log.of_type("stage_restored")) >= 1
+    assert len(log.of_type("stage_done")) == 0  # nothing recomputed
+
+
+def test_event_log_and_report(tmp_path):
+    log = EventLog(str(tmp_path / "calypso.jsonl"))
+    c2 = Context(event_log=log)
+    ds, _ = _mk(c2)
+    ds.group_by(["k"], {"n": ("count", None)}).collect()
+    assert len(log.of_type("stage_done")) >= 1
+    rep = job_report(log)
+    assert "groupby" in rep
+    # JSONL file written
+    with open(tmp_path / "calypso.jsonl") as f:
+        lines = f.read().splitlines()
+    assert len(lines) == len(log.events)
