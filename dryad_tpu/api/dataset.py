@@ -63,10 +63,18 @@ class Context:
     def read_text(self, path: str, column: str = "line",
                   max_line_len: int = 256) -> "Dataset":
         """Read a text file as one record per line (FromStore for LineRecord,
-        DryadLinqContext.cs:1176 + LineRecord.cs)."""
+        DryadLinqContext.cs:1176 + LineRecord.cs).  Line splitting + padding
+        runs in the native IO engine when built."""
+        from dryad_tpu import native
+        from dryad_tpu.exec.data import pdata_from_packed_strings
         with open(path, "rb") as f:
-            lines = f.read().splitlines()
-        return self.from_columns({column: lines}, str_max_len=max_line_len)
+            buf = f.read()
+        data, lens = native.pack_lines(buf, max_line_len)
+        pdata = pdata_from_packed_strings(data, lens, self.mesh,
+                                          column=column)
+        host = {column: [bytes(r[:l]) for r, l in
+                         zip(data, lens)]} if self.local_debug else None
+        return self.from_pdata(pdata, host=host)
 
     def from_store(self, path: str, capacity: int | None = None) -> "Dataset":
         """Load a persisted dataset (FromStore, DryadLinqContext.cs:1176).

@@ -81,11 +81,17 @@ def pdata_from_host(columns: Mapping[str, Any], mesh, nparts: int | None = None,
     for k, v in columns.items():
         if isinstance(v, (list, tuple)) and (
                 n == 0 or isinstance(v[0], (str, bytes))):
-            parts = [string_column_from_list(list(v[s:e]), cap, str_max_len)
-                     for s, e in slices]
-            data = np.stack([np.asarray(p.data) for p in parts])
-            lens = np.stack([np.asarray(p.lengths) for p in parts])
-            cols[k] = StringColumn(jnp.asarray(data), jnp.asarray(lens))
+            from dryad_tpu import native
+            items = [x.encode() if isinstance(x, str) else bytes(x)
+                     for x in v]
+            data, lens = native.pack_bytes_list(items, str_max_len,
+                                                max(n, 1))
+            sd = np.zeros((nparts, cap, str_max_len), np.uint8)
+            sl = np.zeros((nparts, cap), np.int32)
+            for p, (s, e) in enumerate(slices):
+                sd[p, : e - s] = data[s:e]
+                sl[p, : e - s] = lens[s:e]
+            cols[k] = StringColumn(jnp.asarray(sd), jnp.asarray(sl))
         else:
             arr = np.asarray(v)
             stacked = np.zeros((nparts, cap) + arr.shape[1:], arr.dtype)
@@ -94,6 +100,31 @@ def pdata_from_host(columns: Mapping[str, Any], mesh, nparts: int | None = None,
             cols[k] = jnp.asarray(stacked)
     counts = jnp.asarray([e - s for s, e in slices], jnp.int32)
     batch = Batch(cols, counts)
+    sharding = batch_sharding(mesh)
+    batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    return PData(batch, nparts)
+
+
+def pdata_from_packed_strings(data: np.ndarray, lens: np.ndarray, mesh,
+                              column: str = "line",
+                              nparts: int | None = None,
+                              capacity: int | None = None) -> PData:
+    """Build sharded PData from an already-packed [n, max_len] byte matrix
+    (native.pack_lines output) without any per-row Python work."""
+    nparts = nparts or mesh.devices.size
+    n, max_len = data.shape
+    slices = _block_slices(n, nparts)
+    max_block = max(1, max(e - s for s, e in slices))
+    cap = capacity or max_block
+    if cap < max_block:
+        raise ValueError(f"capacity {cap} < max block {max_block}")
+    sd = np.zeros((nparts, cap, max_len), np.uint8)
+    sl = np.zeros((nparts, cap), np.int32)
+    for p, (s, e) in enumerate(slices):
+        sd[p, : e - s] = data[s:e]
+        sl[p, : e - s] = lens[s:e]
+    batch = Batch({column: StringColumn(jnp.asarray(sd), jnp.asarray(sl))},
+                  jnp.asarray([e - s for s, e in slices], jnp.int32))
     sharding = batch_sharding(mesh)
     batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
     return PData(batch, nparts)

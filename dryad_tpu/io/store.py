@@ -3,45 +3,67 @@
 The counterpart of the reference's dataset layer: URI-scheme data providers
 (LinqToDryad/DataProvider.cs, DataPath.cs:124), partitioned files
 (GraphManager/filesystem/DrPartitionFile.cpp), and dataset metadata
-(DryadLinqMetaData.cs — record type + compression per stream).
+(DryadLinqMetaData.cs).
 
 Layout (one directory per dataset):
-    meta.json                 — schema, npartitions, counts, partitioning
-    part-00000/<column>.npy   — one .npy per column (strings: data + lengths)
+    meta.json        — schema, npartitions, counts, partitioning, version
+    part-00000.bin   — all columns of partition 0, concatenated row-major
+                       in sorted-column order (strings: data then lengths)
 
-.npy files are directly memory-mappable for the out-of-core path; the native
-C++ IO engine (dryad_tpu/native) accelerates bulk load/save when built.
+Partition files are written/read by the native parallel scatter-gather IO
+engine (native/dryad_io.cpp via dryad_tpu.native) — partitions move in
+parallel on a worker pool, the role of the reference's per-channel async
+buffer queues (channelbufferqueue.cpp) — with a pure-Python fallback.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
+from dryad_tpu import native
 from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.exec.data import PData
 from dryad_tpu.parallel.mesh import batch_sharding
-import jax
 
 __all__ = ["write_store", "read_store", "store_meta"]
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
 
 
-def _part_dir(path: str, p: int) -> str:
-    return os.path.join(path, f"part-{p:05d}")
+def _part_path(path: str, p: int) -> str:
+    return os.path.join(path, f"part-{p:05d}.bin")
+
+
+def _col_order(schema: Dict[str, Any]) -> List[str]:
+    return sorted(schema.keys())
+
+
+def _part_segments_for_write(batch: Batch, schema, p: int, n: int
+                             ) -> List[np.ndarray]:
+    """Column blobs of partition p, valid rows only, in sorted-column order."""
+    segs: List[np.ndarray] = []
+    for k in _col_order(schema):
+        v = batch.columns[k]
+        if isinstance(v, StringColumn):
+            segs.append(np.ascontiguousarray(np.asarray(v.data[p])[:n]))
+            segs.append(np.ascontiguousarray(np.asarray(v.lengths[p])[:n]))
+        else:
+            segs.append(np.ascontiguousarray(np.asarray(v[p])[:n]))
+    return segs
 
 
 def write_store(path: str, pd: PData,
                 partitioning: Optional[Dict[str, Any]] = None) -> None:
-    """Persist a PData (ToStore, DryadLinqQueryable.cs:3909).  Writes are
-    atomic per dataset: data lands in a temp dir renamed into place (the
-    reference commits temp outputs at job end, DrVertex.h:325-351)."""
+    """Persist a PData (ToStore, DryadLinqQueryable.cs:3909).  Atomic via
+    temp-dir rename (the reference commits temp outputs at job end,
+    DrVertex.h:325-351)."""
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     counts = np.asarray(pd.counts)
@@ -50,21 +72,15 @@ def write_store(path: str, pd: PData,
         if isinstance(v, StringColumn):
             schema[k] = {"kind": "str", "max_len": int(v.data.shape[2])}
         else:
-            arr = np.asarray(v)
-            schema[k] = {"kind": "dense", "dtype": str(arr.dtype),
-                         "shape": list(arr.shape[2:])}
+            arr_dtype = np.dtype(str(np.asarray(v[0, :1]).dtype))
+            schema[k] = {"kind": "dense", "dtype": arr_dtype.name,
+                         "shape": list(v.shape[2:])}
+    paths, segments = [], []
     for p in range(pd.nparts):
-        d = _part_dir(tmp, p)
-        os.makedirs(d, exist_ok=True)
-        n = int(counts[p])
-        for k, v in pd.batch.columns.items():
-            if isinstance(v, StringColumn):
-                np.save(os.path.join(d, f"{k}.data.npy"),
-                        np.asarray(v.data[p])[:n])
-                np.save(os.path.join(d, f"{k}.len.npy"),
-                        np.asarray(v.lengths[p])[:n])
-            else:
-                np.save(os.path.join(d, f"{k}.npy"), np.asarray(v[p])[:n])
+        paths.append(_part_path(tmp, p))
+        segments.append(_part_segments_for_write(
+            pd.batch, schema, p, int(counts[p])))
+    native.write_files(paths, segments)
     meta = {
         "format_version": _FORMAT_VERSION,
         "npartitions": pd.nparts,
@@ -72,6 +88,7 @@ def write_store(path: str, pd: PData,
         "capacity": pd.capacity,
         "schema": schema,
         "partitioning": partitioning or {"kind": "none"},
+        "native_io": native.available(),
     }
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
@@ -86,36 +103,56 @@ def store_meta(path: str) -> Dict[str, Any]:
         return json.load(f)
 
 
-def read_store(path: str, mesh, capacity: Optional[int] = None,
-               mmap: bool = True) -> PData:
+def _alloc_part_views(schema, n: int) -> Tuple[List[np.ndarray],
+                                               Dict[str, Any]]:
+    """Allocate per-column arrays for one partition's n valid rows, in file
+    order; return (ordered segment list, name -> array(s) map)."""
+    segs: List[np.ndarray] = []
+    cols: Dict[str, Any] = {}
+    for k in _col_order(schema):
+        spec = schema[k]
+        if spec["kind"] == "str":
+            d = np.empty((n, spec["max_len"]), np.uint8)
+            l = np.empty((n,), np.int32)
+            segs.extend([d, l])
+            cols[k] = ("str", d, l, spec["max_len"])
+        else:
+            a = np.empty((n,) + tuple(spec["shape"]),
+                         np.dtype(spec["dtype"]))
+            segs.append(a)
+            cols[k] = ("dense", a)
+    return segs, cols
+
+
+def read_store(path: str, mesh, capacity: Optional[int] = None) -> PData:
     """Load a dataset store as sharded PData (FromStore,
     DryadLinqContext.cs:1176).  If the store's partition count differs from
-    the mesh size, rows are re-blocked across the mesh partitions."""
+    the mesh size, rows are re-blocked across mesh partitions."""
     meta = store_meta(path)
     nparts_store = meta["npartitions"]
     counts = meta["counts"]
     schema = meta["schema"]
     nparts = mesh.devices.size
-    mmap_mode = "r" if mmap else None
 
-    # load per-column concatenated host arrays (valid rows only)
+    paths, segments, partviews = [], [], []
+    for p in range(nparts_store):
+        segs, cols = _alloc_part_views(schema, counts[p])
+        paths.append(_part_path(path, p))
+        segments.append(segs)
+        partviews.append(cols)
+    native.read_files(paths, segments)
+
+    # concatenate store partitions then re-block over the mesh
     host_cols: Dict[str, Any] = {}
-    for k, spec in schema.items():
-        if spec["kind"] == "str":
-            datas, lens = [], []
-            for p in range(nparts_store):
-                d = _part_dir(path, p)
-                datas.append(np.load(os.path.join(d, f"{k}.data.npy"),
-                                     mmap_mode=mmap_mode))
-                lens.append(np.load(os.path.join(d, f"{k}.len.npy"),
-                                    mmap_mode=mmap_mode))
-            host_cols[k] = ("str", np.concatenate(datas, axis=0),
-                            np.concatenate(lens, axis=0), spec["max_len"])
+    for k in schema:
+        if schema[k]["kind"] == "str":
+            host_cols[k] = ("str",
+                            np.concatenate([pv[k][1] for pv in partviews]),
+                            np.concatenate([pv[k][2] for pv in partviews]),
+                            schema[k]["max_len"])
         else:
-            arrs = [np.load(os.path.join(_part_dir(path, p), f"{k}.npy"),
-                            mmap_mode=mmap_mode)
-                    for p in range(nparts_store)]
-            host_cols[k] = ("dense", np.concatenate(arrs, axis=0))
+            host_cols[k] = ("dense",
+                            np.concatenate([pv[k][1] for pv in partviews]))
 
     total = sum(counts)
     base, rem = divmod(total, nparts)

@@ -1,0 +1,195 @@
+"""ctypes bindings for the native IO engine (native/dryad_io.cpp).
+
+Builds on first use (g++ via make) and degrades gracefully to pure-Python
+fallbacks when no toolchain is available — `available()` reports which path
+is active.  pybind11 is not in this environment, so the binding layer is
+ctypes over a plain C ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_SO = os.path.join(_NATIVE_DIR, "libdryad_io.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                               check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.dryad_pack_lines.restype = ctypes.c_int64
+        lib.dryad_pack_lines.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.dryad_pack_bytes.restype = ctypes.c_int64
+        lib.dryad_pack_bytes.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.dryad_file_jobs.restype = ctypes.c_int64
+        lib.dryad_file_jobs.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        lib.dryad_fingerprint.restype = ctypes.c_uint64
+        lib.dryad_fingerprint.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# record packing
+
+
+def pack_lines(buf: bytes, max_len: int,
+               capacity: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Split a newline-delimited buffer into (data [n, max_len] u8,
+    lengths [n] i32).  Native when built; numpy fallback otherwise."""
+    lib = _load()
+    if lib is not None:
+        cap = capacity or (buf.count(b"\n") + 2)
+        data = np.zeros((cap, max_len), np.uint8)
+        lens = np.zeros((cap,), np.int32)
+        src = np.frombuffer(buf, np.uint8)
+        n = lib.dryad_pack_lines(
+            src.ctypes.data_as(ctypes.c_void_p), len(buf), max_len,
+            data.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.c_void_p), cap)
+        if n < 0:
+            raise ValueError("pack_lines capacity exceeded")
+        return data[:n], lens[:n]
+    lines = buf.splitlines()
+    n = len(lines)
+    data = np.zeros((n, max_len), np.uint8)
+    lens = np.zeros((n,), np.int32)
+    for i, l in enumerate(lines):
+        l = l[:max_len]
+        data[i, : len(l)] = np.frombuffer(l, np.uint8)
+        lens[i] = len(l)
+    return data, lens
+
+
+def pack_bytes_list(items: Sequence[bytes], max_len: int, capacity: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack a list of bytes into padded (data [capacity, max_len], lens)."""
+    n = len(items)
+    if n > capacity:
+        raise ValueError(f"{n} items > capacity {capacity}")
+    data = np.zeros((capacity, max_len), np.uint8)
+    lens = np.zeros((capacity,), np.int32)
+    lib = _load()
+    if lib is not None and n > 0:
+        ptrs = (ctypes.c_void_p * n)()
+        lens64 = np.empty((n,), np.int64)
+        # keep refs alive
+        bufs = [i if isinstance(i, bytes) else bytes(i) for i in items]
+        for i, b in enumerate(bufs):
+            ptrs[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+            lens64[i] = len(b)
+        rc = lib.dryad_pack_bytes(
+            ptrs, lens64.ctypes.data_as(ctypes.c_void_p), n, max_len,
+            data.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.c_void_p), capacity)
+        if rc < 0:
+            raise ValueError("pack_bytes capacity exceeded")
+        return data, lens
+    for i, b in enumerate(items):
+        b = (b if isinstance(b, bytes) else bytes(b))[:max_len]
+        data[i, : len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+    return data, lens
+
+
+# ---------------------------------------------------------------------------
+# parallel scatter-gather file IO
+
+
+def _file_jobs(paths: List[str], segments: List[List[np.ndarray]],
+               write: bool, nthreads: int = 8) -> None:
+    n = len(paths)
+    if n == 0:
+        return
+    lib = _load()
+    if lib is None:
+        for p, segs in zip(paths, segments):
+            if write:
+                with open(p, "wb") as f:
+                    for s in segs:
+                        f.write(memoryview(np.ascontiguousarray(s)).cast("B"))
+            else:
+                with open(p, "rb") as f:
+                    for s in segs:
+                        f.readinto(memoryview(s).cast("B"))
+        return
+    flat_ptrs, flat_lens, offsets = [], [], [0]
+    keep = []
+    for segs in segments:
+        for s in segs:
+            s = np.ascontiguousarray(s)
+            keep.append(s)
+            flat_ptrs.append(s.ctypes.data)
+            flat_lens.append(s.nbytes)
+        offsets.append(len(flat_ptrs))
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    nseg = len(flat_ptrs)
+    c_ptrs = (ctypes.c_void_p * nseg)(*flat_ptrs)
+    lens_arr = np.asarray(flat_lens, np.int64)
+    offs_arr = np.asarray(offsets, np.int64)
+    rc = lib.dryad_file_jobs(
+        c_paths, n, c_ptrs, lens_arr.ctypes.data_as(ctypes.c_void_p),
+        offs_arr.ctypes.data_as(ctypes.c_void_p),
+        1 if write else 0, nthreads)
+    if rc != 0:
+        raise IOError(f"native file job failed: {paths[int(rc) - 1]}")
+
+
+def write_files(paths: List[str], segments: List[List[np.ndarray]],
+                nthreads: int = 8) -> None:
+    _file_jobs(paths, segments, write=True, nthreads=nthreads)
+
+
+def read_files(paths: List[str], segments: List[List[np.ndarray]],
+               nthreads: int = 8) -> None:
+    """Read each file's bytes contiguously into the given (preallocated,
+    writable) arrays."""
+    _file_jobs(paths, segments, write=False, nthreads=nthreads)
+
+
+def fingerprint(buf) -> int:
+    lib = _load()
+    arr = np.ascontiguousarray(np.frombuffer(buf, np.uint8) if
+                               isinstance(buf, (bytes, bytearray)) else buf)
+    if lib is None:
+        import zlib
+        return zlib.crc32(arr.tobytes())
+    return int(lib.dryad_fingerprint(
+        arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes))

@@ -1,0 +1,155 @@
+// dryad_io — native host-side IO engine for dryad_tpu.
+//
+// TPU-native counterpart of the reference's native channel/buffer layer
+// (reference DryadVertex/VertexHost: channelbuffernativereader.cpp /
+// channelbuffernativewriter.cpp — double-buffered async file IO on an IO
+// completion port (dryadnativeport.cpp:345-391) — and the DrMemoryStream
+// growable buffer streams).  On a TPU host the data plane's hot host-side
+// work is (a) packing variable-length records into fixed-shape tensors and
+// (b) bulk scatter-gather file IO for spill/store; both are implemented
+// here natively with a worker-thread pool, called from Python via ctypes
+// (no pybind11 in this environment).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Record packing: newline-delimited text -> padded [cap, max_len] u8 matrix
+// + lengths.  (The vectorized-ingest role of the reference's
+// DryadLinqTextReader / LineRecord byte-stream parsing.)
+//
+// Returns number of lines packed, or -1 if cap was exceeded (caller
+// re-sizes).  Lines longer than max_len are truncated (semantic match with
+// StringColumn).  A trailing line without '\n' counts.
+int64_t dryad_pack_lines(const uint8_t* buf, int64_t len, int64_t max_len,
+                         uint8_t* out_data, int32_t* out_lens, int64_t cap) {
+  int64_t n = 0;
+  int64_t start = 0;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || buf[i] == '\n') {
+      if (i == len && i == start) break;  // no trailing empty line
+      int64_t l = i - start;
+      if (l > 0 && buf[start + l - 1] == '\r') --l;  // CRLF
+      if (n >= cap) return -1;
+      int64_t keep = l < max_len ? l : max_len;
+      std::memcpy(out_data + n * max_len, buf + start, (size_t)keep);
+      if (keep < max_len)
+        std::memset(out_data + n * max_len + keep, 0, (size_t)(max_len - keep));
+      out_lens[n] = (int32_t)keep;
+      ++n;
+      start = i + 1;
+    }
+  }
+  return n;
+}
+
+// Pack a list of byte strings (ptrs+lens) into a padded matrix.
+// Returns n on success, -1 on cap overflow.
+int64_t dryad_pack_bytes(const uint8_t** ptrs, const int64_t* lens, int64_t n,
+                         int64_t max_len, uint8_t* out_data,
+                         int32_t* out_lens, int64_t cap) {
+  if (n > cap) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t keep = lens[i] < max_len ? lens[i] : max_len;
+    std::memcpy(out_data + i * max_len, ptrs[i], (size_t)keep);
+    if (keep < max_len)
+      std::memset(out_data + i * max_len + keep, 0, (size_t)(max_len - keep));
+    out_lens[i] = (int32_t)keep;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// Parallel scatter-gather file IO (the spill/store engine).
+//
+// Each "file job" is a path plus a list of (ptr, len) segments written (or
+// read) contiguously.  Jobs fan out over a thread pool — partitions spill
+// in parallel, matching the reference's per-channel async buffer queues
+// (channelbufferqueue.cpp) in role.
+
+struct Seg { const uint8_t* ptr; int64_t len; };
+
+static int write_one(const char* path, const Seg* segs, int64_t nsegs) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  setvbuf(f, nullptr, _IOFBF, 1 << 20);
+  for (int64_t s = 0; s < nsegs; ++s) {
+    if (segs[s].len == 0) continue;
+    if (std::fwrite(segs[s].ptr, 1, (size_t)segs[s].len, f) !=
+        (size_t)segs[s].len) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  if (std::fclose(f) != 0) return -1;
+  return 0;
+}
+
+static int read_one(const char* path, const Seg* segs, int64_t nsegs) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  setvbuf(f, nullptr, _IOFBF, 1 << 20);
+  for (int64_t s = 0; s < nsegs; ++s) {
+    if (segs[s].len == 0) continue;
+    if (std::fread((void*)segs[s].ptr, 1, (size_t)segs[s].len, f) !=
+        (size_t)segs[s].len) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+// paths: array of n C strings; seg_offsets: n+1 prefix offsets into the
+// flat segs arrays.  write=1 writes, 0 reads.  Returns 0 on success, else
+// the (1-based) index of the first failed job.
+int64_t dryad_file_jobs(const char** paths, int64_t n,
+                        const uint8_t** seg_ptrs, const int64_t* seg_lens,
+                        const int64_t* seg_offsets, int32_t write,
+                        int32_t nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > 64) nthreads = 64;
+  std::atomic<int64_t> next(0), failed(0);
+  auto worker = [&]() {
+    while (true) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n || failed.load() != 0) break;
+      int64_t s0 = seg_offsets[i], s1 = seg_offsets[i + 1];
+      std::vector<Seg> segs;
+      segs.reserve((size_t)(s1 - s0));
+      for (int64_t s = s0; s < s1; ++s)
+        segs.push_back(Seg{seg_ptrs[s], seg_lens[s]});
+      int rc = write ? write_one(paths[i], segs.data(), (int64_t)segs.size())
+                     : read_one(paths[i], segs.data(), (int64_t)segs.size());
+      if (rc != 0) failed.store(i + 1);
+    }
+  };
+  std::vector<std::thread> pool;
+  int nt = (int)(nthreads < n ? nthreads : n);
+  for (int t = 0; t < nt; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+  return failed.load();
+}
+
+// ---------------------------------------------------------------------------
+// 64-bit FNV-1a (host-side content fingerprinting for store integrity —
+// the role of the reference's Rabin fingerprints, classlib fingerprint.cpp).
+uint64_t dryad_fingerprint(const uint8_t* buf, int64_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= buf[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+}  // extern "C"

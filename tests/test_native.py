@@ -1,0 +1,82 @@
+"""Native IO engine tests (build + pack + parallel file IO + store v2)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu import native
+
+
+def test_native_builds():
+    assert native.available(), "native engine failed to build"
+
+
+def test_pack_lines_matches_python():
+    buf = b"hello world\nsecond line\r\nthird\n\nlast-no-newline"
+    data, lens = native.pack_lines(buf, max_len=16)
+    expect = [b"hello world", b"second line", b"third", b"", b"last-no-newline"]
+    assert len(data) == len(expect)
+    for i, e in enumerate(expect):
+        assert bytes(data[i][: lens[i]]) == e
+
+
+def test_pack_lines_truncation():
+    data, lens = native.pack_lines(b"abcdefghij\nxy", max_len=4)
+    assert bytes(data[0][: lens[0]]) == b"abcd"
+    assert bytes(data[1][: lens[1]]) == b"xy"
+
+
+def test_pack_bytes_list():
+    items = [b"aa", b"", b"cccc", b"longer-than-max"]
+    data, lens = native.pack_bytes_list(items, max_len=8, capacity=8)
+    assert bytes(data[0][:2]) == b"aa"
+    assert lens[1] == 0
+    assert bytes(data[3][: lens[3]]) == b"longer-t"
+
+
+def test_parallel_file_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    paths, segs = [], []
+    arrays = []
+    for i in range(6):
+        a = rng.randint(0, 255, (100 + i, 8), dtype=np.uint8)
+        b = rng.randn(50 + i).astype(np.float32)
+        paths.append(str(tmp_path / f"f{i}.bin"))
+        segs.append([a, b])
+        arrays.append((a, b))
+    native.write_files(paths, segs)
+    out_segs = []
+    for i in range(6):
+        out_segs.append([np.empty_like(arrays[i][0]),
+                         np.empty_like(arrays[i][1])])
+    native.read_files(paths, out_segs)
+    for (a, b), (a2, b2) in zip(arrays, out_segs):
+        np.testing.assert_array_equal(a, a2)
+        np.testing.assert_array_equal(b, b2)
+
+
+def test_read_missing_file_raises(tmp_path):
+    with pytest.raises(IOError):
+        native.read_files([str(tmp_path / "nope.bin")],
+                          [[np.empty(4, np.uint8)]])
+
+
+def test_fingerprint_stable():
+    a = native.fingerprint(b"hello")
+    assert a == native.fingerprint(b"hello")
+    assert a != native.fingerprint(b"hellp")
+
+
+def test_read_text_native(tmp_path):
+    from dryad_tpu import Context
+    p = tmp_path / "t.txt"
+    p.write_bytes(b"the quick fox\njumps over\nthe lazy dog\n" * 50)
+    ctx = Context()
+    out = (ctx.read_text(str(p))
+           .split_words("line", out_capacity=4096)
+           .group_by(["line"], {"n": ("count", None)})
+           .collect())
+    got = {k.decode(): int(v) for k, v in zip(out["line"], out["n"])}
+    assert got == {"the": 100, "quick": 50, "fox": 50, "jumps": 50,
+                   "over": 50, "lazy": 50, "dog": 50}
