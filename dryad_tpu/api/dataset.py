@@ -167,13 +167,74 @@ class Dataset:
             max_token_len=max_token_len, delims=delims, lower=lower))
 
     def apply_per_partition(self, fn, label: str = "apply",
-                            preserves_partitioning: bool = False) -> "Dataset":
+                            preserves_partitioning: bool = False,
+                            host_fn=None) -> "Dataset":
         """Arbitrary Batch -> Batch function per partition
-        (ApplyPerPartition, DryadLinqQueryable.cs:1084).  Not supported in
-        local_debug (opaque to the oracle)."""
+        (ApplyPerPartition, DryadLinqQueryable.cs:1084).  Provide host_fn
+        (table -> table) to make it interpretable by the oracle."""
         return Dataset(self.ctx, E.ApplyPerPartition(
             parents=(self.node,), fn=fn, label=label,
-            preserves_partitioning=preserves_partitioning))
+            preserves_partitioning=preserves_partitioning, host_fn=host_fn))
+
+    def apply_with_partition_index(self, fn, label: str = "apply_idx"
+                                   ) -> "Dataset":
+        """fn(batch, partition_index) -> Batch (ApplyWithPartitionIndex,
+        DryadLinqQueryable.cs:1356)."""
+        return Dataset(self.ctx, E.ApplyPerPartition(
+            parents=(self.node,), fn=fn, label=label, with_index=True))
+
+    def flat_map(self, fn, out_capacity: int,
+                 label: str = "flat_map") -> "Dataset":
+        """Generic SelectMany: fn(cols) -> (out_cols [cap, m, ...],
+        mask [cap, m]); flattened row-major."""
+        return Dataset(self.ctx, E.FlatMap(
+            parents=(self.node,), fn=fn, out_capacity=out_capacity,
+            label=label))
+
+    def zip_with(self, other: "Dataset", suffix: str = "_r") -> "Dataset":
+        """Positional pairing (Zip); requires aligned row placement."""
+        return Dataset(self.ctx, E.Zip(parents=(self.node, other.node),
+                                       suffix=suffix))
+
+    def sliding_window(self, w: int) -> "Dataset":
+        """Windows of w consecutive rows (SlidingWindow,
+        DryadLinqQueryable.cs:1318); columns gain a window axis."""
+        return Dataset(self.ctx, E.SlidingWindow(parents=(self.node,), w=w))
+
+    def with_row_index(self, column: str = "row_index") -> "Dataset":
+        """Add a global row-index column (Long*/indexed operator parity)."""
+        return Dataset(self.ctx, E.WithRowIndex(parents=(self.node,),
+                                                column=column))
+
+    def skip(self, n: int) -> "Dataset":
+        return Dataset(self.ctx, E.SkipTake(parents=(self.node,), op="skip",
+                                            n=n))
+
+    def take_while(self, fn) -> "Dataset":
+        return Dataset(self.ctx, E.SkipTake(parents=(self.node,),
+                                            op="take_while", fn=fn))
+
+    def skip_while(self, fn) -> "Dataset":
+        return Dataset(self.ctx, E.SkipTake(parents=(self.node,),
+                                            op="skip_while", fn=fn))
+
+    def fork_by(self, fn) -> Tuple["Dataset", "Dataset"]:
+        """Split one scan into (matching, non-matching) branches (Fork,
+        DryadLinqQueryable.cs:3717); the shared parent is materialized once
+        (Tee)."""
+        t = self.where(fn, label="fork_t")
+        f = self.where(lambda c, _fn=fn: ~_fn(c), label="fork_f")
+        return t, f
+
+    def assume_hash_partition(self, keys: Sequence[str]) -> "Dataset":
+        """Declare existing hash placement (AssumeHashPartition,
+        DryadLinqQueryable.cs:3408) — skips the shuffle for matching keys."""
+        return Dataset(self.ctx, E.AssumePartitioning(
+            parents=(self.node,), kind="hash", keys=tuple(keys)))
+
+    def assume_range_partition(self, keys: Sequence[str]) -> "Dataset":
+        return Dataset(self.ctx, E.AssumePartitioning(
+            parents=(self.node,), kind="range", keys=tuple(keys)))
 
     def take(self, n: int) -> "Dataset":
         return Dataset(self.ctx, E.Take(parents=(self.node,), n=n))
@@ -277,6 +338,71 @@ class Dataset:
                 return len(v)
             return 0
         return self._materialize().total_rows()
+
+    def _scalar(self, kind: str, column: str):
+        """Terminal scalar aggregate (Count/Sum/Min/Max/Average/Any/All,
+        DryadLinqQueryable.cs *AsQuery aggregates): per-partition partials
+        on device, combined host-side."""
+        import numpy as np
+
+        from dryad_tpu import oracle as orc
+        if self.ctx.local_debug:
+            t = _oracle.run_oracle(self.node)
+            return orc._agg(kind, list(t[column]))
+        pd = self._materialize()
+        import jax
+        import jax.numpy as jnp
+
+        from dryad_tpu.ops.kernels import scalar_aggregate
+
+        @jax.jit
+        def partials(batch):
+            return jax.vmap(lambda b: scalar_aggregate(
+                b, {"out": (kind, column), "cnt": ("count", None)}))(batch)
+
+        out = partials(pd.batch)
+        vals = np.asarray(out["out"])
+        cnts = np.asarray(out["cnt"])
+        nonempty = cnts > 0
+        if kind == "sum":
+            return vals.sum(axis=0)
+        if kind == "min":
+            return vals[nonempty].min(axis=0) if nonempty.any() else None
+        if kind == "max":
+            return vals[nonempty].max(axis=0) if nonempty.any() else None
+        if kind == "mean":
+            total = cnts.sum()
+            if total == 0:
+                return None
+            w = (vals.T * cnts).T.sum(axis=0) / total
+            return w
+        if kind == "any":
+            return bool(vals[nonempty].any())
+        if kind == "all":
+            return bool(vals[nonempty].all()) if nonempty.any() else True
+        raise ValueError(kind)
+
+    def sum(self, column: str):
+        return self._scalar("sum", column)
+
+    def min(self, column: str):
+        return self._scalar("min", column)
+
+    def max(self, column: str):
+        return self._scalar("max", column)
+
+    def mean(self, column: str):
+        return self._scalar("mean", column)
+
+    def any(self, column: str) -> bool:
+        return self._scalar("any", column)
+
+    def all(self, column: str) -> bool:
+        return self._scalar("all", column)
+
+    def first(self) -> Dict[str, Any]:
+        t = self.take(1).collect()
+        return {k: v[0] for k, v in t.items()}
 
     def explain(self) -> str:
         return plan_query(self.node, self.ctx.nparts).explain()

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dryad_tpu.data.columnar import Batch
+from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.exec.data import PData
 from dryad_tpu.ops import kernels
 from dryad_tpu.ops.text import lower_ascii, split_tokens
@@ -93,7 +93,99 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
             local = local.with_count(keep)
         return local, no
     if k == "apply":
+        if p.get("with_index"):
+            return p["fn"](b, jax.lax.axis_index(PARTITION_AXIS)), no
         return p["fn"](b), no
+    if k == "flat_map":
+        return kernels.flat_map_expand(b, p["fn"],
+                                       p["out_capacity"] * scale)
+    if k == "zip":
+        return kernels.zip2(b, others[0]), no
+    if k == "row_index":
+        counts = jax.lax.all_gather(b.count, PARTITION_AXIS)
+        me = jax.lax.axis_index(PARTITION_AXIS)
+        start = jnp.sum(jnp.where(jnp.arange(counts.shape[0]) < me,
+                                  counts, 0))
+        idx = start + jnp.arange(b.capacity, dtype=jnp.int32)
+        return b.with_columns({p["column"]: idx}), no
+    if k == "skip":
+        n = p["n"]
+        counts = jax.lax.all_gather(b.count, PARTITION_AXIS)
+        me = jax.lax.axis_index(PARTITION_AXIS)
+        start = jnp.sum(jnp.where(jnp.arange(counts.shape[0]) < me,
+                                  counts, 0))
+        # drop the first max(0, n - start) local rows
+        drop = jnp.clip(n - start, 0, b.count)
+        keep = jnp.arange(b.capacity, dtype=jnp.int32) >= drop
+        return kernels.compact(b, keep), no
+    if k == "take_while" or k == "skip_while":
+        pred = p["fn"](dict(b.columns)) & b.valid_mask()
+        # local index of first failing row; capacity if none fail
+        fail = ~pred & b.valid_mask()
+        first_fail = jnp.min(jnp.where(
+            fail, jnp.arange(b.capacity, dtype=jnp.int32), b.capacity))
+        first_fail = jnp.minimum(first_fail, b.count)
+        # a partition's prefix counts only if all earlier partitions were
+        # fully clean (no failing row)
+        clean = first_fail >= b.count
+        cleans = jax.lax.all_gather(clean, PARTITION_AXIS)
+        me = jax.lax.axis_index(PARTITION_AXIS)
+        nparts = cleans.shape[0]
+        all_before_clean = jnp.all(
+            jnp.where(jnp.arange(nparts) < me, cleans, True))
+        prefix_len = jnp.where(all_before_clean, first_fail, 0)
+        if k == "take_while":
+            return b.with_count(prefix_len), no
+        keep = jnp.arange(b.capacity, dtype=jnp.int32) >= prefix_len
+        return kernels.compact(b, keep), no
+    if k == "sliding_window":
+        w = p["w"]
+        D = jax.lax.axis_size(PARTITION_AXIS)
+        halo = w - 1
+        if halo == 0:
+            cols = {kk: (StringColumn(v.data[:, None], v.lengths[:, None])
+                         if isinstance(v, StringColumn) else v[:, None])
+                    for kk, v in b.columns.items()}
+            return Batch(cols, b.count), no
+        # every partition sends its first (w-1) rows to the PREVIOUS one;
+        # windows needing rows beyond the halo (tiny next partition) or past
+        # the dataset end are dropped.  Requires halo <= next partition's
+        # count (flagged as overflow -> capacity retries won't fix, which
+        # surfaces a clear error).
+        perm = [(i, (i - 1) % D) for i in range(D)]
+
+        def send(x):
+            return jax.lax.ppermute(x[:halo], PARTITION_AXIS, perm)
+
+        next_count = jax.lax.ppermute(b.count, PARTITION_AXIS, perm)
+        me = jax.lax.axis_index(PARTITION_AXIS)
+        is_last = me == D - 1
+        halo_avail = jnp.where(is_last, 0, jnp.minimum(next_count, halo))
+        bad = (~is_last) & (next_count < halo)
+        cap = b.capacity
+        # splice the halo at position `count` (local rows past count are
+        # padding and must not appear inside windows)
+        idx_ext = jnp.arange(cap + halo, dtype=jnp.int32)
+        src = jnp.where(idx_ext < b.count,
+                        jnp.minimum(idx_ext, cap - 1),
+                        jnp.minimum(cap + (idx_ext - b.count),
+                                    cap + halo - 1))
+        widx0 = jnp.arange(cap, dtype=jnp.int32)[:, None] + \
+            jnp.arange(w, dtype=jnp.int32)[None, :]
+        widx = jnp.take(src, widx0)  # [cap, w] -> indices into concat array
+        cols = {}
+        for kk, v in b.columns.items():
+            if isinstance(v, StringColumn):
+                data = jnp.concatenate([v.data, send(v.data)], axis=0)
+                lens = jnp.concatenate([v.lengths, send(v.lengths)], axis=0)
+                cols[kk] = StringColumn(jnp.take(data, widx, axis=0),
+                                        jnp.take(lens, widx, axis=0))
+            else:
+                ext = jnp.concatenate([v, send(v)], axis=0)
+                cols[kk] = jnp.take(ext, widx, axis=0)
+        # valid window starts: i + w <= count + halo_avail
+        n_out = jnp.clip(b.count + halo_avail - halo, 0, cap)
+        return Batch(cols, n_out), bad
     if k == "recap":
         cap = p["capacity"]
         if cap >= b.capacity:
@@ -172,7 +264,8 @@ class Executor:
             cur = outs[0]
             rest = outs[1:]
             for op in stage.body:
-                if op.kind in ("join", "semi_anti", "concat", "apply2"):
+                if op.kind in ("join", "semi_anti", "concat", "apply2",
+                               "zip"):
                     cur, of = _apply_op(cur, op, scale, rest)
                     rest = []
                 else:

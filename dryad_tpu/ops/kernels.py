@@ -392,6 +392,48 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     return out, overflow
 
 
+def flat_map_expand(batch: Batch, fn, out_capacity: int
+                    ) -> Tuple[Batch, jax.Array]:
+    """Generic SelectMany: ``fn(cols) -> (out_cols, mask)`` where each output
+    column is [cap, m, ...] and mask is [cap, m]; flattens row-major and
+    compacts into ``out_capacity`` rows.  Returns (batch, overflow)."""
+    out_cols, mask = fn(dict(batch.columns))
+    mask = mask & batch.valid_mask()[:, None]
+    cap, m = mask.shape
+    flat_mask = mask.reshape(-1)
+    total = flat_mask.sum(dtype=jnp.int32)
+    perm = jnp.argsort(~flat_mask, stable=True)[:out_capacity]
+    cols = {}
+    for k, v in out_cols.items():
+        if isinstance(v, StringColumn):
+            data = v.data.reshape((cap * m,) + v.data.shape[2:])
+            lens = v.lengths.reshape(-1)
+            cols[k] = StringColumn(jnp.take(data, perm, axis=0),
+                                   jnp.take(lens, perm))
+        else:
+            flat = v.reshape((cap * m,) + v.shape[2:])
+            cols[k] = jnp.take(flat, perm, axis=0)
+    out = Batch(cols, jnp.minimum(total, out_capacity))
+    return out, total > out_capacity
+
+
+def zip2(a: Batch, b: Batch, suffix: str = "_r") -> Batch:
+    """Positional pairing within a partition; shorter-side count (LINQ Zip).
+    Capacity = min of the two capacities."""
+    cap = min(a.capacity, b.capacity)
+
+    def trim(v):
+        return jax.tree.map(lambda x: x[:cap] if x.ndim else x, v)
+
+    cols = {}
+    for k, v in a.columns.items():
+        cols[k] = trim(v)
+    for k, v in b.columns.items():
+        name = k if k not in cols else k + suffix
+        cols[name] = trim(v)
+    return Batch(cols, jnp.minimum(a.count, b.count))
+
+
 def semi_anti_join(left: Batch, right: Batch, left_keys: Sequence[str],
                    right_keys: Sequence[str], anti: bool = False) -> Batch:
     """Keep left rows whose key does (semi) / does not (anti) appear in right.

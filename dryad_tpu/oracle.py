@@ -127,8 +127,71 @@ def run_oracle(root: E.Node, bindings: Dict[str, Table] | None = None) -> Table:
                                       n.lower))
             return {n.column: toks}
         if isinstance(n, E.ApplyPerPartition):
-            raise NotImplementedError(
-                "oracle cannot interpret opaque per-partition functions")
+            if n.host_fn is None:
+                raise NotImplementedError(
+                    "oracle needs host_fn for apply_per_partition")
+            t = ev(n.parents[0])
+            out = n.host_fn(dict(t))
+            return {k: (v if isinstance(v, list) else np.asarray(v))
+                    for k, v in out.items()}
+        if isinstance(n, E.FlatMap):
+            t = ev(n.parents[0])
+            out_cols, mask = n.fn({k: np.asarray(v) for k, v in t.items()})
+            mask = np.asarray(mask).astype(bool)
+            idx = np.nonzero(mask.reshape(-1))[0]
+            out = {}
+            for k, v in out_cols.items():
+                arr = np.asarray(v)
+                flat = arr.reshape((-1,) + arr.shape[2:])
+                out[k] = flat[idx]
+            return out
+        if isinstance(n, E.Zip):
+            lt, rt = ev(n.parents[0]), ev(n.parents[1])
+            nmin = min(_nrows(lt), _nrows(rt))
+            out = {k: (v[:nmin] if isinstance(v, list) else
+                       np.asarray(v)[:nmin]) for k, v in lt.items()}
+            for k, v in rt.items():
+                name = k if k not in out else k + n.suffix
+                out[name] = (v[:nmin] if isinstance(v, list)
+                             else np.asarray(v)[:nmin])
+            return out
+        if isinstance(n, E.SlidingWindow):
+            t = ev(n.parents[0])
+            nrows = _nrows(t)
+            nwin = max(0, nrows - n.w + 1)
+            out = {}
+            for k, v in t.items():
+                if isinstance(v, list):
+                    out[k] = [[v[i + j] for j in range(n.w)]
+                              for i in range(nwin)]
+                else:
+                    arr = np.asarray(v)
+                    out[k] = np.stack([arr[i:i + n.w]
+                                       for i in range(nwin)]) if nwin else \
+                        np.zeros((0, n.w) + arr.shape[1:], arr.dtype)
+            return out
+        if isinstance(n, E.WithRowIndex):
+            t = ev(n.parents[0])
+            out = dict(t)
+            out[n.column] = np.arange(_nrows(t), dtype=np.int32)
+            return out
+        if isinstance(n, E.AssumePartitioning):
+            return ev(n.parents[0])
+        if isinstance(n, E.SkipTake):
+            t = ev(n.parents[0])
+            nrows = _nrows(t)
+            if n.op == "skip":
+                return _take_rows(t, range(min(n.n, nrows), nrows))
+            pred = np.asarray(n.fn({k: np.asarray(v) if not isinstance(v, list)
+                                    else v for k, v in t.items()})).astype(bool)
+            cut = nrows
+            for i in range(nrows):
+                if not pred[i]:
+                    cut = i
+                    break
+            if n.op == "take_while":
+                return _take_rows(t, range(cut))
+            return _take_rows(t, range(cut, nrows))
         if isinstance(n, E.GroupByAgg):
             t = ev(n.parents[0])
             nrows = _nrows(t)

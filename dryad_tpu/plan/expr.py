@@ -25,7 +25,9 @@ __all__ = [
     "Partitioning", "Node", "Source", "Placeholder", "Map", "Filter",
     "FlatTokens", "GroupByAgg", "Join", "OrderBy", "Distinct", "Concat",
     "HashRepartition", "RangeRepartition", "Broadcast", "ApplyPerPartition",
-    "Take", "SetOp", "WithCapacity", "CrossApply", "walk",
+    "Take", "SetOp", "WithCapacity", "CrossApply", "FlatMap", "Zip",
+    "SlidingWindow", "WithRowIndex", "AssumePartitioning", "SkipTake",
+    "walk",
 ]
 
 _ids = itertools.count()
@@ -151,6 +153,8 @@ class ApplyPerPartition(Node):
     fn: Callable
     label: str = "apply"
     preserves_partitioning: bool = False
+    with_index: bool = False  # fn(batch, partition_index) when True
+    host_fn: Any = None  # oracle interpretation (fn over the whole table)
 
     @property
     def partitioning(self) -> Partitioning:
@@ -278,6 +282,83 @@ class Broadcast(Node):
 class Take(Node):
     parents: Tuple[Node, ...]
     n: int
+
+
+@_node
+class FlatMap(Node):
+    """Generic SelectMany: fn(cols) -> (out_cols each [cap, m, ...],
+    mask [cap, m]); rows flattened in row-major order then compacted.
+    Reference: SelectMany (DryadLinqQueryable.cs SelectMany overloads)."""
+
+    parents: Tuple[Node, ...]
+    fn: Callable
+    out_capacity: int
+    label: str = "flat_map"
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning.none()
+
+
+@_node
+class Zip(Node):
+    """Pairwise combination by position (shorter-side semantics).  The
+    distributed form pairs rows within aligned partitions; use on datasets
+    with identical row placement (e.g. same source through row-local ops).
+    Reference: DryadLinqQueryable Zip."""
+
+    parents: Tuple[Node, ...]  # (left, right)
+    suffix: str = "_r"
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning.none()
+
+
+@_node
+class SlidingWindow(Node):
+    """Each row becomes the window of ``w`` consecutive rows starting at it
+    (windows crossing the dataset end are dropped); columns gain a window
+    axis.  Distributed via a halo exchange: every partition receives the
+    first w-1 rows of the next partition over ICI (ppermute).
+    Reference: SlidingWindow (DryadLinqQueryable.cs:1318)."""
+
+    parents: Tuple[Node, ...]
+    w: int
+
+
+@_node
+class WithRowIndex(Node):
+    """Add a global row-index column (reference: the Long*/indexed operator
+    variants, e.g. LongSelect with (elem, index) lambdas)."""
+
+    parents: Tuple[Node, ...]
+    column: str = "row_index"
+
+
+@_node
+class AssumePartitioning(Node):
+    """Declare (without shuffling) that the data is already partitioned this
+    way.  Reference: AssumeHashPartition / AssumeRangePartition
+    (DryadLinqQueryable.cs:3408,3478)."""
+
+    parents: Tuple[Node, ...]
+    kind: str
+    keys: Tuple[str, ...]
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning(self.kind, tuple(self.keys))
+
+
+@_node
+class SkipTake(Node):
+    """Global skip / take_while / skip_while."""
+
+    parents: Tuple[Node, ...]
+    op: str  # "skip" | "take_while" | "skip_while"
+    n: int = 0
+    fn: Any = None
 
 
 @_node

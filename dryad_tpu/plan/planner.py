@@ -154,8 +154,51 @@ class Planner:
 
         if isinstance(n, E.ApplyPerPartition):
             f = self._frag(n.parents[0])
-            f.ops.append(StageOp("apply", {"fn": n.fn, "label": n.label}))
+            f.ops.append(StageOp("apply", {"fn": n.fn, "label": n.label,
+                                           "with_index": n.with_index}))
             f.partitioning = n.partitioning
+            return f
+
+        if isinstance(n, E.FlatMap):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("flat_map", {
+                "fn": n.fn, "out_capacity": n.out_capacity,
+                "label": n.label}))
+            f.capacity = n.out_capacity
+            f.partitioning = E.Partitioning.none()
+            return f
+
+        if isinstance(n, E.Zip):
+            lf = self._frag(n.parents[0])
+            rf = self._frag(n.parents[1])
+            st = self._new_stage(
+                [Leg(lf.src, lf.ops, None), Leg(rf.src, rf.ops, None)],
+                [StageOp("zip", {"suffix": n.suffix})], "zip")
+            return Fragment(st.id, [], min(lf.capacity, rf.capacity),
+                            E.Partitioning.none())
+
+        if isinstance(n, E.SlidingWindow):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("sliding_window", {"w": n.w}))
+            f.partitioning = E.Partitioning.none()
+            return f
+
+        if isinstance(n, E.WithRowIndex):
+            f = self._frag(n.parents[0])
+            f.ops.append(StageOp("row_index", {"column": n.column}))
+            return f
+
+        if isinstance(n, E.AssumePartitioning):
+            f = self._frag(n.parents[0])
+            f.partitioning = E.Partitioning(n.kind, tuple(n.keys))
+            return f
+
+        if isinstance(n, E.SkipTake):
+            f = self._frag(n.parents[0])
+            if n.op == "skip":
+                f.ops.append(StageOp("skip", {"n": n.n}))
+            else:
+                f.ops.append(StageOp(n.op, {"fn": n.fn}))
             return f
 
         if isinstance(n, E.Take):

@@ -1,0 +1,133 @@
+"""Extended operator surface: flat_map, zip, sliding window, skip/
+take_while, row index, apply variants, terminal aggregates, fork."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dryad_tpu import Context
+from tests.utils import assert_same_rows
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return Context()
+
+
+@pytest.fixture(scope="module")
+def dbg():
+    return Context(local_debug=True)
+
+
+def _mk(c, n=100, seed=0):
+    rng = np.random.RandomState(seed)
+    cols = {"k": rng.randint(0, 10, n).astype(np.int32),
+            "v": rng.randn(n).astype(np.float32)}
+    return c.from_columns(cols, capacity=32), cols
+
+
+def both(ctx, dbg, build):
+    a, _ = _mk(ctx)
+    b, _ = _mk(dbg)
+    return build(a).collect(), build(b).collect()
+
+
+def test_flat_map(ctx, dbg):
+    def fn(cols):
+        # each row expands to k%3 copies with an offset tag
+        m = 3
+        reps = cols["k"] % m
+        tags = jnp.broadcast_to(jnp.arange(m)[None, :],
+                                (cols["k"].shape[0], m))
+        mask = tags < reps[:, None]
+        out = {"k": jnp.broadcast_to(cols["k"][:, None],
+                                     (cols["k"].shape[0], m)),
+               "tag": tags}
+        return out, mask
+
+    got, exp = both(ctx, dbg, lambda d: d.flat_map(fn, out_capacity=128))
+    assert_same_rows(got, exp)
+
+
+def test_zip(ctx, dbg):
+    def q(d):
+        a = d.select(lambda c: {"x": c["k"]})
+        b = d.select(lambda c: {"y": c["v"]})
+        return a.zip_with(b)
+    got, exp = both(ctx, dbg, q)
+    assert_same_rows(got, exp, ordered=True)
+
+
+def test_sliding_window(ctx, dbg):
+    def q(d):
+        return d.select(lambda c: {"v": c["v"]}).sliding_window(4)
+    got, exp = both(ctx, dbg, q)
+    gv, ev = np.asarray(got["v"]), np.asarray(exp["v"])
+    assert gv.shape == ev.shape
+    np.testing.assert_allclose(gv, ev, rtol=1e-6)
+
+
+def test_skip(ctx, dbg):
+    got, exp = both(ctx, dbg, lambda d: d.skip(37))
+    assert_same_rows(got, exp, ordered=True)
+
+
+def test_take_while_skip_while(ctx, dbg):
+    for op in ("take_while", "skip_while"):
+        def q(d, op=op):
+            return getattr(d, op)(lambda c: c["v"] > -1.2)
+        got, exp = both(ctx, dbg, q)
+        assert_same_rows(got, exp, ordered=True)
+
+
+def test_with_row_index(ctx, dbg):
+    got, exp = both(ctx, dbg, lambda d: d.with_row_index())
+    assert_same_rows(got, exp, ordered=True)
+
+
+def test_apply_with_partition_index(ctx):
+    ds, _ = _mk(ctx)
+
+    def fn(b, idx):
+        return b.with_columns({"part": jnp.full((b.capacity,), idx,
+                                                jnp.int32)})
+    out = ds.apply_with_partition_index(fn).collect()
+    assert set(out["part"].tolist()) == set(range(ctx.nparts))
+
+
+def test_fork(ctx, dbg):
+    def q(d):
+        t, f = d.fork_by(lambda c: c["v"] > 0)
+        return t.concat(f)
+    got, exp = both(ctx, dbg, q)
+    assert_same_rows(got, exp)
+
+
+def test_terminal_aggregates(ctx, dbg):
+    a, cols = _mk(ctx)
+    d, _ = _mk(dbg)
+    v = cols["v"]
+    np.testing.assert_allclose(a.sum("v"), v.sum(), rtol=1e-4)
+    np.testing.assert_allclose(a.min("v"), v.min(), rtol=1e-6)
+    np.testing.assert_allclose(a.max("v"), v.max(), rtol=1e-6)
+    np.testing.assert_allclose(a.mean("v"), v.mean(), rtol=1e-4)
+    np.testing.assert_allclose(d.sum("v"), v.sum(), rtol=1e-4)
+    np.testing.assert_allclose(d.mean("v"), v.mean(), rtol=1e-4)
+    assert a.first()["k"] == cols["k"][0]
+
+
+def test_assume_hash_partition(ctx):
+    ds, _ = _mk(ctx)
+    pre = ds.hash_partition(["k"])._materialize()
+    loaded = ctx.from_pdata(pre)
+    plan = (loaded.assume_hash_partition(["k"])
+            .group_by(["k"], {"n": ("count", None)}).explain())
+    assert "=>hash" not in plan
+    # and results are still correct
+    out = (loaded.assume_hash_partition(["k"])
+           .group_by(["k"], {"n": ("count", None)}).collect())
+    import collections
+    _, cols = _mk(ctx)
+    ref = collections.Counter(cols["k"].tolist())
+    assert {int(k): int(n) for k, n in zip(out["k"], out["n"])} == dict(ref)
