@@ -1,0 +1,123 @@
+"""Physical plan (de)serialization.
+
+Parity with the reference's XML query plan contract: the client writes an
+XML plan (DryadLinqQueryGen.cs GenerateDryadProgram :814) that the GM parses
+back into its graph (DryadLinqGraphManager/QueryParser.cs:360, Query.cs).
+Our plan is JSON; Python callables inside ops are serialized as opaque
+references (a plan with UDFs round-trips structurally for inspection/
+tooling; re-execution requires re-binding the callables via ``fn_table``,
+the analogue of the reference's `assembly!class.method` vertex-entry names,
+QueryParser.cs:100).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional
+
+from dryad_tpu.plan.stages import Exchange, Leg, Stage, StageGraph, StageOp
+
+__all__ = ["graph_to_json", "graph_from_json"]
+
+
+def _op_to_json(op: StageOp, fn_names: Dict[int, str]) -> dict:
+    params = {}
+    for k, v in op.params.items():
+        if callable(v):
+            params[k] = {"__fn__": fn_names.get(id(v), f"fn_{id(v):x}")}
+        elif isinstance(v, bytes):
+            params[k] = {"__bytes__": v.decode("latin1")}
+        elif isinstance(v, tuple):
+            params[k] = {"__tuple__": list(v)}
+        elif isinstance(v, dict):
+            params[k] = {"__dict__": {kk: list(vv) if isinstance(vv, tuple)
+                                      else vv for kk, vv in v.items()}}
+        else:
+            params[k] = v
+    return {"kind": op.kind, "params": params}
+
+
+def _op_from_json(d: dict, fn_table: Optional[Dict[str, Callable]]) -> StageOp:
+    params: Dict[str, Any] = {}
+    for k, v in d["params"].items():
+        if isinstance(v, dict) and "__fn__" in v:
+            name = v["__fn__"]
+            if fn_table is None or name not in fn_table:
+                raise KeyError(
+                    f"plan references callable {name!r}; pass it in fn_table")
+            params[k] = fn_table[name]
+        elif isinstance(v, dict) and "__bytes__" in v:
+            params[k] = v["__bytes__"].encode("latin1")
+        elif isinstance(v, dict) and "__tuple__" in v:
+            params[k] = tuple(tuple(x) if isinstance(x, list) else x
+                              for x in v["__tuple__"])
+        elif isinstance(v, dict) and "__dict__" in v:
+            params[k] = {kk: tuple(vv) if isinstance(vv, list) else vv
+                         for kk, vv in v["__dict__"].items()}
+        else:
+            params[k] = v
+    return StageOp(d["kind"], params)
+
+
+def graph_to_json(graph: StageGraph,
+                  fn_names: Optional[Dict[int, str]] = None) -> str:
+    fn_names = fn_names or {}
+    stages = []
+    for st in graph.stages:
+        legs = []
+        for leg in st.legs:
+            if isinstance(leg.src, int):
+                src: Any = {"stage": leg.src}
+            elif leg.src[0] == "placeholder":
+                src = {"placeholder": leg.src[1]}
+            else:
+                src = {"source": True}
+            ex = None
+            if leg.exchange is not None:
+                e = leg.exchange
+                ex = {"kind": e.kind, "keys": list(e.keys),
+                      "out_capacity": e.out_capacity,
+                      "descending": e.descending,
+                      "bounds_from": e.bounds_from,
+                      "bounds_key": e.bounds_key}
+            legs.append({"src": src,
+                         "ops": [_op_to_json(o, fn_names) for o in leg.ops],
+                         "exchange": ex})
+        stages.append({"id": st.id, "label": st.label, "legs": legs,
+                       "body": [_op_to_json(o, fn_names) for o in st.body]})
+    return json.dumps({"version": 1, "stages": stages,
+                       "out_stage": graph.out_stage}, indent=1)
+
+
+def graph_from_json(s: str, fn_table: Optional[Dict[str, Callable]] = None,
+                    sources: Optional[Dict[int, Any]] = None) -> StageGraph:
+    """Rebuild a StageGraph.  ``sources`` maps (stage_id, leg_index) source
+    slots — keyed "sid:leg" — to bound data handles."""
+    d = json.loads(s)
+    stages = []
+    for sd in d["stages"]:
+        legs = []
+        for li, ld in enumerate(sd["legs"]):
+            src = ld["src"]
+            if "stage" in src:
+                lsrc: Any = src["stage"]
+            elif "placeholder" in src:
+                lsrc = ("placeholder", src["placeholder"])
+            else:
+                key = f"{sd['id']}:{li}"
+                if sources is None or key not in sources:
+                    raise KeyError(f"plan needs source binding for {key}")
+                lsrc = ("source", sources[key])
+            ex = None
+            if ld["exchange"] is not None:
+                e = ld["exchange"]
+                ex = Exchange(e["kind"], tuple(e["keys"]), e["out_capacity"],
+                              e["descending"], e["bounds_from"],
+                              e["bounds_key"])
+            legs.append(Leg(lsrc, [_op_from_json(o, fn_table)
+                                   for o in ld["ops"]], ex))
+        stages.append(Stage(id=sd["id"], legs=legs,
+                            body=[_op_from_json(o, fn_table)
+                                  for o in sd["body"]],
+                            label=sd["label"]))
+    return StageGraph(stages, d["out_stage"])
