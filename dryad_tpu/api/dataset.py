@@ -35,6 +35,9 @@ class Context:
                  spill_dir: Optional[str] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.nparts = self.mesh.devices.size
+        # 2-D (dcn, dp) meshes trigger hierarchical aggregation plans
+        self.hosts = (self.mesh.devices.shape[0]
+                      if len(self.mesh.axis_names) == 2 else 1)
         self.local_debug = local_debug
         self.spill_dir = spill_dir
         self.executor = Executor(self.mesh, event_log=event_log)
@@ -123,7 +126,7 @@ class Context:
         ph = E.Placeholder(parents=(), name="__loop", _npartitions=self.nparts,
                            capacity=cur.capacity)
         body_ds = body(Dataset(self, ph))
-        graph = plan_query(body_ds.node, self.nparts)
+        graph = plan_query(body_ds.node, self.nparts, hosts=self.hosts)
         for _ in range(n_iters):
             nxt = self.executor.run(graph, bindings={"__loop": cur})
             if nxt.capacity != cur.capacity:
@@ -309,7 +312,8 @@ class Dataset:
     # -- terminals ---------------------------------------------------------
 
     def _materialize(self) -> PData:
-        graph = plan_query(self.node, self.ctx.nparts)
+        graph = plan_query(self.node, self.ctx.nparts,
+                           hosts=self.ctx.hosts)
         return self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
 
     def collect(self) -> Dict[str, Any]:
@@ -405,4 +409,5 @@ class Dataset:
         return {k: v[0] for k, v in t.items()}
 
     def explain(self) -> str:
-        return plan_query(self.node, self.ctx.nparts).explain()
+        return plan_query(self.node, self.ctx.nparts,
+                          hosts=self.ctx.hosts).explain()

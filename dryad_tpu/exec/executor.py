@@ -53,7 +53,8 @@ def _expand(b: Batch) -> Batch:
     return jax.tree.map(lambda x: x[None], b)
 
 
-def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
+def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
+              axes: tuple = (PARTITION_AXIS,)):
     """Apply one StageOp to batch ``b``; returns (batch, overflow_bool)."""
     no = jnp.zeros((), jnp.bool_)
     k = op.kind
@@ -84,8 +85,8 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
         n = p["n"]
         local = kernels.take(b, n)
         if p.get("global", True):
-            counts = jax.lax.all_gather(local.count, PARTITION_AXIS)
-            me = jax.lax.axis_index(PARTITION_AXIS)
+            counts = jax.lax.all_gather(local.count, axes)
+            me = jax.lax.axis_index(axes)
             nparts = counts.shape[0]
             before = jnp.sum(
                 jnp.where(jnp.arange(nparts) < me, counts, 0))
@@ -94,7 +95,7 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
         return local, no
     if k == "apply":
         if p.get("with_index"):
-            return p["fn"](b, jax.lax.axis_index(PARTITION_AXIS)), no
+            return p["fn"](b, jax.lax.axis_index(axes)), no
         return p["fn"](b), no
     if k == "flat_map":
         return kernels.flat_map_expand(b, p["fn"],
@@ -102,16 +103,16 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
     if k == "zip":
         return kernels.zip2(b, others[0]), no
     if k == "row_index":
-        counts = jax.lax.all_gather(b.count, PARTITION_AXIS)
-        me = jax.lax.axis_index(PARTITION_AXIS)
+        counts = jax.lax.all_gather(b.count, axes)
+        me = jax.lax.axis_index(axes)
         start = jnp.sum(jnp.where(jnp.arange(counts.shape[0]) < me,
                                   counts, 0))
         idx = start + jnp.arange(b.capacity, dtype=jnp.int32)
         return b.with_columns({p["column"]: idx}), no
     if k == "skip":
         n = p["n"]
-        counts = jax.lax.all_gather(b.count, PARTITION_AXIS)
-        me = jax.lax.axis_index(PARTITION_AXIS)
+        counts = jax.lax.all_gather(b.count, axes)
+        me = jax.lax.axis_index(axes)
         start = jnp.sum(jnp.where(jnp.arange(counts.shape[0]) < me,
                                   counts, 0))
         # drop the first max(0, n - start) local rows
@@ -128,8 +129,8 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
         # a partition's prefix counts only if all earlier partitions were
         # fully clean (no failing row)
         clean = first_fail >= b.count
-        cleans = jax.lax.all_gather(clean, PARTITION_AXIS)
-        me = jax.lax.axis_index(PARTITION_AXIS)
+        cleans = jax.lax.all_gather(clean, axes)
+        me = jax.lax.axis_index(axes)
         nparts = cleans.shape[0]
         all_before_clean = jnp.all(
             jnp.where(jnp.arange(nparts) < me, cleans, True))
@@ -140,7 +141,7 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
         return kernels.compact(b, keep), no
     if k == "sliding_window":
         w = p["w"]
-        D = jax.lax.axis_size(PARTITION_AXIS)
+        D = jax.lax.axis_size(axes)
         halo = w - 1
         if halo == 0:
             cols = {kk: (StringColumn(v.data[:, None], v.lengths[:, None])
@@ -155,10 +156,10 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
         perm = [(i, (i - 1) % D) for i in range(D)]
 
         def send(x):
-            return jax.lax.ppermute(x[:halo], PARTITION_AXIS, perm)
+            return jax.lax.ppermute(x[:halo], axes, perm)
 
-        next_count = jax.lax.ppermute(b.count, PARTITION_AXIS, perm)
-        me = jax.lax.axis_index(PARTITION_AXIS)
+        next_count = jax.lax.ppermute(b.count, axes, perm)
+        me = jax.lax.axis_index(axes)
         is_last = me == D - 1
         halo_avail = jnp.where(is_last, 0, jnp.minimum(next_count, halo))
         bad = (~is_last) & (next_count < halo)
@@ -213,19 +214,21 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch]):
     raise ValueError(f"unknown op kind {k}")
 
 
-def _apply_exchange(b: Batch, ex: Exchange, scale: int,
-                    bounds) -> Tuple[Batch, jax.Array]:
+def _apply_exchange(b: Batch, ex: Exchange, scale: int, bounds,
+                    axes: tuple = (PARTITION_AXIS,)
+                    ) -> Tuple[Batch, jax.Array]:
     cap = ex.out_capacity * scale
     if ex.kind == "hash":
         # empty keys = whole row; sorted so both legs of a set op agree
         keys = list(ex.keys) or sorted(b.names)
-        return shuffle.hash_exchange(b, keys, cap, send_slack=2 * scale)
+        return shuffle.hash_exchange(b, keys, cap, send_slack=2 * scale,
+                                     axes=axes, axis=ex.axis)
     if ex.kind == "range":
         return shuffle.range_exchange(b, ex.bounds_key, bounds, cap,
                                       descending=ex.descending,
-                                      send_slack=2 * scale)
+                                      send_slack=2 * scale, axes=axes)
     if ex.kind == "broadcast":
-        return shuffle.broadcast_gather(b, cap)
+        return shuffle.broadcast_gather(b, cap, axes=axes)
     raise ValueError(ex.kind)
 
 
@@ -234,6 +237,7 @@ class Executor:
 
     def __init__(self, mesh, event_log: Optional[Callable[[dict], None]] = None):
         self.mesh = mesh
+        self.axes = tuple(mesh.axis_names)
         self.nparts = mesh.devices.size
         self._event = event_log or (lambda e: None)
         # bounded LRU keyed by stage structure + input shapes, so identical
@@ -255,10 +259,11 @@ class Executor:
             outs = []
             for leg, b in zip(stage.legs, leg_batches):
                 for op in leg.ops:
-                    b, of = _apply_op(b, op, scale, [])
+                    b, of = _apply_op(b, op, scale, [], self.axes)
                     overflow |= of
                 if leg.exchange is not None:
-                    b, of = _apply_exchange(b, leg.exchange, scale, bounds)
+                    b, of = _apply_exchange(b, leg.exchange, scale,
+                                            bounds, self.axes)
                     overflow |= of
                 outs.append(b)
             cur = outs[0]
@@ -266,17 +271,19 @@ class Executor:
             for op in stage.body:
                 if op.kind in ("join", "semi_anti", "concat", "apply2",
                                "zip"):
-                    cur, of = _apply_op(cur, op, scale, rest)
+                    cur, of = _apply_op(cur, op, scale, rest,
+                                        self.axes)
                     rest = []
                 else:
-                    cur, of = _apply_op(cur, op, scale, [])
+                    cur, of = _apply_op(cur, op, scale, [],
+                                        self.axes)
                 overflow |= of
             return _expand(cur), overflow[None]
 
-        in_specs = tuple([P(PARTITION_AXIS)] * n_legs +
+        in_specs = tuple([P(self.axes)] * n_legs +
                          ([P()] if has_bounds else []))
         fn = jax.shard_map(per_shard, mesh=self.mesh, in_specs=in_specs,
-                           out_specs=(P(PARTITION_AXIS), P(PARTITION_AXIS)),
+                           out_specs=(P(self.axes), P(self.axes)),
                            check_vma=False)
         return jax.jit(fn)
 

@@ -2,9 +2,16 @@
 
 The reference's cluster topology tree (GraphManager/kernel/DrResources.h:23 —
 Core/Socket/Computer/Rack/Cluster levels feeding locality-aware scheduling)
-maps on TPU to the ICI mesh: partitions ride the ``dp`` axis, and the
-hierarchical aggregation trees of DrDynamicAggregateManager (machine -> pod
--> overall) become collectives over mesh sub-axes.
+maps on TPU to the mesh axes: a 1-D ``(dp,)`` mesh for one host/slice, or a
+2-D ``(dcn, dp)`` mesh for multi-host — ``dp`` rides ICI inside a slice,
+``dcn`` crosses slices/hosts.  The hierarchical aggregation trees of
+DrDynamicAggregateManager (machine -> pod -> overall,
+DrDynamicAggregateManager.h:99) become per-axis exchange hops: combine over
+``dp`` first (cheap ICI), then over ``dcn`` (scarce bandwidth) — see
+plan/planner.py GroupByAgg lowering.
+
+Partitions are enumerated over ALL mesh axes jointly: partition index =
+dcn_index * |dp| + dp_index.
 """
 
 from __future__ import annotations
@@ -15,22 +22,43 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 PARTITION_AXIS = "dp"
+HOST_AXIS = "dcn"
 
-__all__ = ["PARTITION_AXIS", "make_mesh", "partition_spec", "batch_sharding"]
+__all__ = ["PARTITION_AXIS", "HOST_AXIS", "make_mesh", "mesh_axes",
+           "partition_spec", "batch_sharding", "axis_sizes"]
 
 
-def make_mesh(devices=None, n: int | None = None) -> Mesh:
-    """1-D partition mesh over the given (or all) devices."""
+def make_mesh(devices=None, n: int | None = None,
+              hosts: int | None = None) -> Mesh:
+    """Partition mesh over the given (or all) devices.  With ``hosts`` > 1,
+    a 2-D (dcn, dp) mesh: dp within a host/slice, dcn across."""
     devs = list(devices) if devices is not None else jax.devices()
     if n is not None:
         devs = devs[:n]
+    if hosts and hosts > 1:
+        if len(devs) % hosts:
+            raise ValueError(f"{len(devs)} devices not divisible by "
+                             f"{hosts} hosts")
+        arr = np.asarray(devs).reshape(hosts, len(devs) // hosts)
+        return Mesh(arr, (HOST_AXIS, PARTITION_AXIS))
     return Mesh(np.asarray(devs), (PARTITION_AXIS,))
 
 
-def partition_spec() -> PartitionSpec:
-    return PartitionSpec(PARTITION_AXIS)
+def mesh_axes(mesh: Mesh) -> tuple:
+    """All partition axes of the mesh, outermost first."""
+    return tuple(mesh.axis_names)
+
+
+def axis_sizes(mesh: Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def partition_spec(mesh: Mesh | None = None) -> PartitionSpec:
+    if mesh is None:
+        return PartitionSpec(PARTITION_AXIS)
+    return PartitionSpec(mesh_axes(mesh))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for stacked per-partition data: leading dim over dp."""
-    return NamedSharding(mesh, PartitionSpec(PARTITION_AXIS))
+    """Sharding for stacked per-partition data: leading dim over all axes."""
+    return NamedSharding(mesh, partition_spec(mesh))

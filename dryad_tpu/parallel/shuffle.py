@@ -3,15 +3,20 @@
 This module replaces the reference's entire shuffle transport (SURVEY.md
 §2.8: producer temp files + GM URI rewriting (kernel/DrCluster.cpp:553-569) +
 ranged HTTP GETs (managedchannel/HttpReader.cs:78-105) served by
-ProcessService FileServer) with in-HBM ``all_to_all`` over the ICI mesh, and
-the dynamic broadcast tree (DrDynamicBroadcast.h:23) with ``all_gather``.
+ProcessService FileServer) with in-HBM ``all_to_all`` over the mesh, and the
+dynamic broadcast tree (DrDynamicBroadcast.h:23) with ``all_gather``.
 
-All functions here run INSIDE ``shard_map`` over the partition axis: they
-take the calling device's partition Batch and return the post-exchange
-partition Batch plus an overflow flag.  Capacities are static; skew beyond
-the per-destination capacity sets the overflow flag (checked host-side by the
-executor, which re-plans with a larger capacity — the moral equivalent of
-DrDynamicDistributionManager's runtime repartitioning).
+All functions run INSIDE ``shard_map`` over the partition axes.  On a 1-D
+``(dp,)`` mesh an exchange is one all_to_all over ICI.  On a 2-D
+``(dcn, dp)`` mesh a global exchange is TWO hops — within-host over ``dp``
+(ICI), then across hosts over ``dcn`` (DCN) — the standard 2-hop all-to-all
+that keeps the scarce DCN hop dense; single-axis exchanges (used by the
+hierarchical aggregation lowering) touch only their own axis.
+
+Capacities are static; skew beyond the per-destination capacity sets the
+overflow flag (checked host-side by the executor, which re-plans with a
+larger capacity — the dynamic-repartition role of
+DrDynamicDistributionManager).
 """
 
 from __future__ import annotations
@@ -24,34 +29,27 @@ import jax.numpy as jnp
 from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.ops.hashing import hash_batch_keys
 from dryad_tpu.ops.kernels import sort_lanes_for
-from dryad_tpu.parallel.mesh import PARTITION_AXIS
+from dryad_tpu.parallel.mesh import HOST_AXIS, PARTITION_AXIS
 
 __all__ = ["exchange_by_dest", "hash_exchange", "range_exchange",
            "broadcast_gather", "range_dest_lane"]
 
-
-def _axis_size() -> int:
-    return jax.lax.axis_size(PARTITION_AXIS)
+_DEST = "__dest"
 
 
-def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
-                     send_slack: int = 2) -> Tuple[Batch, jax.Array]:
-    """Send each valid row to partition ``dest[row]``; return the rows
-    received by this partition, compacted, plus an overflow flag.
-
-    Implementation: stable-sort rows by destination, scatter into a
-    [D, C] send buffer (C = per-destination slot count), ``all_to_all``
-    over the partition axis, then compact received chunks.
-    """
-    D = _axis_size()
+def _exchange_one_axis(batch: Batch, dest: jax.Array, axis: str,
+                       out_capacity: int, send_slack: int,
+                       all_axes: tuple) -> Tuple[Batch, jax.Array]:
+    """Send each valid row to index ``dest[row]`` along ``axis``; compact
+    received rows.  Returns (batch, overflow)."""
+    D = jax.lax.axis_size(axis)
     cap = batch.capacity
     valid = batch.valid_mask()
     dest = jnp.where(valid, dest.astype(jnp.int32), D)  # invalid -> sentinel
 
     # per-destination slot capacity in the send buffer: worst-case a single
     # destination receives this partition's whole batch, but sizing for that
-    # squares the buffer; default slack of 2x even spread, scaled up by the
-    # executor's overflow retry (send_slack grows with the capacity scale).
+    # squares the buffer; slack scales with the executor's overflow retry.
     C = max(1, min(cap, -(-send_slack * cap // D)))
 
     order = jnp.argsort(dest, stable=True)
@@ -60,19 +58,15 @@ def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
     counts = jnp.bincount(jnp.minimum(sdest, D), length=D + 1)[:D]
     offsets = jnp.cumsum(counts) - counts  # exclusive prefix
 
-    # send slot (d, j) <- sorted row offsets[d] + j  (j < counts[d])
     d_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
     j_idx = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
-    src = jnp.take(offsets, d_idx) + j_idx
-    slot_filled = j_idx < jnp.take(counts, d_idx)
-    src = jnp.clip(src, 0, cap - 1)
-    send = sb.gather(src)  # [D*C] rows, garbage where not slot_filled
-    send_counts = jnp.minimum(counts, C)  # rows actually shipped per dest
+    src = jnp.clip(jnp.take(offsets, d_idx) + j_idx, 0, cap - 1)
+    send = sb.gather(src)  # [D*C] rows, garbage where slot not filled
+    send_counts = jnp.minimum(counts, C)
     send_overflow = (counts > C).any()
 
-    # all_to_all: split leading dim into D chunks, exchange, concat
     def a2a(x):
-        return jax.lax.all_to_all(x, PARTITION_AXIS, 0, 0, tiled=True)
+        return jax.lax.all_to_all(x, axis, 0, 0, tiled=True)
 
     recv_cols = {}
     for k, v in send.columns.items():
@@ -80,17 +74,14 @@ def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
             recv_cols[k] = StringColumn(a2a(v.data), a2a(v.lengths))
         else:
             recv_cols[k] = a2a(v)
-    recv_counts = jax.lax.all_to_all(
-        send_counts, PARTITION_AXIS, 0, 0, tiled=True)  # [D]
+    recv_counts = jax.lax.all_to_all(send_counts, axis, 0, 0, tiled=True)
 
-    # compact received rows: row (s, j) valid iff j < recv_counts[s]
     s_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
     jj = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
     rvalid = jj < jnp.take(recv_counts, s_idx)
-    recv = Batch(recv_cols, rvalid.sum(dtype=jnp.int32))
-    perm = jnp.argsort(~rvalid, stable=True)
     total = rvalid.sum(dtype=jnp.int32)
-
+    recv = Batch(recv_cols, total)
+    perm = jnp.argsort(~rvalid, stable=True)
     if out_capacity >= D * C:
         out = recv.gather(perm).pad_to(out_capacity)
         recv_overflow = jnp.zeros((), jnp.bool_)
@@ -101,17 +92,72 @@ def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
 
     overflow = send_overflow | recv_overflow
     # any shard overflowing poisons the whole exchange
-    overflow = jax.lax.psum(overflow.astype(jnp.int32), PARTITION_AXIS) > 0
+    overflow = jax.lax.psum(overflow.astype(jnp.int32), all_axes) > 0
     return out, overflow
 
 
+def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
+                     send_slack: int = 2,
+                     axes: tuple = (PARTITION_AXIS,)
+                     ) -> Tuple[Batch, jax.Array]:
+    """Send each valid row to GLOBAL partition ``dest[row]`` (index over all
+    mesh axes, outermost-major).  1-D mesh: one all_to_all hop.  2-D mesh:
+    two hops — to the target dp column within the host, then to the target
+    host over dcn."""
+    if len(axes) == 1:
+        return _exchange_one_axis(batch, dest, axes[0], out_capacity,
+                                  send_slack, axes)
+    if len(axes) != 2:
+        raise ValueError(f"unsupported mesh rank {len(axes)}")
+    host_axis, dp_axis = axes
+    D = jax.lax.axis_size(dp_axis)
+    b1 = batch.with_columns({_DEST: dest.astype(jnp.int32)})
+    # hop 1 (ICI): to the destination's dp column, within this host
+    h1, of1 = _exchange_one_axis(b1, dest % D, dp_axis, out_capacity,
+                                 send_slack, axes)
+    # hop 2 (DCN): to the destination host
+    d2 = h1.columns[_DEST] // D
+    h2, of2 = _exchange_one_axis(h1, d2, host_axis, out_capacity,
+                                 send_slack, axes)
+    out_cols = {k: v for k, v in h2.columns.items() if k != _DEST}
+    return Batch(out_cols, h2.count), of1 | of2
+
+
 def hash_exchange(batch: Batch, keys: Sequence[str], out_capacity: int,
-                  send_slack: int = 2) -> Tuple[Batch, jax.Array]:
-    """Repartition rows by key hash (HashPartition / shuffle-for-GroupBy)."""
-    D = _axis_size()
+                  send_slack: int = 2, axes: tuple = (PARTITION_AXIS,),
+                  axis: str | None = None) -> Tuple[Batch, jax.Array]:
+    """Repartition rows by key hash (HashPartition / shuffle-for-GroupBy).
+
+    With ``axis`` set, the exchange touches only that mesh axis — used by
+    the hierarchical aggregation lowering (combine over ICI first, then
+    DCN), the mesh-axis form of the reference's machine->pod->overall trees
+    (DrDynamicAggregateManager.h:99).  Key->place mapping is consistent
+    across the per-axis and global forms: global partition of key k is
+    (lo(k) // |dp|) % |dcn| on dcn, lo(k) % |dp| on dp.
+    """
     _, lo = hash_batch_keys(batch, keys)
-    dest = (lo % jnp.uint32(D)).astype(jnp.int32)
-    return exchange_by_dest(batch, dest, out_capacity, send_slack)
+    if axis is None:
+        if len(axes) == 1:
+            D = jax.lax.axis_size(axes[0])
+            dest = (lo % jnp.uint32(D)).astype(jnp.int32)
+        else:
+            Ddp = jax.lax.axis_size(axes[1])
+            H = jax.lax.axis_size(axes[0])
+            dd = lo % jnp.uint32(Ddp)
+            hh = (lo // jnp.uint32(Ddp)) % jnp.uint32(H)
+            dest = (hh * jnp.uint32(Ddp) + dd).astype(jnp.int32)
+        return exchange_by_dest(batch, dest, out_capacity, send_slack, axes)
+    if axis == PARTITION_AXIS:
+        D = jax.lax.axis_size(axis)
+        dest = (lo % jnp.uint32(D)).astype(jnp.int32)
+    elif axis == HOST_AXIS:
+        Ddp = jax.lax.axis_size(PARTITION_AXIS)
+        H = jax.lax.axis_size(axis)
+        dest = ((lo // jnp.uint32(Ddp)) % jnp.uint32(H)).astype(jnp.int32)
+    else:
+        raise ValueError(axis)
+    return _exchange_one_axis(batch, dest, axis, out_capacity, send_slack,
+                              axes)
 
 
 def range_dest_lane(col) -> jax.Array:
@@ -127,29 +173,31 @@ def range_dest_lane(col) -> jax.Array:
 
 def range_exchange(batch: Batch, key: str, bounds: jax.Array,
                    out_capacity: int, descending: bool = False,
-                   send_slack: int = 2) -> Tuple[Batch, jax.Array]:
+                   send_slack: int = 2, axes: tuple = (PARTITION_AXIS,)
+                   ) -> Tuple[Batch, jax.Array]:
     """Repartition by range: row -> searchsorted(bounds, lane(key)).
 
-    ``bounds`` is a [D-1] uint32 array of split points over the ordering
+    ``bounds`` is a [P-1] uint32 array of split points over the ordering
     lane, computed host-side from samples (the reference computes these in a
     sampling stage: DryadLinqSampler.cs:42 + DrDynamicRangeDistributor.h:23).
     """
-    D = _axis_size()
     lane = range_dest_lane(batch.columns[key])
     dest = jnp.searchsorted(bounds, lane, side="right").astype(jnp.int32)
     if descending:
-        dest = (D - 1) - dest
-    return exchange_by_dest(batch, dest, out_capacity, send_slack)
+        P = bounds.shape[0] + 1
+        dest = (P - 1) - dest
+    return exchange_by_dest(batch, dest, out_capacity, send_slack, axes)
 
 
-def broadcast_gather(batch: Batch, out_capacity: int) -> Tuple[Batch, jax.Array]:
+def broadcast_gather(batch: Batch, out_capacity: int,
+                     axes: tuple = (PARTITION_AXIS,)
+                     ) -> Tuple[Batch, jax.Array]:
     """Replicate all partitions' rows to every partition (all_gather +
     compact).  Used for broadcast joins and k-means centroids."""
-    D = _axis_size()
     cap = batch.capacity
 
     def ag(x):
-        return jax.lax.all_gather(x, PARTITION_AXIS, axis=0, tiled=True)
+        return jax.lax.all_gather(x, axes, axis=0, tiled=True)
 
     cols = {}
     for k, v in batch.columns.items():
@@ -157,7 +205,8 @@ def broadcast_gather(batch: Batch, out_capacity: int) -> Tuple[Batch, jax.Array]
             cols[k] = StringColumn(ag(v.data), ag(v.lengths))
         else:
             cols[k] = ag(v)
-    counts = jax.lax.all_gather(batch.count, PARTITION_AXIS)  # [D]
+    counts = jax.lax.all_gather(batch.count, axes)  # [P]
+    D = counts.shape[0]
     s_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), cap)
     jj = jnp.tile(jnp.arange(cap, dtype=jnp.int32), D)
     rvalid = jj < jnp.take(counts, s_idx)

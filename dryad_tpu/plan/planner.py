@@ -79,8 +79,9 @@ def _mean_post_fn(mean_cols: List[str]):
 
 
 class Planner:
-    def __init__(self, npartitions: int):
+    def __init__(self, npartitions: int, hosts: int = 1):
         self.nparts = npartitions
+        self.hosts = hosts  # >1 => 2-D (dcn, dp) mesh: hierarchical aggs
         self.stages: List[Stage] = []
         self.frags: Dict[int, Fragment] = {}
         self.consumers: Dict[int, int] = {}
@@ -232,8 +233,31 @@ class Planner:
                 return f
             partial, final, mean_cols = _decompose_aggs(n.aggs)
             f.ops.append(StageOp("group", {"keys": keys, "aggs": partial}))
+            if self.hosts > 1:
+                # hierarchical aggregation over mesh axes (the reference's
+                # machine->pod->overall trees, DrDynamicAggregateManager.h:99):
+                # combine within each host over ICI first, so the scarce DCN
+                # hop carries one partial per (host, key) instead of one per
+                # (device, key)
+                ex1 = Exchange("hash", keys=keys, out_capacity=f.capacity,
+                               axis="dp")
+                body: List[StageOp] = [
+                    StageOp("group", {"keys": keys, "aggs": final})]
+                st1 = self._new_stage([Leg(f.src, f.ops, ex1)], body,
+                                      "groupby-ici")
+                ex2 = Exchange("hash", keys=keys, out_capacity=f.capacity,
+                               axis="dcn")
+                body2: List[StageOp] = [
+                    StageOp("group", {"keys": keys, "aggs": final})]
+                if mean_cols:
+                    body2.append(StageOp("fn", {"fn": _mean_post_fn(mean_cols),
+                                                "label": "mean-finalize"}))
+                st2 = self._new_stage([Leg(st1.id, [], ex2)], body2,
+                                      "groupby-dcn")
+                return Fragment(st2.id, [], f.capacity,
+                                E.Partitioning("hash", keys))
             ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
-            body: List[StageOp] = [StageOp("group", {"keys": keys, "aggs": final})]
+            body = [StageOp("group", {"keys": keys, "aggs": final})]
             if mean_cols:
                 body.append(StageOp("fn", {"fn": _mean_post_fn(mean_cols),
                                            "label": "mean-finalize"}))
@@ -361,5 +385,5 @@ class Planner:
         raise TypeError(f"planner: unhandled node {type(n).__name__}")
 
 
-def plan_query(root: E.Node, npartitions: int) -> StageGraph:
-    return Planner(npartitions).plan(root)
+def plan_query(root: E.Node, npartitions: int, hosts: int = 1) -> StageGraph:
+    return Planner(npartitions, hosts=hosts).plan(root)

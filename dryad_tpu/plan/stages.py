@@ -53,6 +53,9 @@ class Exchange:
     descending: bool = False
     bounds_from: Optional[int] = None  # stage id whose output seeds range bounds
     bounds_key: Optional[str] = None
+    # None = global exchange over all mesh axes; "dp"/"dcn" = only that axis
+    # (hierarchical aggregation hops, DrDynamicAggregateManager.h:99 parity)
+    axis: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -95,7 +98,7 @@ class Stage:
                 return "-"
             return (f"{ex.kind}[{','.join(ex.keys)}]cap{ex.out_capacity}"
                     f"{'desc' if ex.descending else ''}"
-                    f"{ex.bounds_key or ''}")
+                    f"{ex.bounds_key or ''}@{ex.axis or '*'}")
 
         legs = ";".join(
             ",".join(op_fp(o) for o in leg.ops) + "=>" + ex_fp(leg.exchange)

@@ -1,0 +1,90 @@
+"""2-D (dcn, dp) mesh tests: the multi-host topology simulated as 2 hosts x
+4 devices on the virtual CPU mesh.  Exercises hierarchical aggregation
+(ICI hop then DCN hop), 2-hop global exchanges, broadcast over both axes."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dryad_tpu import Context
+from dryad_tpu.parallel.mesh import make_mesh
+from tests.utils import assert_same_rows
+
+
+@pytest.fixture(scope="module")
+def ctx2d():
+    return Context(mesh=make_mesh(jax.devices(), hosts=2))
+
+
+@pytest.fixture(scope="module")
+def dbg():
+    return Context(local_debug=True)
+
+
+def _mk(c, n=240, seed=0):
+    rng = np.random.RandomState(seed)
+    cols = {"k": rng.randint(0, 15, n).astype(np.int32),
+            "v": rng.randn(n).astype(np.float32)}
+    return c.from_columns(cols, capacity=48), cols
+
+
+def test_mesh_shape(ctx2d):
+    assert ctx2d.hosts == 2
+    assert ctx2d.nparts == 8
+    assert tuple(ctx2d.mesh.axis_names) == ("dcn", "dp")
+
+
+def test_hierarchical_groupby(ctx2d, dbg):
+    a, _ = _mk(ctx2d)
+    b, _ = _mk(dbg)
+    q = lambda d: d.group_by(["k"], {"n": ("count", None), "s": ("sum", "v"),
+                                     "m": ("mean", "v")})  # noqa: E731
+    plan = q(a).explain()
+    assert "groupby-ici" in plan and "groupby-dcn" in plan
+    assert_same_rows(q(a).collect(), q(b).collect())
+
+
+def test_global_sort_2hop(ctx2d, dbg):
+    a, _ = _mk(ctx2d)
+    b, _ = _mk(dbg)
+    got = a.order_by([("v", False)]).collect()
+    exp = b.order_by([("v", False)]).collect()
+    assert_same_rows(got, exp, ordered=True)
+
+
+def test_join_2hop(ctx2d, dbg):
+    def q(d):
+        dim = d.ctx.from_columns(
+            {"k": np.arange(15, dtype=np.int32),
+             "t": (np.arange(15) * 3).astype(np.int32)}, capacity=4)
+        return d.join(dim, ["k"], expansion=3.0)
+    a, _ = _mk(ctx2d)
+    b, _ = _mk(dbg)
+    assert_same_rows(q(a).collect(), q(b).collect())
+
+
+def test_broadcast_2d(ctx2d, dbg):
+    def q(d):
+        dim = d.ctx.from_columns(
+            {"k": np.arange(15, dtype=np.int32),
+             "t": (np.arange(15) * 3).astype(np.int32)}, capacity=4)
+        return d.join(dim, ["k"], expansion=3.0, broadcast=True)
+    a, _ = _mk(ctx2d)
+    b, _ = _mk(dbg)
+    assert_same_rows(q(a).collect(), q(b).collect())
+
+
+def test_wordcount_2d(ctx2d, dbg):
+    lines = [b"alpha beta gamma", b"beta gamma", b"alpha alpha"] * 16
+    def build(c):
+        return (c.from_columns({"line": lines}, str_max_len=32)
+                .split_words("line", out_capacity=64)
+                .group_by(["line"], {"n": ("count", None)}))
+    assert_same_rows(build(ctx2d).collect(), build(dbg).collect())
+
+
+def test_graft_dryrun_2d():
+    """dryrun also exercisable via the 2-host mesh shape."""
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
