@@ -320,7 +320,8 @@ class Dataset:
         """Execute and pull all rows to host (Submit + read output)."""
         if self.ctx.local_debug:
             return _oracle.run_oracle(self.node)
-        out = pdata_to_host(self._materialize())
+        from dryad_tpu.exec.data import maybe_shrink_for_collect
+        out = pdata_to_host(maybe_shrink_for_collect(self._materialize()))
         if isinstance(self.node, E.Take):
             n = self.node.n
             out = {k: v[:n] for k, v in out.items()}

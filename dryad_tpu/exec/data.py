@@ -11,6 +11,7 @@ anchor for fault tolerance), where the reference materializes temp files
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Dict, Mapping, Sequence
 
 import jax
@@ -130,6 +131,32 @@ def pdata_from_packed_strings(data: np.ndarray, lens: np.ndarray, mesh,
     return PData(batch, nparts)
 
 
+@partial(jax.jit, static_argnums=(1,))
+def _shrink_batch(batch: Batch, new_cap: int) -> Batch:
+    return jax.vmap(lambda b: b.gather(
+        jnp.arange(new_cap, dtype=jnp.int32)).with_count(b.count))(batch)
+
+
+def shrink_pdata(pd: PData, new_cap: int) -> PData:
+    """Reduce per-partition capacity (device-side) before host transfer —
+    collect() uses this so a 1M-capacity / 12-row result doesn't ship 1M
+    padded rows through PCIe/tunnel.  new_cap must cover max(counts)."""
+    return PData(_shrink_batch(pd.batch, new_cap), pd.nparts)
+
+
+def maybe_shrink_for_collect(pd: PData) -> PData:
+    counts = np.asarray(pd.counts)
+    max_n = int(counts.max()) if counts.size else 0
+    cap = pd.capacity
+    if cap <= 1024 or cap <= 4 * max(max_n, 1):
+        return pd
+    # pow2 bucket >= max_n bounds the number of shrink-program compiles
+    bucket = 1
+    while bucket < max(max_n, 1):
+        bucket *= 2
+    return shrink_pdata(pd, min(bucket, cap))
+
+
 def pdata_to_host(pd: PData) -> Dict[str, Any]:
     """Collect valid rows to host, partition order preserved."""
     counts = np.asarray(pd.counts)
@@ -138,10 +165,14 @@ def pdata_to_host(pd: PData) -> Dict[str, Any]:
         if isinstance(v, StringColumn):
             data = np.asarray(v.data)
             lens = np.asarray(v.lengths)
+            L = data.shape[2]
             vals = []
             for p in range(pd.nparts):
-                for i in range(counts[p]):
-                    vals.append(bytes(data[p, i, : lens[p, i]]))
+                n = int(counts[p])
+                flat = data[p, :n].tobytes()
+                pl = lens[p, :n].tolist()
+                vals.extend(flat[i * L: i * L + l]
+                            for i, l in enumerate(pl))
             out[k] = vals
         else:
             arr = np.asarray(v)

@@ -306,10 +306,11 @@ class Executor:
             if take > 0:
                 idx = np.linspace(0, c - 1, take).astype(np.int64)
                 samples.append(lanes[p_i, idx])
-        if not samples:
-            return jnp.zeros((self.nparts - 1,), jnp.uint32)
+        if not samples or self.nparts == 1:
+            return jnp.zeros((max(self.nparts - 1, 0),), jnp.uint32)
         s = np.sort(np.concatenate(samples).astype(np.uint64))
-        qs = [int(len(s) * (i + 1) / self.nparts) for i in range(self.nparts - 1)]
+        qs = np.asarray([len(s) * (i + 1) // self.nparts
+                         for i in range(self.nparts - 1)], np.int64)
         bounds = s[np.minimum(qs, len(s) - 1)].astype(np.uint32)
         return jnp.asarray(bounds)
 

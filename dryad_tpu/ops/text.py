@@ -68,20 +68,13 @@ def split_tokens(batch: Batch, column: str, out_capacity: int,
     start_pos = start_pos.at[scatter_idx].set(
         jnp.arange(N, dtype=jnp.int32), mode="drop")
 
-    # token length: run-length of nondelim starting at each position, via a
-    # reverse associative scan
-    def combine(a, b):
-        # run[i] = 0 if delim else run[i+1]+1: segmented suffix sum.  In a
-        # reverse associative_scan the first argument is the element further
-        # to the RIGHT, so the run of the combined span counts from b's left
-        # edge and extends into a only if b's span is all-nondelim.
-        am, ar = a
-        bm, br = b
-        return am & bm, jnp.where(bm, br + ar, br)
-
-    runs = jax.lax.associative_scan(
-        combine, (nondelim, nondelim.astype(jnp.int32)), reverse=True)[1]
-    tok_len_all = jnp.minimum(runs, max_token_len)
+    # token length = distance from each position to the next delimiter,
+    # via a single reverse cummin primitive (a custom-combine
+    # associative_scan here compiles pathologically at scale on TPU)
+    delim_pos = jnp.where(~nondelim, jnp.arange(N, dtype=jnp.int32), N)
+    next_delim = jnp.flip(jax.lax.cummin(jnp.flip(delim_pos)))
+    tok_len_all = jnp.minimum(next_delim - jnp.arange(N, dtype=jnp.int32),
+                              max_token_len)
 
     tok_valid = jnp.arange(out_capacity, dtype=jnp.int32) < jnp.minimum(
         num_tokens, out_capacity)
