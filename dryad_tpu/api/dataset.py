@@ -260,7 +260,15 @@ class Dataset:
     def group_by(self, keys: Sequence[str],
                  aggs: Dict[str, Tuple[str, Optional[str]]]) -> "Dataset":
         """GroupBy + decomposable aggregates: aggs maps output column ->
-        (kind, value_column), kind in sum/count/min/max/mean/any/all."""
+        (kind, value_column), kind in sum/count/min/max/mean/any/all.
+
+        Supported-workload assumption: groups are identified by a 64-bit
+        key hash (ops/hashing.py).  Keys that collide in all 64 bits are
+        merged — vanishingly unlikely for organic data (~n^2/2^64) but
+        possible for adversarially constructed keys; this differs from the
+        reference's GroupBy, which compares real keys
+        (DryadLinqVertex.cs:510).  ``join`` verifies true keys; ``group_by``
+        / ``distinct`` / semi-joins do not."""
         return Dataset(self.ctx, E.GroupByAgg(
             parents=(self.node,), keys=tuple(keys), aggs=dict(aggs)))
 
@@ -278,6 +286,9 @@ class Dataset:
                                            keys=tuple(keys)))
 
     def distinct(self, keys: Sequence[str] = ()) -> "Dataset":
+        """Distinct rows (by ``keys``, or all columns when empty).  Rows are
+        deduplicated by 64-bit key hash — see the supported-workload
+        assumption documented on :meth:`group_by`."""
         return Dataset(self.ctx, E.Distinct(parents=(self.node,),
                                             keys=tuple(keys)))
 

@@ -45,6 +45,24 @@ class CapacityError(RuntimeError):
     pass
 
 
+# Op kinds whose overflow is fixed by doubling out_capacity on retry.
+_SCALABLE_OVERFLOW_KINDS = {"flat_tokens", "flat_map", "join"}
+# Op kinds whose overflow CANNOT be fixed by scaling: `recap` truncates to a
+# user-fixed capacity, `sliding_window` overflows when a neighbor partition
+# lacks halo rows — retrying at a bigger scale just re-runs the same failure.
+_FIXED_OVERFLOW_KINDS = {"recap", "sliding_window"}
+
+
+def _stage_overflow_scalable(stage: Stage) -> bool:
+    """True if any overflow source in the stage responds to capacity
+    scaling (any exchange, or a scalable op kind)."""
+    kinds = {op.kind for leg in stage.legs for op in leg.ops}
+    kinds |= {op.kind for op in stage.body}
+    if kinds & _SCALABLE_OVERFLOW_KINDS:
+        return True
+    return any(leg.exchange is not None for leg in stage.legs)
+
+
 def _squeeze(b: Batch) -> Batch:
     return jax.tree.map(lambda x: x[0], b)
 
@@ -373,7 +391,22 @@ class Executor:
             if not of:
                 stage._capacity_scale = scale
                 return PData(out_batch, self.nparts)
+            if not _stage_overflow_scalable(stage):
+                raise CapacityError(
+                    f"stage {stage.id} ({stage.label}) overflowed a fixed "
+                    f"capacity (with_capacity truncation or sliding_window "
+                    f"halo) — retrying at a larger scale cannot succeed; "
+                    f"raise the declared capacity instead")
             scale *= 2
+        kinds = ({op.kind for leg in stage.legs for op in leg.ops}
+                 | {op.kind for op in stage.body})
+        hint = ""
+        if kinds & _FIXED_OVERFLOW_KINDS:
+            hint = (" — note the stage also contains a fixed-capacity op "
+                    f"({sorted(kinds & _FIXED_OVERFLOW_KINDS)}); if that is "
+                    "the overflow source, raise its declared capacity "
+                    "(scaling retries cannot fix it)")
         raise CapacityError(
             f"stage {stage.id} ({stage.label}) still overflowing after "
-            f"{_MAX_CAPACITY_RETRIES} capacity retries (scale={scale})")
+            f"{_MAX_CAPACITY_RETRIES} capacity retries (scale={scale})"
+            + hint)

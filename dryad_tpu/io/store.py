@@ -126,8 +126,14 @@ def _alloc_part_views(schema, n: int) -> Tuple[List[np.ndarray],
 
 def read_store(path: str, mesh, capacity: Optional[int] = None) -> PData:
     """Load a dataset store as sharded PData (FromStore,
-    DryadLinqContext.cs:1176).  If the store's partition count differs from
-    the mesh size, rows are re-blocked across mesh partitions."""
+    DryadLinqContext.cs:1176).
+
+    When the store's partition count equals the mesh size, store partition p
+    is loaded into mesh partition p VERBATIM (per-partition counts
+    preserved), so persisted hash/range placement — honored by
+    ``from_store`` for shuffle elimination — stays valid.  Only when the
+    counts differ are rows re-blocked evenly (and ``from_store`` then drops
+    the partitioning claim)."""
     meta = store_meta(path)
     nparts_store = meta["npartitions"]
     counts = meta["counts"]
@@ -142,45 +148,67 @@ def read_store(path: str, mesh, capacity: Optional[int] = None) -> PData:
         partviews.append(cols)
     native.read_files(paths, segments)
 
-    # concatenate store partitions then re-block over the mesh
-    host_cols: Dict[str, Any] = {}
+    if nparts_store == nparts:
+        # verbatim per-partition load: placement-preserving
+        cap = capacity or max(int(meta.get("capacity", 0)),
+                              max(counts or [0]), 1)
+        part_rows = [{k: (partviews[p][k][1:3]
+                          if schema[k]["kind"] == "str"
+                          else partviews[p][k][1])
+                      for k in schema} for p in range(nparts)]
+        return _stack_partitions(schema, part_rows, counts, cap, mesh)
+
+    # partition counts differ: concatenate store partitions then re-block
+    # over the mesh (placement-destroying; callers drop partitioning claims)
+    concat: Dict[str, Any] = {}
     for k in schema:
         if schema[k]["kind"] == "str":
-            host_cols[k] = ("str",
-                            np.concatenate([pv[k][1] for pv in partviews]),
-                            np.concatenate([pv[k][2] for pv in partviews]),
-                            schema[k]["max_len"])
+            concat[k] = (np.concatenate([pv[k][1] for pv in partviews]),
+                         np.concatenate([pv[k][2] for pv in partviews]))
         else:
-            host_cols[k] = ("dense",
-                            np.concatenate([pv[k][1] for pv in partviews]))
+            concat[k] = np.concatenate([pv[k][1] for pv in partviews])
 
     total = sum(counts)
     base, rem = divmod(total, nparts)
     sizes = [base + (1 if p < rem else 0) for p in range(nparts)]
     cap = capacity or max(1, max(sizes))
-    if cap < max(sizes or [1]):
-        raise ValueError(f"capacity {cap} < max block {max(sizes)}")
-
-    cols: Dict[str, Any] = {}
     offs = np.cumsum([0] + sizes)
-    for k, spec in host_cols.items():
-        if spec[0] == "str":
-            _, data, lens, max_len = spec
+    part_rows = [{k: ((concat[k][0][offs[p]:offs[p + 1]],
+                       concat[k][1][offs[p]:offs[p + 1]])
+                      if schema[k]["kind"] == "str"
+                      else concat[k][offs[p]:offs[p + 1]])
+                  for k in schema} for p in range(nparts)]
+    return _stack_partitions(schema, part_rows, sizes, cap, mesh)
+
+
+def _stack_partitions(schema, part_rows: List[Dict[str, Any]],
+                      counts, cap: int, mesh) -> PData:
+    """Stack per-partition row blocks into a sharded [P, cap, ...] PData.
+
+    ``part_rows[p][k]`` is either a dense array of partition p's rows or a
+    ``(data, lengths)`` pair for string columns; ``counts[p]`` rows each."""
+    nparts = len(part_rows)
+    if cap < max(list(counts) or [0]):
+        raise ValueError(f"capacity {cap} < max partition count "
+                         f"{max(counts)}")
+    cols: Dict[str, Any] = {}
+    for k, spec in schema.items():
+        if spec["kind"] == "str":
+            max_len = spec["max_len"]
             sd = np.zeros((nparts, cap, max_len), np.uint8)
             sl = np.zeros((nparts, cap), np.int32)
             for p in range(nparts):
-                s, e = offs[p], offs[p + 1]
-                sd[p, : e - s] = data[s:e]
-                sl[p, : e - s] = lens[s:e]
+                d, l = part_rows[p][k]
+                sd[p, : counts[p]] = d
+                sl[p, : counts[p]] = l
             cols[k] = StringColumn(jnp.asarray(sd), jnp.asarray(sl))
         else:
-            _, arr = spec
-            stacked = np.zeros((nparts, cap) + arr.shape[1:], arr.dtype)
+            first = part_rows[0][k]
+            stacked = np.zeros((nparts, cap) + first.shape[1:], first.dtype)
             for p in range(nparts):
-                s, e = offs[p], offs[p + 1]
-                stacked[p, : e - s] = arr[s:e]
+                stacked[p, : counts[p]] = part_rows[p][k]
             cols[k] = jnp.asarray(stacked)
-    batch = Batch(cols, jnp.asarray(sizes, jnp.int32))
+    batch = Batch(cols, jnp.asarray(np.asarray(counts), jnp.int32))
     sharding = batch_sharding(mesh)
     batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
     return PData(batch, nparts)

@@ -87,7 +87,14 @@ def pack_lines(buf: bytes, max_len: int,
         if n < 0:
             raise ValueError("pack_lines capacity exceeded")
         return data[:n], lens[:n]
-    lines = buf.splitlines()
+    # fallback mirrors dryad_pack_lines exactly: split ONLY on b"\n"
+    # (bytes.splitlines also splits on \x0b, \x0c, \x1c-\x1e, lone \r —
+    # which would make ingest differ from the native path), trim a
+    # trailing \r (CRLF), drop only the final empty piece.
+    lines = buf.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    lines = [l[:-1] if l.endswith(b"\r") else l for l in lines]
     n = len(lines)
     data = np.zeros((n, max_len), np.uint8)
     lens = np.zeros((n,), np.int32)

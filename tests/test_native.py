@@ -21,6 +21,35 @@ def test_pack_lines_matches_python():
         assert bytes(data[i][: lens[i]]) == e
 
 
+def test_pack_lines_fallback_matches_native():
+    """The pure-Python fallback must split ONLY on \\n (with CRLF trim),
+    like dryad_pack_lines — not on \\x0b/\\x0c/\\x1c-\\x1e/lone \\r the way
+    bytes.splitlines does (ADVICE r1)."""
+    buf = (b"plain\n"
+           b"vt\x0bmid\n"        # \x0b must NOT split
+           b"ff\x0cmid\n"        # \x0c must NOT split
+           b"fs\x1c\x1d\x1emid\n"
+           b"lone\rcr\n"         # lone \r mid-line must NOT split
+           b"crlf\r\n"
+           b"tail")
+    from dryad_tpu.native import pack_lines
+
+    native_res = pack_lines(buf, max_len=32)
+    # force the fallback path
+    import dryad_tpu.native as nat
+    orig = nat._load
+    nat._load = lambda: None
+    try:
+        fb_res = pack_lines(buf, max_len=32)
+    finally:
+        nat._load = orig
+    assert len(native_res[0]) == len(fb_res[0])
+    for (d1, l1), (d2, l2) in zip(zip(*native_res), zip(*fb_res)):
+        assert bytes(d1[:l1]) == bytes(d2[:l2])
+    assert bytes(fb_res[0][1][: fb_res[1][1]]) == b"vt\x0bmid"
+    assert bytes(fb_res[0][4][: fb_res[1][4]]) == b"lone\rcr"
+
+
 def test_pack_lines_truncation():
     data, lens = native.pack_lines(b"abcdefghij\nxy", max_len=4)
     assert bytes(data[0][: lens[0]]) == b"abcd"

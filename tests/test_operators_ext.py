@@ -131,3 +131,13 @@ def test_assume_hash_partition(ctx):
     _, cols = _mk(ctx)
     ref = collections.Counter(cols["k"].tolist())
     assert {int(k): int(n) for k, n in zip(out["k"], out["n"])} == dict(ref)
+
+
+def test_with_capacity_overflow_fails_fast(ctx):
+    """A with_capacity truncation overflow cannot be fixed by capacity-scale
+    retries; the executor must raise a specific CapacityError immediately
+    instead of burning 3 recompiles (ADVICE r1)."""
+    from dryad_tpu.exec.executor import CapacityError
+    ds, _ = _mk(ctx)  # 100 rows over 8 parts, up to 13/part
+    with pytest.raises(CapacityError, match="fixed capacity"):
+        ds.with_capacity(2).collect()
