@@ -16,7 +16,7 @@ import numpy as np
 
 from dryad_tpu.api.dataset import Context, Dataset
 
-__all__ = ["gen_records", "terasort_query", "terasort"]
+__all__ = ["gen_records", "terasort_query", "terasort", "terasort_ooc"]
 
 
 def gen_records(n: int, seed: int = 0, key_len: int = 10):
@@ -37,3 +37,34 @@ def terasort(ctx: Context, n: int, seed: int = 0):
     recs = gen_records(n, seed)
     ds = ctx.from_columns(recs, str_max_len=10)
     return terasort_query(ds).collect()
+
+
+def terasort_ooc(n: int, chunk_rows: int, out_store: str | None = None,
+                 seed: int = 0, n_buckets: int | None = None,
+                 spill_dir: str | None = None):
+    """Out-of-core TeraSort: generate records chunk-wise (never
+    materializing the input), externally sort with a bounded device
+    working set, optionally stream the sorted output to a store.
+
+    This is the >HBM path to BASELINE.md config 2: device memory use is
+    O(chunk_rows) regardless of n.  Returns the output store meta (when
+    ``out_store``) or an iterator of sorted host chunks.
+    """
+    from dryad_tpu.exec import ooc
+
+    n_chunks = -(-n // chunk_rows)
+
+    def gen(i: int):
+        rows = min(chunk_rows, n - i * chunk_rows)
+        return gen_records(rows, seed=seed * 1_000_003 + i)
+
+    src = ooc.ChunkSource.from_generator(gen, n_chunks, chunk_rows,
+                                         str_max_len=10)
+    sorted_chunks = ooc.external_sort(src, [("key", False)],
+                                      n_buckets=n_buckets,
+                                      spill_dir=spill_dir)
+    if out_store is None:
+        return sorted_chunks
+    return ooc.write_chunks_to_store(
+        out_store, sorted_chunks, src.schema,
+        partitioning={"kind": "range", "keys": ["key"]})

@@ -53,12 +53,15 @@ _SCALABLE_OVERFLOW_KINDS = {"flat_tokens", "flat_map", "join"}
 _FIXED_OVERFLOW_KINDS = {"recap", "sliding_window"}
 
 
+def _stage_kinds(stage: Stage) -> set:
+    return ({op.kind for leg in stage.legs for op in leg.ops}
+            | {op.kind for op in stage.body})
+
+
 def _stage_overflow_scalable(stage: Stage) -> bool:
     """True if any overflow source in the stage responds to capacity
     scaling (any exchange, or a scalable op kind)."""
-    kinds = {op.kind for leg in stage.legs for op in leg.ops}
-    kinds |= {op.kind for op in stage.body}
-    if kinds & _SCALABLE_OVERFLOW_KINDS:
+    if _stage_kinds(stage) & _SCALABLE_OVERFLOW_KINDS:
         return True
     return any(leg.exchange is not None for leg in stage.legs)
 
@@ -398,8 +401,7 @@ class Executor:
                     f"halo) — retrying at a larger scale cannot succeed; "
                     f"raise the declared capacity instead")
             scale *= 2
-        kinds = ({op.kind for leg in stage.legs for op in leg.ops}
-                 | {op.kind for op in stage.body})
+        kinds = _stage_kinds(stage)
         hint = ""
         if kinds & _FIXED_OVERFLOW_KINDS:
             hint = (" — note the stage also contains a fixed-capacity op "
