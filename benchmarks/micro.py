@@ -1,0 +1,158 @@
+"""Transport + shuffle microbenchmarks.
+
+The north-star metric (BASELINE.md config 2) is shuffle bandwidth vs line
+rate.  What "line rate" means depends on the fabric available:
+
+* multi-chip mesh: ICI all-to-all — measured by ``bench_all_to_all``;
+* one chip (this environment): the shuffle data plane is HBM (device
+  bucket scatter) + the host DMA link (chunk streaming) — measured by
+  ``bench_hbm_copy`` / ``bench_transfers``; the effective shuffle rate to
+  compare against is ``bench_exchange_effective``.
+
+Every figure is device-time fenced via block_until_ready.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bench_transfers", "bench_hbm_copy", "bench_all_to_all",
+           "bench_exchange_effective", "run_all"]
+
+
+def _time(fn, warmup: int = 1, iters: int = 3) -> float:
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_transfers(mb: int = 64) -> Dict[str, float]:
+    """Host->device and device->host GB/s (the OOC streaming line rate).
+
+    D2H must fetch a FRESH device array each iteration — jax.Array caches
+    its numpy value after the first np.asarray, so re-fetching the same
+    array measures a host memcpy, not the link."""
+    n = mb * (1 << 20)
+    host = np.random.RandomState(0).randint(0, 255, n, np.uint8)
+    dev = jax.device_put(host)
+    dev.block_until_ready()
+    bump = jax.jit(lambda a: a + jnp.uint8(1))
+    bump(dev).block_until_ready()
+
+    h2d = _time(lambda: jax.device_put(host).block_until_ready())
+
+    def d2h_once():
+        y = bump(dev)          # fresh array, negligible compute
+        y.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(y)
+        return time.perf_counter() - t0
+
+    d2h = min(d2h_once() for _ in range(2))
+    gb = n / (1 << 30)
+    return {"h2d_gbps": gb / h2d, "d2h_gbps": gb / d2h, "transfer_mb": mb}
+
+
+def bench_hbm_copy(mb: int = 512, inner: int = 8) -> Dict[str, float]:
+    """On-device copy GB/s (upper bound for device-side bucket scatter).
+
+    ``inner`` sequential passes run inside ONE jit call so a slow dispatch
+    path (e.g. a remote-compile tunnel) is amortized out of the figure."""
+    n = mb * (1 << 18)  # float32 elements
+    x = jnp.arange(n, dtype=jnp.float32)
+    x.block_until_ready()
+
+    def body(_, a):
+        return a + 1.0
+
+    f = jax.jit(lambda a: jax.lax.fori_loop(0, inner, body, a))
+    f(x).block_until_ready()
+    t = _time(lambda: f(x).block_until_ready())
+    gb = 2 * n * 4 * inner / (1 << 30)  # read + write per pass
+    return {"hbm_copy_gbps": gb / t, "hbm_copy_mb": n * 4 / (1 << 20)}
+
+
+def bench_all_to_all(mesh=None, mb_per_device: int = 64) -> Dict[str, float]:
+    """Raw all_to_all GB/s per device over the mesh's partition axis.
+
+    Only meaningful with >1 device (rides ICI on real hardware).  Returns
+    {} on a single-device mesh."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from jax import shard_map
+
+    devs = jax.devices() if mesh is None else list(mesh.devices.flat)
+    P = len(devs)
+    if P < 2:
+        return {}
+    m = Mesh(np.asarray(devs), ("dp",))
+    rows = mb_per_device * (1 << 20) // 4 // P * P
+    x = jnp.arange(P * rows, dtype=jnp.float32).reshape(P, rows)
+    x = jax.device_put(x, NamedSharding(m, PartitionSpec("dp")))
+
+    def a2a(block):
+        b = block.reshape(P, rows // P)
+        return jax.lax.all_to_all(b, "dp", 0, 0, tiled=True)
+
+    f = jax.jit(shard_map(a2a, mesh=m, in_specs=PartitionSpec("dp", None),
+                          out_specs=PartitionSpec("dp", None)))
+    f(x).block_until_ready()
+    t = _time(lambda: f(x).block_until_ready())
+    # each device sends (P-1)/P of its block
+    gb_sent = rows * 4 * (P - 1) / P / (1 << 30)
+    return {"all_to_all_gbps_per_device": gb_sent / t,
+            "all_to_all_devices": P}
+
+
+def bench_exchange_effective(rows: int = 1_000_000,
+                             n_buckets: int = 64) -> Dict[str, float]:
+    """Effective shuffle GB/s of the real single-chip exchange path: device
+    range-bucket scatter (hash lane -> stable sort -> histogram) + D2H
+    fetch — the per-chunk shuffle step of exec/ooc.external_sort."""
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.exec.ooc import _make_hash_scatter_fn
+
+    rng = np.random.RandomState(0)
+    k = rng.randint(0, 1 << 31, rows).astype(np.int32)
+    v = rng.randint(0, 1 << 31, rows).astype(np.int32)
+    b = Batch({"k": jax.device_put(k), "v": jax.device_put(v)},
+              jnp.asarray(rows, jnp.int32))
+    scatter = _make_hash_scatter_fn(("k",), n_buckets)
+
+    def run():
+        grouped, hist = scatter(b)
+        # fetch to host like the real path does
+        np.asarray(grouped.columns["k"])
+        np.asarray(grouped.columns["v"])
+        np.asarray(hist)
+
+    run()
+    t = _time(run)
+    gb = rows * 8 / (1 << 30)  # two i32 columns through scatter + D2H
+    return {"exchange_effective_gbps": gb / t, "exchange_rows": rows,
+            "exchange_buckets": n_buckets}
+
+
+def run_all() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    out.update(bench_transfers())
+    out.update(bench_hbm_copy())
+    out.update(bench_all_to_all())
+    out.update(bench_exchange_effective())
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_all(), indent=1))

@@ -12,3 +12,4 @@ __version__ = "0.1.0"
 
 from dryad_tpu.api.dataset import Context, Dataset  # noqa: F401,E402
 from dryad_tpu.parallel.mesh import make_mesh  # noqa: F401,E402
+from dryad_tpu.plan.expr import Decomposable  # noqa: F401,E402

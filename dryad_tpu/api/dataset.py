@@ -22,7 +22,23 @@ from dryad_tpu.exec.data import PData, pdata_from_host, pdata_to_host
 from dryad_tpu.exec.executor import Executor
 from dryad_tpu.parallel.mesh import make_mesh
 from dryad_tpu.plan import expr as E
+from dryad_tpu.plan.expr import Decomposable  # noqa: F401 (re-export)
 from dryad_tpu.plan.planner import plan_query
+
+
+def _const_key_like(cols):
+    """A zero int32 key column matching the batch's row count (used by the
+    whole-dataset ``aggregate`` terminal to form one global group)."""
+    import jax.numpy as jnp
+
+    v = next(iter(cols.values()))
+    if hasattr(v, "lengths"):
+        n = v.lengths.shape[0]
+    elif hasattr(v, "shape"):
+        n = v.shape[0]
+    else:
+        n = len(v)
+    return jnp.zeros((n,), jnp.int32)
 
 __all__ = ["Context", "Dataset"]
 
@@ -195,7 +211,8 @@ class Dataset:
             label=label))
 
     def zip_with(self, other: "Dataset", suffix: str = "_r") -> "Dataset":
-        """Positional pairing (Zip); requires aligned row placement."""
+        """Positional pairing by global row index (LINQ Zip).  Sides with
+        differing per-partition counts are realigned via an exchange."""
         return Dataset(self.ctx, E.Zip(parents=(self.node, other.node),
                                        suffix=suffix))
 
@@ -228,6 +245,27 @@ class Dataset:
         t = self.where(fn, label="fork_t")
         f = self.where(lambda c, _fn=fn: ~_fn(c), label="fork_f")
         return t, f
+
+    def fork(self, *predicates) -> Tuple["Dataset", ...]:
+        """n-way Fork (reference Fork, DryadLinqQueryable.cs:3717-3852 is
+        n-way): one branch per predicate over a single shared scan (the
+        parent is Tee-materialized once by the planner's consumer count).
+        Branches may overlap or under-cover; pair with fork_on for
+        disjoint key-value splits."""
+        return tuple(self.where(p, label=f"fork_{i}")
+                     for i, p in enumerate(predicates))
+
+    def fork_on(self, column: str, values: Sequence[Any]
+                ) -> Tuple["Dataset", ...]:
+        """n-way Fork by key value (the reference's Fork(keySelector,
+        keys) overload): branch i holds rows where ``column == values[i]``.
+        """
+        import jax.numpy as jnp
+
+        return tuple(
+            self.where(lambda c, _v=v: c[column] == jnp.asarray(_v),
+                       label=f"fork_{column}_{i}")
+            for i, v in enumerate(values))
 
     def assume_hash_partition(self, keys: Sequence[str]) -> "Dataset":
         """Declare existing hash placement (AssumeHashPartition,
@@ -268,17 +306,57 @@ class Dataset:
         possible for adversarially constructed keys; this differs from the
         reference's GroupBy, which compares real keys
         (DryadLinqVertex.cs:510).  ``join`` verifies true keys; ``group_by``
-        / ``distinct`` / semi-joins do not."""
+        / ``distinct`` / semi-joins do not.
+
+        An agg value may also be a ``Decomposable(seed, merge, finalize)``
+        for user-defined aggregation (IDecomposable.cs:34 parity) — see
+        ``dryad_tpu.Decomposable``."""
         return Dataset(self.ctx, E.GroupByAgg(
             parents=(self.node,), keys=tuple(keys), aggs=dict(aggs)))
 
+    def aggregate(self, dec: "E.Decomposable"):
+        """Whole-dataset user-defined aggregation (the reference's
+        user-combinable Aggregate operator, DryadLinqQueryable.cs
+        *AsQuery aggregates + IDecomposable.cs:34): runs the decomposable
+        protocol over ONE global group and returns the finalized value(s).
+        """
+        const = self.select(
+            lambda c: dict(c, __agg_key=_const_key_like(c)),
+            label="agg-key")
+        out = const.group_by(["__agg_key"], {"agg": dec}).collect()
+        res = {k: v for k, v in out.items() if k != "__agg_key"}
+        if set(res.keys()) == {"agg"}:
+            v = np.asarray(res["agg"])
+            return v[0] if v.shape and v.shape[0] == 1 else v
+        return {k: (np.asarray(v)[0] if np.asarray(v).shape
+                    and np.asarray(v).shape[0] == 1 else np.asarray(v))
+                for k, v in res.items()}
+
     def join(self, other: "Dataset", left_keys: Sequence[str],
              right_keys: Sequence[str] | None = None, expansion: float = 1.0,
-             broadcast: bool = False) -> "Dataset":
+             broadcast: bool = False, how: str = "inner") -> "Dataset":
+        """Equi-join.  how="left" keeps unmatched left rows with the right
+        columns zero-filled."""
         return Dataset(self.ctx, E.Join(
             parents=(self.node, other.node), left_keys=tuple(left_keys),
             right_keys=tuple(right_keys or left_keys), expansion=expansion,
-            broadcast_right=broadcast))
+            broadcast_right=broadcast, how=how))
+
+    def group_join(self, other: "Dataset", left_keys: Sequence[str],
+                   aggs: Dict[str, Any],
+                   right_keys: Sequence[str] | None = None,
+                   expansion: float = 1.0) -> "Dataset":
+        """GroupJoin (reference DryadLinqQueryable GroupJoin /
+        DLinqGroupByNode): each left row is paired with the AGGREGATE of
+        its matching right group.  Lowered as right.group_by(keys, aggs)
+        followed by a left-outer join, so empty groups appear with
+        zero/neutral aggregate values (include a ("count", None) agg to
+        distinguish empties).  aggs values may be builtin kinds or
+        Decomposables."""
+        rkeys = list(right_keys or left_keys)
+        agg = other.group_by(rkeys, aggs)
+        return self.join(agg, left_keys, rkeys, expansion=expansion,
+                         how="left")
 
     def order_by(self, keys: Sequence[Tuple[str, bool]]) -> "Dataset":
         """Global sort; keys = [(column, descending), ...]."""
@@ -338,14 +416,17 @@ class Dataset:
             out = {k: v[:n] for k, v in out.items()}
         return out
 
-    def to_store(self, path: str) -> None:
+    def to_store(self, path: str, compression: str | None = None) -> None:
         """Execute and persist (ToStore + Submit,
-        DryadLinqQueryable.cs:3909,4032)."""
+        DryadLinqQueryable.cs:3909,4032).  ``compression="gzip"`` enables
+        the per-partition compression transform (reference
+        GzipCompressionChannelTransform.cpp)."""
         from dryad_tpu.io.store import write_store
         pd = self._materialize()
         part = self.node.partitioning
         write_store(path, pd, partitioning={"kind": part.kind,
-                                            "keys": list(part.keys)})
+                                            "keys": list(part.keys)},
+                    compression=compression)
 
     def count(self) -> int:
         if self.ctx.local_debug:

@@ -41,7 +41,7 @@ def terasort(ctx: Context, n: int, seed: int = 0):
 
 def terasort_ooc(n: int, chunk_rows: int, out_store: str | None = None,
                  seed: int = 0, n_buckets: int | None = None,
-                 spill_dir: str | None = None):
+                 spill_dir: str | None = None, depth: int = 2):
     """Out-of-core TeraSort: generate records chunk-wise (never
     materializing the input), externally sort with a bounded device
     working set, optionally stream the sorted output to a store.
@@ -62,7 +62,7 @@ def terasort_ooc(n: int, chunk_rows: int, out_store: str | None = None,
                                          str_max_len=10)
     sorted_chunks = ooc.external_sort(src, [("key", False)],
                                       n_buckets=n_buckets,
-                                      spill_dir=spill_dir)
+                                      spill_dir=spill_dir, depth=depth)
     if out_store is None:
         return sorted_chunks
     return ooc.write_chunks_to_store(

@@ -222,9 +222,11 @@ def string_column_from_list(strings: Sequence[bytes | str], capacity: int,
 
 
 def string_column_to_list(col: StringColumn, count: int) -> list:
+    from dryad_tpu import native
+
     data = np.asarray(col.data)
     lengths = np.asarray(col.lengths)
-    return [bytes(data[i, : lengths[i]]) for i in range(count)]
+    return native.unpack_rows(data[:count], lengths[:count])
 
 
 def batch_from_numpy(columns: Mapping[str, Any], capacity: int | None = None,

@@ -159,20 +159,18 @@ def maybe_shrink_for_collect(pd: PData) -> PData:
 
 def pdata_to_host(pd: PData) -> Dict[str, Any]:
     """Collect valid rows to host, partition order preserved."""
+    from dryad_tpu import native
+
     counts = np.asarray(pd.counts)
     out: Dict[str, Any] = {}
     for k, v in pd.batch.columns.items():
         if isinstance(v, StringColumn):
             data = np.asarray(v.data)
             lens = np.asarray(v.lengths)
-            L = data.shape[2]
             vals = []
             for p in range(pd.nparts):
                 n = int(counts[p])
-                flat = data[p, :n].tobytes()
-                pl = lens[p, :n].tolist()
-                vals.extend(flat[i * L: i * L + l]
-                            for i, l in enumerate(pl))
+                vals.extend(native.unpack_rows(data[p, :n], lens[p, :n]))
             out[k] = vals
         else:
             arr = np.asarray(v)

@@ -30,6 +30,7 @@ deployment runs one OOC stream per host feeding the sharded exchanges.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from collections import deque
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
@@ -206,7 +207,7 @@ class ChunkSource:
         partition, slicing each into chunks.  Individual partitions must fit
         host RAM; the dataset as a whole need not."""
         from dryad_tpu.io.store import (_alloc_part_views, _part_path,
-                                        store_meta)
+                                        store_meta, verify_checksums)
         from dryad_tpu import native
 
         meta = store_meta(path)
@@ -216,7 +217,10 @@ class ChunkSource:
             for p in range(meta["npartitions"]):
                 cnt = meta["counts"][p]
                 segs, cols = _alloc_part_views(schema, cnt)
-                native.read_files([_part_path(path, p)], [segs])
+                native.read_files(
+                    [_part_path(path, p)], [segs],
+                    compress=(meta.get("compression") == "gzip"))
+                verify_checksums(path, meta, [segs], partitions=[p])
                 hc = {k: ((cols[k][1], cols[k][2])
                           if cols[k][0] == "str" else cols[k][1])
                       for k in schema}
@@ -390,9 +394,15 @@ def _sample_bounds(src: ChunkSource, key: str, n_buckets: int,
     return _bounds_from_samples(samples, n_buckets)
 
 
+@functools.lru_cache(maxsize=256)
 def _make_scatter_fn(key: str, n_buckets: int):
     """Device fn: chunk Batch + bounds -> rows grouped by range bucket,
-    with per-bucket counts."""
+    with per-bucket counts.
+
+    lru_cache'd on the static params so repeated external_sort calls reuse
+    the SAME jitted callable — a fresh closure per call would miss jax's
+    compile cache and re-XLA-compile every run (3-40s each on a
+    remote-compile tunnel)."""
 
     def fn(b: Batch, bounds: jax.Array):
         from dryad_tpu.parallel.shuffle import range_dest_lane
@@ -408,6 +418,7 @@ def _make_scatter_fn(key: str, n_buckets: int):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=256)
 def _make_hash_scatter_fn(keys: Sequence[str], n_buckets: int):
     def fn(b: Batch):
         _, lo = hash_batch_keys(b, list(keys))
@@ -419,6 +430,11 @@ def _make_hash_scatter_fn(keys: Sequence[str], n_buckets: int):
         return grouped, hist
 
     return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
+def _make_sort_fn(keys: Tuple[Tuple[str, bool], ...]):
+    return jax.jit(lambda b: kernels.sort_by_columns(b, list(keys)))
 
 
 class _BucketStore:
@@ -597,7 +613,7 @@ def external_sort(src: ChunkSource, keys: Sequence[Tuple[str, bool]],
         drain_one()
 
     # pass C: per-bucket sort + emit in bucket order
-    sort_fn = jax.jit(lambda b: kernels.sort_by_columns(b, list(keys)))
+    sort_fn = _make_sort_fn(tuple(keys))
     order = range(nb - 1, -1, -1) if desc0 else range(nb)
     try:
         for i in order:
@@ -642,7 +658,7 @@ def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
     probe = _batch_to_chunk(pagg(_chunk_to_batch(
         HChunk.empty_like(src.schema), 1)))
     pschema = chunk_schema(probe)
-    scatter = _make_hash_scatter_fn(list(keys), n_buckets)
+    scatter = _make_hash_scatter_fn(tuple(keys), n_buckets)
 
     buckets: List[List[HChunk]] = [[] for _ in range(n_buckets)]
     bucket_rows = [0] * n_buckets
@@ -699,7 +715,8 @@ def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
 
 def write_chunks_to_store(path: str, chunks: Iterable[HChunk],
                           schema: Dict[str, Any],
-                          partitioning: Optional[Dict[str, Any]] = None
+                          partitioning: Optional[Dict[str, Any]] = None,
+                          compression: Optional[str] = None
                           ) -> Dict[str, Any]:
     """Stream chunks to a store directory (io/store.py layout), one
     partition file per chunk, committed atomically via temp-dir rename."""
@@ -708,6 +725,7 @@ def write_chunks_to_store(path: str, chunks: Iterable[HChunk],
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     counts: List[int] = []
+    checksums: List[str] = []
     store_schema: Dict[str, Any] = {}
     for k, spec in schema.items():
         if spec["kind"] == "str":
@@ -725,17 +743,22 @@ def write_chunks_to_store(path: str, chunks: Iterable[HChunk],
                 segs.append(np.ascontiguousarray(v[1]))
             else:
                 segs.append(np.ascontiguousarray(v))
-        native.write_files([os.path.join(tmp, f"part-{p:05d}.bin")], [segs])
+        native.write_files([os.path.join(tmp, f"part-{p:05d}.bin")], [segs],
+                           compress=(compression == "gzip"))
+        checksums.append("%016x" % native.checksum_segments(segs))
         counts.append(chunk.n)
         p += 1
     import json
     meta = {
-        "format_version": 2,
+        "format_version": 3,
         "npartitions": p,
         "counts": counts,
         "capacity": max(counts or [1]),
         "schema": store_schema,
         "partitioning": partitioning or {"kind": "none"},
+        "compression": compression,
+        "checksum_algo": "fnv64",
+        "checksums": checksums,
         "native_io": native.available(),
     }
     with open(os.path.join(tmp, "meta.json"), "w") as f:

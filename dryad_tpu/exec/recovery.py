@@ -38,11 +38,13 @@ class Run:
     def __init__(self, executor, graph: StageGraph,
                  bindings: Optional[Dict[str, PData]] = None,
                  spill_dir: Optional[str] = None,
-                 failure_budget: int = 16):
+                 failure_budget: int = 16,
+                 spill_compression: Optional[str] = None):
         self.ex = executor
         self.graph = graph
         self.bindings = bindings or {}
         self.spill_dir = spill_dir
+        self.spill_compression = spill_compression
         self.failure_budget = failure_budget
         self.failures = 0
         self._results: Dict[int, PData] = {}
@@ -98,7 +100,8 @@ class Run:
         if not self.spill_dir:
             return
         from dryad_tpu.io.store import write_store
-        write_store(self._spill_path(sid), pd)
+        write_store(self._spill_path(sid), pd,
+                    compression=self.spill_compression)
         self.ex._event({"event": "stage_spilled", "stage": sid})
 
     def _load_spill(self, sid: int) -> Optional[PData]:

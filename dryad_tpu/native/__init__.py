@@ -58,6 +58,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
         lib.dryad_fingerprint.restype = ctypes.c_uint64
         lib.dryad_fingerprint.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dryad_compact_rows.restype = ctypes.c_int64
+        lib.dryad_compact_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.dryad_fingerprint_seed.restype = ctypes.c_uint64
+        lib.dryad_fingerprint_seed.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         _lib = lib
         return _lib
 
@@ -141,21 +148,30 @@ def pack_bytes_list(items: Sequence[bytes], max_len: int, capacity: int
 
 
 def _file_jobs(paths: List[str], segments: List[List[np.ndarray]],
-               write: bool, nthreads: int = 8) -> None:
+               write: bool, nthreads: int = 8,
+               compress: bool = False) -> None:
     n = len(paths)
     if n == 0:
         return
     lib = _load()
     if lib is None:
+        import gzip as _gz
+
+        opener = (lambda p, m: _gz.open(p, m, compresslevel=1)) \
+            if compress else open
         for p, segs in zip(paths, segments):
             if write:
-                with open(p, "wb") as f:
+                with opener(p, "wb") as f:
                     for s in segs:
                         f.write(memoryview(np.ascontiguousarray(s)).cast("B"))
             else:
-                with open(p, "rb") as f:
+                with opener(p, "rb") as f:
                     for s in segs:
-                        f.readinto(memoryview(s).cast("B"))
+                        mv = memoryview(s).cast("B")
+                        if compress:
+                            mv[:] = f.read(mv.nbytes)
+                        else:
+                            f.readinto(mv)
         return
     flat_ptrs, flat_lens, offsets = [], [], [0]
     keep = []
@@ -171,32 +187,98 @@ def _file_jobs(paths: List[str], segments: List[List[np.ndarray]],
     c_ptrs = (ctypes.c_void_p * nseg)(*flat_ptrs)
     lens_arr = np.asarray(flat_lens, np.int64)
     offs_arr = np.asarray(offsets, np.int64)
+    mode = (1 if write else 0) + (2 if compress else 0)
     rc = lib.dryad_file_jobs(
         c_paths, n, c_ptrs, lens_arr.ctypes.data_as(ctypes.c_void_p),
-        offs_arr.ctypes.data_as(ctypes.c_void_p),
-        1 if write else 0, nthreads)
+        offs_arr.ctypes.data_as(ctypes.c_void_p), mode, nthreads)
     if rc != 0:
         raise IOError(f"native file job failed: {paths[int(rc) - 1]}")
 
 
 def write_files(paths: List[str], segments: List[List[np.ndarray]],
-                nthreads: int = 8) -> None:
-    _file_jobs(paths, segments, write=True, nthreads=nthreads)
+                nthreads: int = 8, compress: bool = False) -> None:
+    _file_jobs(paths, segments, write=True, nthreads=nthreads,
+               compress=compress)
 
 
 def read_files(paths: List[str], segments: List[List[np.ndarray]],
-               nthreads: int = 8) -> None:
+               nthreads: int = 8, compress: bool = False) -> None:
     """Read each file's bytes contiguously into the given (preallocated,
     writable) arrays."""
-    _file_jobs(paths, segments, write=False, nthreads=nthreads)
+    _file_jobs(paths, segments, write=False, nthreads=nthreads,
+               compress=compress)
+
+
+def compact_rows(data: np.ndarray, lens: np.ndarray
+                 ) -> Tuple[bytes, np.ndarray]:
+    """Compact a padded [n, max_len] u8 matrix into (packed bytes,
+    offsets[n+1] i64): row i is packed[offs[i]:offs[i+1]].  Native single
+    pass when built; numpy mask-gather fallback.  The egress counterpart of
+    pack_bytes_list — collect()'s string columns avoid copying padding."""
+    n, L = data.shape
+    lens = np.ascontiguousarray(lens[:n], np.int32)
+    data = np.ascontiguousarray(data)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(int(np.clip(lens, 0, L).sum()), np.uint8)
+        offs = np.empty(n + 1, np.int64)
+        lib.dryad_compact_rows(
+            data.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.c_void_p), n, L,
+            out.ctypes.data_as(ctypes.c_void_p),
+            offs.ctypes.data_as(ctypes.c_void_p))
+        return out.tobytes(), offs
+    cl = np.clip(lens, 0, L)
+    mask = np.arange(L)[None, :] < cl[:, None]
+    packed = data[mask].tobytes()
+    offs = np.concatenate([[0], np.cumsum(cl, dtype=np.int64)])
+    return packed, offs
+
+
+def unpack_rows(data: np.ndarray, lens: np.ndarray) -> List[bytes]:
+    """Padded byte matrix -> list of per-row bytes (native compaction +
+    zero-padding-free slicing)."""
+    packed, offs = compact_rows(data, lens)
+    return [packed[offs[i]: offs[i + 1]] for i in range(data.shape[0])]
+
+
+_FNV_BASIS = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def _fnv_py(data: bytes, seed: int = _FNV_BASIS) -> int:
+    h = seed
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
 
 
 def fingerprint(buf) -> int:
+    """64-bit FNV-1a.  The Python fallback computes the SAME function as
+    the native path (a fallback must never change the digest — the store
+    records fnv64 checksums that any environment must be able to verify)."""
     lib = _load()
     arr = np.ascontiguousarray(np.frombuffer(buf, np.uint8) if
                                isinstance(buf, (bytes, bytearray)) else buf)
     if lib is None:
-        import zlib
-        return zlib.crc32(arr.tobytes())
+        return _fnv_py(arr.tobytes())
     return int(lib.dryad_fingerprint(
         arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes))
+
+
+def checksum_segments(segments: Sequence[np.ndarray]) -> int:
+    """Chained fnv64 over a partition's segment list (no concatenation):
+    store integrity checksums (the role of the reference's channel
+    fingerprints, classlib fingerprint.cpp)."""
+    lib = _load()
+    h = _FNV_BASIS
+    for s in segments:
+        s = np.ascontiguousarray(s)
+        view = s.view(np.uint8).reshape(-1)
+        if lib is None:
+            h = _fnv_py(view.tobytes(), h)
+        else:
+            h = int(lib.dryad_fingerprint_seed(
+                view.ctypes.data_as(ctypes.c_void_p), view.nbytes,
+                ctypes.c_uint64(h)))
+    return h

@@ -30,7 +30,9 @@ from dryad_tpu.ops.hashing import hash_batch_keys
 
 __all__ = [
     "compact", "filter_rows", "sort_by_columns", "group_aggregate",
-    "distinct", "scalar_aggregate", "hash_join", "semi_anti_join",
+    "group_decompose_partial", "group_decompose_merge",
+    "group_decompose_local", "distinct",
+    "scalar_aggregate", "hash_join", "semi_anti_join",
     "concat2", "take", "AGG_KINDS",
 ]
 
@@ -248,6 +250,144 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     return Batch(out_cols, num_groups)
 
 
+# ---------------------------------------------------------------------------
+# user-defined decomposable aggregation (IDecomposable parity)
+
+
+def _segmented_merge(seg: jax.Array, states, merge_fn):
+    """Reduce an arbitrary associative ``merge_fn`` over each segment.
+
+    TPU-idiomatic segmented reduction: a single ``associative_scan`` over
+    rows carrying (segment id, state); the combine keeps the right operand
+    where segments differ, so each segment's LAST row ends up holding the
+    full segment reduction.  This is what lets *user-defined* aggregations
+    (reference IDecomposable.cs:34 Accumulate/RecursiveAccumulate) run as
+    one fused XLA op instead of a per-group loop.
+    """
+
+    def combine(a, b):
+        sa, va = a
+        sb, vb = b
+        same = sa == sb
+
+        def pick(x, y):
+            m = same.reshape(same.shape + (1,) * (x.ndim - 1))
+            return jnp.where(m, x, y)
+
+        merged = merge_fn(va, vb)
+        out = jax.tree.map(pick, merged, vb)
+        return sb, out
+
+    _, scanned = jax.lax.associative_scan(combine, (seg, states))
+    return scanned
+
+
+def _last_row_per_segment(seg: jax.Array, cap: int,
+                          num_groups: jax.Array) -> jax.Array:
+    last_idx = jax.ops.segment_max(
+        jnp.arange(cap, dtype=jnp.int32), seg, num_segments=cap)
+    return jnp.where(jnp.arange(cap) < num_groups, last_idx, 0)
+
+
+def _group_states(batch: Batch, key_names: Sequence[str],
+                  decs: Dict[str, Tuple], state_box: Dict):
+    """Shared seed+segmented-merge machinery: returns (key out_cols,
+    out -> per-group merged state pytree, num_groups, valid_rows mask)."""
+    sb, seg, is_start, num_groups = _group_segments(batch, key_names)
+    cap = batch.capacity
+
+    out_cols = {}
+    rep = sb.gather(_first_row_per_segment(seg, cap, num_groups))
+    for k in key_names:
+        out_cols[k] = rep.columns[k]
+
+    last = _last_row_per_segment(seg, cap, num_groups)
+    valid_rows = jnp.arange(cap) < num_groups
+    merged_states = {}
+    for out_name, (seed, merge_fn, _fin) in decs.items():
+        states = seed(dict(sb.columns))
+        state_box[out_name] = jax.tree.structure(states)
+        scanned = _segmented_merge(seg, states, merge_fn)
+        merged_states[out_name] = jax.tree.map(
+            lambda l: jnp.take(l, last, axis=0), scanned)
+    return out_cols, merged_states, num_groups, valid_rows
+
+
+def _emit_finalized(out_cols, out_name, fin, merged, valid_rows):
+    val = fin(merged) if fin is not None else merged
+    named = val if isinstance(val, dict) else {out_name: val}
+    for cname, v in named.items():
+        m = valid_rows.reshape(valid_rows.shape + (1,) * (v.ndim - 1))
+        out_cols[cname] = jnp.where(m, v, 0)
+
+
+def group_decompose_partial(batch: Batch, key_names: Sequence[str],
+                            decs: Dict[str, Tuple], state_box: Dict
+                            ) -> Batch:
+    """Map-side combine for user-defined decomposable aggregates.
+
+    decs: out_name -> (seed, merge, finalize) callables.  ``seed(columns)``
+    maps the row columns to a state pytree (vectorized over rows);
+    ``merge(a, b)`` is the associative combine.  Output: key columns + the
+    flattened state leaves as columns ``{out}@{i}``; the treedefs are
+    published into ``state_box`` for the merge/finalize stage
+    (reference IDecomposable.cs:34 Initialize/Seed/Accumulate).
+    """
+    out_cols, merged_states, num_groups, valid_rows = _group_states(
+        batch, key_names, decs, state_box)
+    for out_name, merged in merged_states.items():
+        for i, leaf in enumerate(jax.tree.leaves(merged)):
+            m = valid_rows.reshape(valid_rows.shape + (1,) * (leaf.ndim - 1))
+            out_cols[f"{out_name}@{i}"] = jnp.where(m, leaf, 0)
+    return Batch(out_cols, num_groups)
+
+
+def group_decompose_local(batch: Batch, key_names: Sequence[str],
+                          decs: Dict[str, Tuple], state_box: Dict) -> Batch:
+    """Single-pass decomposable GroupBy (co-located input): seed + merge +
+    FinalReduce in one fused kernel."""
+    out_cols, merged_states, num_groups, valid_rows = _group_states(
+        batch, key_names, decs, state_box)
+    for out_name, merged in merged_states.items():
+        fin = decs[out_name][2]
+        _emit_finalized(out_cols, out_name, fin, merged, valid_rows)
+    return Batch(out_cols, num_groups)
+
+
+def group_decompose_merge(batch: Batch, key_names: Sequence[str],
+                          decs: Dict[str, Tuple], state_box: Dict,
+                          finalize: bool) -> Batch:
+    """Reduce-side merge of partial states (columns ``{out}@{i}``), plus
+    FinalReduce when ``finalize`` (reference IDecomposable.cs:34
+    RecursiveAccumulate/FinalReduce)."""
+    sb, seg, is_start, num_groups = _group_segments(batch, key_names)
+    cap = batch.capacity
+
+    out_cols = {}
+    rep = sb.gather(_first_row_per_segment(seg, cap, num_groups))
+    for k in key_names:
+        out_cols[k] = rep.columns[k]
+
+    last = _last_row_per_segment(seg, cap, num_groups)
+    valid_rows = jnp.arange(cap) < num_groups
+    for out_name, (_seed, merge_fn, fin) in decs.items():
+        treedef = state_box[out_name]
+        n_leaves = treedef.num_leaves
+        leaves = [sb.columns[f"{out_name}@{i}"] for i in range(n_leaves)]
+        states = jax.tree.unflatten(treedef, leaves)
+        scanned = _segmented_merge(seg, states, merge_fn)
+        merged = jax.tree.map(
+            lambda l: jnp.take(l, last, axis=0), scanned)
+        if finalize:
+            _emit_finalized(out_cols, out_name, fin, merged, valid_rows)
+        else:
+            for i, leaf in enumerate(jax.tree.leaves(merged)):
+                m = valid_rows.reshape(
+                    valid_rows.shape + (1,) * (leaf.ndim - 1))
+                out_cols[f"{out_name}@{i}"] = jnp.where(m, leaf, 0)
+    return Batch(out_cols, num_groups)
+
+
 def distinct(batch: Batch, key_names: Sequence[str] | None = None) -> Batch:
     """One representative row per distinct key (all columns kept)."""
     keys = list(key_names) if key_names else sorted(batch.names)
@@ -319,9 +459,18 @@ def _keys_equal(a: Batch, a_idx, a_names, b: Batch, b_idx, b_names) -> jax.Array
 
 def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
               right_keys: Sequence[str], out_capacity: int,
-              suffix: str = "_r") -> Tuple[Batch, jax.Array]:
-    """Inner equi-join; output columns = left columns + right non-key columns
+              suffix: str = "_r", how: str = "inner"
+              ) -> Tuple[Batch, jax.Array]:
+    """Equi-join; output columns = left columns + right non-key columns
     (right name suffixed on collision).  Returns ``(batch, overflow)``.
+
+    ``how="left"``: left rows without a match emit ONE row with the right
+    columns zero-filled (the GroupJoin empty-group case — reference
+    DryadLinqQueryable GroupJoin; pair with a count aggregate to
+    distinguish empty groups).  A left row whose only hash candidates are
+    64-bit-collision false positives could be misclassified as matched-less
+    output being dropped — probability ~2^-32 per pair, same collision
+    budget documented on group_by.
 
     Output capacity is the static ``out_capacity``.  ``overflow`` is a
     conservative bool: True whenever the number of *candidate* pairs (hash
@@ -355,6 +504,12 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     start = jnp.searchsorted(rkey, lh, side="left")
     stop = jnp.searchsorted(rkey, lh, side="right")
     mult = jnp.where(lvalid, stop - start, 0)
+    if how == "left":
+        # unmatched left rows still occupy one output slot (synthetic)
+        synth_row = lvalid & (mult == 0)
+        mult = jnp.where(synth_row, 1, mult)
+    elif how != "inner":
+        raise ValueError(f"unknown join how={how!r}")
 
     # output slot -> (left row, right row) via prefix sums
     cum = jnp.cumsum(mult)
@@ -372,6 +527,9 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     # are unspecified and may hold stale real keys
     eq = _keys_equal(left, lid_c, left_keys, rs, rid, right_keys)
     keep = slot_valid & eq & (rid < right.count)
+    if how == "left":
+        synth_slot = slot_valid & jnp.take(synth_row, lid_c)
+        keep = keep | synth_slot
 
     out_cols = {}
     for k, v in left.columns.items():
@@ -382,8 +540,21 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
         if k in rkeyset:
             continue
         name = k if k not in out_cols else k + suffix
-        out_cols[name] = v.gather(rid) if isinstance(v, StringColumn) \
-            else jnp.take(v, rid, axis=0)
+        if isinstance(v, StringColumn):
+            g = v.gather(rid)
+            if how == "left":
+                z = synth_slot
+                g = StringColumn(
+                    jnp.where(z[:, None], 0, g.data),
+                    jnp.where(z, 0, g.lengths))
+            out_cols[name] = g
+        else:
+            g = jnp.take(v, rid, axis=0)
+            if how == "left":
+                z = synth_slot.reshape(
+                    synth_slot.shape + (1,) * (g.ndim - 1))
+                g = jnp.where(z, 0, g)
+            out_cols[name] = g
     joined = Batch(out_cols, keep.sum(dtype=jnp.int32))
     perm = jnp.argsort(~keep, stable=True)
     out = joined.gather(perm)

@@ -82,6 +82,35 @@ def _agg(kind: str, vals: List[Any]):
     raise ValueError(kind)
 
 
+def _eval_decomposable(dec: "E.Decomposable", t: Dict[str, Any],
+                       idx: List[int], oname: str) -> Dict[str, Any]:
+    """Sequential-reference evaluation of a Decomposable over one group:
+    seed each row, left-fold merge, finalize.  Mirrors the kernel's
+    segmented-scan semantics exactly (same seed/merge/finalize callables,
+    applied per single-row state)."""
+    import functools
+
+    import jax
+
+    def row_state(i):
+        cols = {}
+        for k, v in t.items():
+            if isinstance(v, list):  # bytes column
+                raise NotImplementedError(
+                    "decomposable aggregates over string columns are "
+                    "oracle-opaque")
+            cols[k] = np.asarray(v)[i: i + 1]
+        return dec.seed(cols)
+
+    states = [row_state(i) for i in idx]
+    merged = functools.reduce(dec.merge, states)
+    val = dec.finalize(merged) if dec.finalize is not None else merged
+    named = val if isinstance(val, dict) else {oname: val}
+    return {k: np.asarray(v)[0] if np.asarray(v).shape
+            and np.asarray(v).shape[0] == 1 else np.asarray(v)
+            for k, v in named.items()}
+
+
 def _key_of(row: dict, keys) -> tuple:
     names = keys if keys else sorted(row.keys())
     out = []
@@ -203,15 +232,23 @@ def run_oracle(root: E.Node, bindings: Dict[str, Table] | None = None) -> Table:
                     order.append(k)
                 groups[k].append(i)
             out: Table = {k: [] for k in n.keys}
-            for oname in n.aggs:
-                out[oname] = []
+            agg_out_names: List[str] = []
             for k in order:
                 idx = groups[k]
                 for kk, kv in zip(n.keys, k):
                     out[kk].append(kv)
-                for oname, (kind, col) in n.aggs.items():
-                    vals = [t[col][i] for i in idx] if col else [None] * len(idx)
-                    out[oname].append(_agg(kind, vals))
+                for oname, spec in n.aggs.items():
+                    if isinstance(spec, E.Decomposable):
+                        named = _eval_decomposable(spec, t, idx, oname)
+                    else:
+                        kind, col = spec
+                        vals = [t[col][i] for i in idx] if col \
+                            else [None] * len(idx)
+                        named = {oname: _agg(kind, vals)}
+                    for cname, v in named.items():
+                        out.setdefault(cname, []).append(v)
+                        if cname not in agg_out_names:
+                            agg_out_names.append(cname)
             return {k: (v if v and isinstance(v[0], bytes) else np.asarray(v))
                     for k, v in out.items()}
         if isinstance(n, E.Join):
@@ -225,15 +262,30 @@ def run_oracle(root: E.Node, bindings: Dict[str, Table] | None = None) -> Table:
             out_names = list(lt.keys()) + [
                 (k if k not in lt else k + "_r") for k in rextra]
             out: Table = {k: [] for k in out_names}
+            how = getattr(n, "how", "inner")
             for i in range(_nrows(lt)):
                 k = _key_of({kk: lt[kk][i] for kk in n.left_keys},
                             tuple(n.left_keys))
-                for j in rmap.get(k, ()):
+                matches = rmap.get(k, ())
+                for j in matches:
                     for kk in lt.keys():
                         out[kk].append(lt[kk][i])
                     for kk in rextra:
                         name = kk if kk not in lt else kk + "_r"
                         out[name].append(rt[kk][j])
+                if how == "left" and not matches:
+                    # unmatched left row: right columns zero-filled
+                    for kk in lt.keys():
+                        out[kk].append(lt[kk][i])
+                    for kk in rextra:
+                        name = kk if kk not in lt else kk + "_r"
+                        proto = rt[kk]
+                        if isinstance(proto, list):
+                            out[name].append(b"")
+                        else:
+                            z = np.zeros((1,) + np.asarray(proto).shape[1:],
+                                         np.asarray(proto).dtype)
+                            out[name].append(z[0])
             return {k: (v if v and isinstance(v[0], bytes) else np.asarray(v))
                     for k, v in out.items()}
         if isinstance(n, E.OrderBy):

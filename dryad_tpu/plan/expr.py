@@ -163,16 +163,36 @@ class ApplyPerPartition(Node):
         return Partitioning.none()
 
 
+@dataclasses.dataclass(frozen=True)
+class Decomposable:
+    """User-defined decomposable aggregate (IDecomposable.cs:34 parity:
+    Initialize/Seed -> ``seed``, Accumulate/RecursiveAccumulate ->
+    ``merge``, FinalReduce -> ``finalize``).
+
+    * ``seed(columns) -> state``: map the row columns (arrays, vectorized
+      over rows) to a state pytree;
+    * ``merge(a, b) -> state``: ASSOCIATIVE combine of two states
+      (elementwise over rows — it runs inside a segmented scan);
+    * ``finalize(state) -> value | dict[str, value]``: per-group result
+      (None = identity; a dict fans out to multiple columns).
+    """
+
+    seed: Any
+    merge: Any
+    finalize: Any = None
+
+
 @_node
 class GroupByAgg(Node):
     """GroupBy + decomposable aggregation.
-    aggs: out_name -> (kind, value_col | None).
+    aggs: out_name -> (kind, value_col | None) builtin aggregate, or a
+    ``Decomposable`` for user-defined seed/merge/finalize.
     Reference: DLinqGroupByNode (DryadLinqQueryNode.cs:1581) +
     IDecomposable (IDecomposable.cs:34)."""
 
     parents: Tuple[Node, ...]
     keys: Tuple[str, ...]
-    aggs: Dict[str, Tuple[str, Optional[str]]]
+    aggs: Dict[str, Any]
 
     @property
     def partitioning(self) -> Partitioning:
@@ -181,13 +201,16 @@ class GroupByAgg(Node):
 
 @_node
 class Join(Node):
-    """Inner equi-join.  Reference: DLinqJoinNode (DryadLinqQueryNode.cs:2053)."""
+    """Equi-join (inner, or left-outer with zero-filled right columns).
+    Reference: DLinqJoinNode (DryadLinqQueryNode.cs:2053); how="left" is
+    the GroupJoin empty-group case."""
 
     parents: Tuple[Node, ...]  # (left, right)
     left_keys: Tuple[str, ...]
     right_keys: Tuple[str, ...]
     expansion: float = 1.0  # out_capacity multiplier over left capacity
     broadcast_right: bool = False
+    how: str = "inner"
 
     @property
     def npartitions(self) -> int:
@@ -302,10 +325,11 @@ class FlatMap(Node):
 
 @_node
 class Zip(Node):
-    """Pairwise combination by position (shorter-side semantics).  The
-    distributed form pairs rows within aligned partitions; use on datasets
-    with identical row placement (e.g. same source through row-local ops).
-    Reference: DryadLinqQueryable Zip."""
+    """Pairwise combination by GLOBAL position (shorter-side semantics).
+    Lowered to a realignment exchange: right rows move to the partition
+    holding the same global row index on the left, so misaligned
+    per-partition counts (e.g. after a filter) pair correctly
+    (parallel/shuffle.zip_exchange).  Reference: DryadLinqQueryable Zip."""
 
     parents: Tuple[Node, ...]  # (left, right)
     suffix: str = "_r"

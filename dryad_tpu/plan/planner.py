@@ -61,6 +61,65 @@ def _decompose_aggs(aggs: Dict[str, Tuple[str, Optional[str]]]):
     return partial, final, mean_cols
 
 
+# Builtin aggregate kinds as Decomposable (seed, merge, finalize) triples —
+# used when a group_by mixes builtin kinds with user-defined Decomposables
+# so the whole aggregation runs through one segmented-scan path.
+def _rowcount_of(cols) -> int:
+    v = next(iter(cols.values()))
+    return v.lengths.shape[0] if hasattr(v, "lengths") else v.shape[0]
+
+
+def _builtin_as_decomposable(kind: str, col: Optional[str]):
+    import jax.numpy as jnp
+
+    if kind == "count":
+        return E.Decomposable(
+            lambda c: jnp.ones(_rowcount_of(c), jnp.int32),
+            lambda a, b: a + b, None)
+    if kind == "sum":
+        return E.Decomposable(lambda c: c[col], lambda a, b: a + b, None)
+    if kind == "min":
+        return E.Decomposable(lambda c: c[col], jnp.minimum, None)
+    if kind == "max":
+        return E.Decomposable(lambda c: c[col], jnp.maximum, None)
+    if kind == "any":
+        return E.Decomposable(lambda c: c[col].astype(jnp.bool_),
+                              lambda a, b: a | b, None)
+    if kind == "all":
+        return E.Decomposable(lambda c: c[col].astype(jnp.bool_),
+                              lambda a, b: a & b, None)
+    if kind == "mean":
+        def fin(s):
+            tot, cnt = s
+            cf = jnp.maximum(cnt, 1)
+            return tot / cf.astype(tot.dtype) \
+                if jnp.issubdtype(tot.dtype, jnp.floating) \
+                else tot.astype(jnp.float32) / cf
+        return E.Decomposable(
+            lambda c: (c[col],
+                       jnp.ones(c[col].shape[0], jnp.int32)),
+            lambda a, b: (a[0] + b[0], a[1] + b[1]), fin)
+    raise ValueError(f"aggregate kind {kind!r} not decomposable")
+
+
+def _normalize_decs(aggs: Dict[str, Any]) -> Dict[str, Tuple]:
+    """aggs (builtin tuples and/or Decomposables) -> out -> (seed, merge,
+    finalize) triples."""
+    out = {}
+    for name, spec in aggs.items():
+        if isinstance(spec, E.Decomposable):
+            out[name] = (spec.seed, spec.merge, spec.finalize)
+        else:
+            kind, col = spec
+            d = _builtin_as_decomposable(kind, col)
+            out[name] = (d.seed, d.merge, d.finalize)
+    return out
+
+
+def _has_user_decs(aggs: Dict[str, Any]) -> bool:
+    return any(isinstance(v, E.Decomposable) for v in aggs.values())
+
+
 def _mean_post_fn(mean_cols: List[str]):
     import jax.numpy as jnp
 
@@ -116,6 +175,49 @@ class Planner:
             self.frags[n.id] = frag
         out_id, _ = self._materialize(self.frags[root.id], label="output")
         return StageGraph(self.stages, out_id)
+
+    def _lower_group_decomposable(self, n: "E.GroupByAgg", f: Fragment,
+                                  keys: Tuple[str, ...]) -> Fragment:
+        """GroupBy with user-defined Decomposable aggregates: seed+merge
+        map-side combine -> hash exchange of flattened states -> merge (+
+        FinalReduce).  The state treedefs travel through a shared box
+        filled at partial-trace time (partial stages always trace before
+        their merge stages).  Reference: IDecomposable.cs:34 feeding the
+        GM's aggregation trees."""
+        decs = _normalize_decs(n.aggs)
+        box: Dict[str, Any] = {}  # shared mutable plan state (treedefs)
+        if self.nparts == 1 or (f.partitioning.kind == "hash"
+                                and f.partitioning.keys == keys):
+            f.ops.append(StageOp("dgroup_local", {"keys": keys,
+                                                  "decs": decs, "box": box}))
+            f.partitioning = E.Partitioning("hash", keys)
+            return f
+        f.ops.append(StageOp("dgroup_partial", {"keys": keys, "decs": decs,
+                                                "box": box}))
+        if self.hosts > 1:
+            ex1 = Exchange("hash", keys=keys, out_capacity=f.capacity,
+                           axis="dp")
+            st1 = self._new_stage(
+                [Leg(f.src, f.ops, ex1)],
+                [StageOp("dgroup_merge", {"keys": keys, "decs": decs,
+                                          "box": box, "finalize": False})],
+                "dgroupby-ici")
+            ex2 = Exchange("hash", keys=keys, out_capacity=f.capacity,
+                           axis="dcn")
+            st2 = self._new_stage(
+                [Leg(st1.id, [], ex2)],
+                [StageOp("dgroup_merge", {"keys": keys, "decs": decs,
+                                          "box": box, "finalize": True})],
+                "dgroupby-dcn")
+            return Fragment(st2.id, [], f.capacity,
+                            E.Partitioning("hash", keys))
+        ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
+        st = self._new_stage(
+            [Leg(f.src, f.ops, ex)],
+            [StageOp("dgroup_merge", {"keys": keys, "decs": decs,
+                                      "box": box, "finalize": True})],
+            "dgroupby")
+        return Fragment(st.id, [], f.capacity, E.Partitioning("hash", keys))
 
     def _frag(self, n: E.Node) -> Fragment:
         f = self.frags[n.id]
@@ -216,8 +318,8 @@ class Planner:
         if isinstance(n, E.CrossApply):
             lf = self._frag(n.parents[0])
             rf = self._frag(n.parents[1])
-            rex = Exchange("broadcast",
-                           out_capacity=rf.capacity * self.nparts)
+            rex = None if self.nparts == 1 else Exchange(
+                "broadcast", out_capacity=rf.capacity * self.nparts)
             st = self._new_stage(
                 [Leg(lf.src, lf.ops, None), Leg(rf.src, rf.ops, rex)],
                 [StageOp("apply2", {"fn": n.fn, "label": n.label})],
@@ -227,6 +329,16 @@ class Planner:
         if isinstance(n, E.GroupByAgg):
             f = self._frag(n.parents[0])
             keys = tuple(n.keys)
+            if _has_user_decs(n.aggs):
+                return self._lower_group_decomposable(n, f, keys)
+            if self.nparts == 1:
+                # single partition: everything is trivially co-located; the
+                # partial/exchange/merge pipeline would be 3 extra full-batch
+                # sorts for nothing
+                f.ops.append(StageOp("group", {"keys": keys,
+                                               "aggs": dict(n.aggs)}))
+                f.partitioning = E.Partitioning("hash", keys)
+                return f
             if f.partitioning.kind == "hash" and f.partitioning.keys == keys:
                 # partition elimination: already co-located by these keys
                 f.ops.append(StageOp("group", {"keys": keys, "aggs": dict(n.aggs)}))
@@ -268,6 +380,9 @@ class Planner:
         if isinstance(n, E.Distinct):
             f = self._frag(n.parents[0])
             keys = tuple(n.keys)
+            if self.nparts == 1:
+                f.ops.append(StageOp("distinct", {"keys": keys}))
+                return f
             if f.partitioning.kind == "hash" and f.partitioning.keys == keys \
                     and keys:
                 f.ops.append(StageOp("distinct", {"keys": keys}))
@@ -284,7 +399,9 @@ class Planner:
             rf = self._frag(n.parents[1])
             lkeys, rkeys = tuple(n.left_keys), tuple(n.right_keys)
             out_cap = max(1, int(lf.capacity * n.expansion))
-            if n.broadcast_right:
+            if self.nparts == 1:
+                lex = rex = None
+            elif n.broadcast_right:
                 rex = Exchange("broadcast",
                                out_capacity=rf.capacity * self.nparts)
                 lex = None
@@ -298,7 +415,8 @@ class Planner:
             st = self._new_stage(
                 [Leg(lf.src, lf.ops, lex), Leg(rf.src, rf.ops, rex)],
                 [StageOp("join", {"left_keys": lkeys, "right_keys": rkeys,
-                                  "out_capacity": out_cap})], "join")
+                                  "out_capacity": out_cap,
+                                  "how": n.how})], "join")
             # broadcast join keeps the LEFT side's distribution (each
             # partition holds matches for its own left rows only)
             out_part = lf.partitioning if n.broadcast_right \
@@ -307,6 +425,11 @@ class Planner:
 
         if isinstance(n, E.OrderBy):
             f = self._frag(n.parents[0])
+            if self.nparts == 1:
+                f.ops.append(StageOp("sort", {"keys": tuple(n.keys)}))
+                f.partitioning = E.Partitioning(
+                    "range", tuple(k for k, _ in n.keys))
+                return f
             src_id, f = self._materialize(f, label="sort-input")
             primary, desc = n.keys[0]
             ex = Exchange("range", keys=(primary,), out_capacity=f.capacity,
@@ -325,8 +448,10 @@ class Planner:
             lf.ops.append(StageOp("distinct", {"keys": ()}))
             if n.op != "union":
                 rf.ops.append(StageOp("distinct", {"keys": ()}))
-            lex = Exchange("hash", keys=(), out_capacity=lf.capacity)
-            rex = Exchange("hash", keys=(), out_capacity=rf.capacity)
+            lex = rex = None
+            if self.nparts > 1:
+                lex = Exchange("hash", keys=(), out_capacity=lf.capacity)
+                rex = Exchange("hash", keys=(), out_capacity=rf.capacity)
             # the per-leg distinct dedups within a partition; after the
             # exchange, copies arriving from different partitions are
             # co-located, so a post-exchange distinct finishes the dedup
@@ -359,6 +484,9 @@ class Planner:
 
         if isinstance(n, E.HashRepartition):
             f = self._frag(n.parents[0])
+            if self.nparts == 1:
+                f.partitioning = E.Partitioning("hash", tuple(n.keys))
+                return f
             ex = Exchange("hash", keys=tuple(n.keys), out_capacity=f.capacity)
             st = self._new_stage([Leg(f.src, f.ops, ex)], [], "hashpartition")
             return Fragment(st.id, [], f.capacity,
@@ -366,6 +494,9 @@ class Planner:
 
         if isinstance(n, E.RangeRepartition):
             f = self._frag(n.parents[0])
+            if self.nparts == 1:
+                f.partitioning = E.Partitioning("range", tuple(n.keys))
+                return f
             src_id, f = self._materialize(f, label="range-input")
             key = n.keys[0]
             ex = Exchange("range", keys=(key,), out_capacity=f.capacity,
@@ -376,6 +507,9 @@ class Planner:
 
         if isinstance(n, E.Broadcast):
             f = self._frag(n.parents[0])
+            if self.nparts == 1:
+                f.partitioning = E.Partitioning("replicated")
+                return f
             ex = Exchange("broadcast",
                           out_capacity=f.capacity * self.nparts)
             st = self._new_stage([Leg(f.src, f.ops, ex)], [], "broadcast")

@@ -30,8 +30,16 @@ def _op_to_json(op: StageOp, fn_names: Dict[int, str]) -> dict:
         elif isinstance(v, tuple):
             params[k] = {"__tuple__": list(v)}
         elif isinstance(v, dict):
-            params[k] = {"__dict__": {kk: list(vv) if isinstance(vv, tuple)
-                                      else vv for kk, vv in v.items()}}
+            try:
+                enc = {kk: list(vv) if isinstance(vv, tuple) else vv
+                       for kk, vv in v.items()}
+                json.dumps(enc)
+                params[k] = {"__dict__": enc}
+            except TypeError:
+                # opaque structured param (e.g. decomposable seed/merge/
+                # finalize triples, treedef boxes): structurally noted only;
+                # re-execution re-binds via fn_table like other UDFs
+                params[k] = {"__opaque__": f"{op.kind}.{k}"}
         else:
             params[k] = v
     return {"kind": op.kind, "params": params}
@@ -48,6 +56,13 @@ def _op_from_json(d: dict, fn_table: Optional[Dict[str, Callable]]) -> StageOp:
             params[k] = fn_table[name]
         elif isinstance(v, dict) and "__bytes__" in v:
             params[k] = v["__bytes__"].encode("latin1")
+        elif isinstance(v, dict) and "__opaque__" in v:
+            name = v["__opaque__"]
+            if fn_table is None or name not in fn_table:
+                raise KeyError(
+                    f"plan references opaque param {name!r}; pass it in "
+                    f"fn_table")
+            params[k] = fn_table[name]
         elif isinstance(v, dict) and "__tuple__" in v:
             params[k] = tuple(tuple(x) if isinstance(x, list) else x
                               for x in v["__tuple__"])

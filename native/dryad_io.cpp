@@ -19,6 +19,8 @@
 #include <thread>
 #include <vector>
 
+#include <zlib.h>
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -109,12 +111,55 @@ static int read_one(const char* path, const Seg* segs, int64_t nsegs) {
   return 0;
 }
 
+// gzip variants (level-1 deflate): the per-channel compression transform of
+// the reference (GzipCompressionChannelTransform.cpp; job-level intermediate
+// compression mode, GraphManager DrGraph.cpp:47).
+// gz IO takes unsigned (32-bit) lengths: loop in <=256MB slices so
+// segments >= 2 GB neither truncate nor wrap the success check.
+static const int64_t kGzSlice = 1LL << 28;
+
+static int write_one_gz(const char* path, const Seg* segs, int64_t nsegs) {
+  gzFile f = gzopen(path, "wb1");
+  if (!f) return -1;
+  gzbuffer(f, 1 << 20);
+  for (int64_t s = 0; s < nsegs; ++s) {
+    for (int64_t off = 0; off < segs[s].len; off += kGzSlice) {
+      int64_t n = segs[s].len - off;
+      if (n > kGzSlice) n = kGzSlice;
+      if (gzwrite(f, segs[s].ptr + off, (unsigned)n) != (int)n) {
+        gzclose(f);
+        return -1;
+      }
+    }
+  }
+  return gzclose(f) == Z_OK ? 0 : -1;
+}
+
+static int read_one_gz(const char* path, const Seg* segs, int64_t nsegs) {
+  gzFile f = gzopen(path, "rb");
+  if (!f) return -1;
+  gzbuffer(f, 1 << 20);
+  for (int64_t s = 0; s < nsegs; ++s) {
+    for (int64_t off = 0; off < segs[s].len; off += kGzSlice) {
+      int64_t n = segs[s].len - off;
+      if (n > kGzSlice) n = kGzSlice;
+      if (gzread(f, (void*)(segs[s].ptr + off), (unsigned)n) != (int)n) {
+        gzclose(f);
+        return -1;
+      }
+    }
+  }
+  gzclose(f);
+  return 0;
+}
+
 // paths: array of n C strings; seg_offsets: n+1 prefix offsets into the
 // flat segs arrays.  write=1 writes, 0 reads.  Returns 0 on success, else
 // the (1-based) index of the first failed job.
+// mode: 0 = read, 1 = write, 2 = read gzip, 3 = write gzip
 int64_t dryad_file_jobs(const char** paths, int64_t n,
                         const uint8_t** seg_ptrs, const int64_t* seg_lens,
-                        const int64_t* seg_offsets, int32_t write,
+                        const int64_t* seg_offsets, int32_t mode,
                         int32_t nthreads) {
   if (nthreads < 1) nthreads = 1;
   if (nthreads > 64) nthreads = 64;
@@ -128,8 +173,17 @@ int64_t dryad_file_jobs(const char** paths, int64_t n,
       segs.reserve((size_t)(s1 - s0));
       for (int64_t s = s0; s < s1; ++s)
         segs.push_back(Seg{seg_ptrs[s], seg_lens[s]});
-      int rc = write ? write_one(paths[i], segs.data(), (int64_t)segs.size())
-                     : read_one(paths[i], segs.data(), (int64_t)segs.size());
+      int rc;
+      switch (mode) {
+        case 1: rc = write_one(paths[i], segs.data(),
+                               (int64_t)segs.size()); break;
+        case 2: rc = read_one_gz(paths[i], segs.data(),
+                                 (int64_t)segs.size()); break;
+        case 3: rc = write_one_gz(paths[i], segs.data(),
+                                  (int64_t)segs.size()); break;
+        default: rc = read_one(paths[i], segs.data(),
+                               (int64_t)segs.size());
+      }
       if (rc != 0) failed.store(i + 1);
     }
   };
@@ -141,10 +195,46 @@ int64_t dryad_file_jobs(const char** paths, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// Row compaction: padded [n, max_len] byte matrix + lengths -> contiguous
+// packed bytes + (n+1) offsets.  The egress mirror of dryad_pack_bytes:
+// collect()'s string columns compact here in one native pass instead of
+// copying per-row padding through Python (the reference streams records out
+// through DryadLinqBinaryWriter; our egress is a single packed buffer).
+// Returns total packed bytes.
+int64_t dryad_compact_rows(const uint8_t* data, const int32_t* lens,
+                           int64_t n, int64_t max_len, uint8_t* out,
+                           int64_t* out_offs) {
+  int64_t o = 0;
+  out_offs[0] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t l = lens[i];
+    if (l < 0) l = 0;
+    if (l > max_len) l = max_len;
+    std::memcpy(out + o, data + i * max_len, (size_t)l);
+    o += l;
+    out_offs[i + 1] = o;
+  }
+  return o;
+}
+
+// ---------------------------------------------------------------------------
 // 64-bit FNV-1a (host-side content fingerprinting for store integrity —
 // the role of the reference's Rabin fingerprints, classlib fingerprint.cpp).
 uint64_t dryad_fingerprint(const uint8_t* buf, int64_t len) {
   uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= buf[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// Streaming form: chain over multiple segments by passing the previous
+// return as `seed` (start with DRYAD_FNV_BASIS).  Used to fingerprint a
+// partition's segment list without concatenating.
+uint64_t dryad_fingerprint_seed(const uint8_t* buf, int64_t len,
+                                uint64_t seed) {
+  uint64_t h = seed;
   for (int64_t i = 0; i < len; ++i) {
     h ^= buf[i];
     h *= 1099511628211ULL;

@@ -109,3 +109,24 @@ def test_read_text_native(tmp_path):
     got = {k.decode(): int(v) for k, v in zip(out["line"], out["n"])}
     assert got == {"the": 100, "quick": 50, "fox": 50, "jumps": 50,
                    "over": 50, "lazy": 50, "dog": 50}
+
+
+def test_compact_rows_native_matches_fallback():
+    rng = np.random.RandomState(0)
+    n, L = 1_000, 12
+    data = rng.randint(0, 255, (n, L), np.uint8)
+    lens = rng.randint(0, L + 1, n).astype(np.int32)
+    lens[5] = 0
+    packed, offs = native.compact_rows(data, lens)
+    assert offs[-1] == lens.sum() == len(packed)
+    import dryad_tpu.native as nat
+    orig = nat._load
+    nat._load = lambda: None
+    try:
+        p2, o2 = native.compact_rows(data, lens)
+    finally:
+        nat._load = orig
+    assert p2 == packed and np.array_equal(o2, offs)
+    rows = native.unpack_rows(data, lens)
+    for i in range(0, n, 97):
+        assert rows[i] == bytes(data[i, : lens[i]])

