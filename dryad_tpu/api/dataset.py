@@ -48,15 +48,50 @@ class Context:
 
     def __init__(self, mesh=None, local_debug: bool = False,
                  event_log: Optional[Callable[[dict], None]] = None,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 cluster=None, fn_table: Optional[Mapping[str, Any]] = None):
+        self.cluster = cluster
+        self.fn_table = dict(fn_table or {})
+        self.local_debug = local_debug
+        self.spill_dir = spill_dir
+        if cluster is not None:
+            # multi-process mode (runtime.LocalCluster): the driver owns no
+            # devices; plans + deferred sources ship to the worker gang
+            # (LocalJobSubmission.cs:97-302 parity).  Workers build a 2-D
+            # (dcn, dp) mesh with dcn = the process boundary.
+            self.mesh = None
+            self.nparts = cluster.nparts
+            self.hosts = (cluster.n_processes
+                          if cluster.n_processes > 1 else 1)
+            self.executor = None
+            self._event_log = event_log
+            return
         self.mesh = mesh if mesh is not None else make_mesh()
         self.nparts = self.mesh.devices.size
         # 2-D (dcn, dp) meshes trigger hierarchical aggregation plans
         self.hosts = (self.mesh.devices.shape[0]
                       if len(self.mesh.axis_names) == 2 else 1)
-        self.local_debug = local_debug
-        self.spill_dir = spill_dir
         self.executor = Executor(self.mesh, event_log=event_log)
+
+    # -- cluster submission -------------------------------------------------
+
+    def _cluster_run(self, node, collect: bool = True,
+                     store_path: Optional[str] = None,
+                     store_partitioning: Optional[Dict[str, Any]] = None):
+        """Plan, serialize, and submit one query to the worker gang."""
+        from dryad_tpu.runtime.shiplan import serialize_for_cluster
+        graph = plan_query(node, self.nparts, hosts=self.hosts)
+        plan_json, specs = serialize_for_cluster(graph, self.fn_table)
+        # route worker events to THIS context's logger for the duration of
+        # the job (several Contexts may share one cluster)
+        prev_log = self.cluster.event_log
+        self.cluster.event_log = self._event_log
+        try:
+            return self.cluster.execute(plan_json, specs, collect=collect,
+                                        store_path=store_path,
+                                        store_partitioning=store_partitioning)
+        finally:
+            self.cluster.event_log = prev_log
 
     # -- dataset constructors ---------------------------------------------
 
@@ -65,6 +100,14 @@ class Context:
                      str_max_len: int = 64) -> "Dataset":
         """Create a partitioned dataset from host columns (FromEnumerable,
         DryadLinqContext.cs:1210)."""
+        if self.cluster is not None:
+            from dryad_tpu.runtime.sources import (DeferredSource,
+                                                   columns_spec)
+            spec = columns_spec(columns, self.nparts, capacity=capacity,
+                                str_max_len=str_max_len)
+            node = E.Source(parents=(), data=DeferredSource(spec),
+                            _npartitions=self.nparts, host=dict(columns))
+            return Dataset(self, node)
         pdata = pdata_from_host(columns, self.mesh, nparts=self.nparts,
                                 capacity=capacity, str_max_len=str_max_len)
         node = E.Source(parents=(), data=pdata, _npartitions=self.nparts,
@@ -84,6 +127,13 @@ class Context:
         """Read a text file as one record per line (FromStore for LineRecord,
         DryadLinqContext.cs:1176 + LineRecord.cs).  Line splitting + padding
         runs in the native IO engine when built."""
+        if self.cluster is not None:
+            from dryad_tpu.runtime.sources import DeferredSource, text_spec
+            spec = text_spec(path, self.nparts, column=column,
+                             max_line_len=max_line_len)
+            node = E.Source(parents=(), data=DeferredSource(spec),
+                            _npartitions=self.nparts)
+            return Dataset(self, node)
         from dryad_tpu import native
         from dryad_tpu.exec.data import pdata_from_packed_strings
         with open(path, "rb") as f:
@@ -101,13 +151,19 @@ class Context:
         (AssumeHashPartition parity, DryadLinqQueryable.cs:3408)."""
         from dryad_tpu.io.store import read_store, store_meta
         meta = store_meta(path)
-        pdata = read_store(path, self.mesh, capacity=capacity)
         pmeta = meta.get("partitioning", {"kind": "none"})
         part = E.Partitioning(pmeta.get("kind", "none"),
                               tuple(pmeta.get("keys", ())))
         # re-blocking across a different mesh size destroys hash placement
         if meta["npartitions"] != self.nparts:
             part = E.Partitioning.none()
+        if self.cluster is not None:
+            from dryad_tpu.runtime.sources import DeferredSource, store_spec
+            spec = store_spec(path, self.nparts, meta, capacity=capacity)
+            node = E.Source(parents=(), data=DeferredSource(spec),
+                            _npartitions=self.nparts, _partitioning=part)
+            return Dataset(self, node)
+        pdata = read_store(path, self.mesh, capacity=capacity)
         return self.from_pdata(pdata, partitioning=part)
 
     # -- iteration ---------------------------------------------------------
@@ -125,6 +181,11 @@ class Context:
         are compiled once and reused (shapes are stable).  ``cond`` (host
         predicate on the collected current table) can stop early.
         """
+        if self.cluster is not None:
+            raise NotImplementedError(
+                "do_while is not yet supported in cluster mode — run "
+                "iterative queries in-process or checkpoint per iteration "
+                "via to_store/from_store")
         if self.local_debug:
             cur_host = _oracle.run_oracle(init.node)
             ph = E.Placeholder(parents=(), name="__loop",
@@ -409,8 +470,12 @@ class Dataset:
         """Execute and pull all rows to host (Submit + read output)."""
         if self.ctx.local_debug:
             return _oracle.run_oracle(self.node)
-        from dryad_tpu.exec.data import maybe_shrink_for_collect
-        out = pdata_to_host(maybe_shrink_for_collect(self._materialize()))
+        if self.ctx.cluster is not None:
+            out = self.ctx._cluster_run(self.node)
+        else:
+            from dryad_tpu.exec.data import maybe_shrink_for_collect
+            out = pdata_to_host(
+                maybe_shrink_for_collect(self._materialize()))
         if isinstance(self.node, E.Take):
             n = self.node.n
             out = {k: v[:n] for k, v in out.items()}
@@ -422,8 +487,17 @@ class Dataset:
         the per-partition compression transform (reference
         GzipCompressionChannelTransform.cpp)."""
         from dryad_tpu.io.store import write_store
-        pd = self._materialize()
         part = self.node.partitioning
+        if self.ctx.cluster is not None:
+            if compression is not None:
+                raise NotImplementedError(
+                    "to_store(compression=...) in cluster mode")
+            self.ctx._cluster_run(
+                self.node, collect=False, store_path=path,
+                store_partitioning={"kind": part.kind,
+                                    "keys": list(part.keys)})
+            return
+        pd = self._materialize()
         write_store(path, pd, partitioning={"kind": part.kind,
                                             "keys": list(part.keys)},
                     compression=compression)
@@ -434,6 +508,9 @@ class Dataset:
             for v in t.values():
                 return len(v)
             return 0
+        if self.ctx.cluster is not None:
+            # counts-only reduction: no row data crosses the control plane
+            return self.ctx._cluster_run(self.node, collect="count")
         return self._materialize().total_rows()
 
     def _scalar(self, kind: str, column: str):
@@ -445,6 +522,9 @@ class Dataset:
         from dryad_tpu import oracle as orc
         if self.ctx.local_debug:
             t = _oracle.run_oracle(self.node)
+            return orc._agg(kind, list(t[column]))
+        if self.ctx.cluster is not None:
+            t = self.ctx._cluster_run(self.node)
             return orc._agg(kind, list(t[column]))
         pd = self._materialize()
         import jax

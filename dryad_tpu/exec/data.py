@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Dict, Mapping, Sequence
+from typing import Any, Dict, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -22,7 +22,70 @@ from dryad_tpu.data.columnar import (Batch, StringColumn,
                                      string_column_from_list)
 from dryad_tpu.parallel.mesh import batch_sharding
 
-__all__ = ["PData", "pdata_from_host", "pdata_to_host"]
+__all__ = ["PData", "pdata_from_host", "pdata_to_host", "put_batch",
+           "replicate_tree", "collect_replicated"]
+
+
+def mesh_is_multiprocess(mesh) -> bool:
+    """True when the mesh spans more than one OS process (runtime cluster
+    mode) — host<->device placement must then go through per-process
+    addressable shards instead of whole-array device_put."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def put_batch(tree, mesh):
+    """Place a host pytree onto the mesh with the standard partition
+    sharding.  Single-process: plain device_put.  Multi-process: every
+    process holds the same full host value and fills only its addressable
+    shards (jax.make_array_from_callback) — the runtime-cluster analogue of
+    the reference's per-vertex input channel reads."""
+    sharding = batch_sharding(mesh)
+    if not mesh_is_multiprocess(mesh):
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
+    return jax.tree.map(put, tree)
+
+
+def replicate_tree(tree, mesh):
+    """All-gather a sharded pytree to a fully-replicated layout so every
+    process can read it host-side (multihost-safe np.asarray)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(lambda t: t, out_shardings=rep)(tree)
+
+
+def shrink_bucket_cap(counts: np.ndarray, cap: int) -> int | None:
+    """Shared shrink-before-collect policy: pow2 bucket >= max count when
+    the capacity is grossly oversized, else None (no shrink)."""
+    max_n = int(counts.max()) if counts.size else 0
+    if cap <= 1024 or cap <= 4 * max(max_n, 1):
+        return None
+    bucket = 1
+    while bucket < max(max_n, 1):
+        bucket *= 2
+    return min(bucket, cap)
+
+
+def collect_replicated(pd: "PData", mesh,
+                       unpack: bool = True) -> Optional[Dict[str, Any]]:
+    """Multi-process collect: shrink (deterministically, mirrored on every
+    process), replicate over the mesh, and unpack host-side.  All processes
+    must call this (the replication is a collective); pass ``unpack=False``
+    on processes that don't need the host table (they return None without
+    paying the host-side string unpack)."""
+    counts = np.asarray(replicate_tree(pd.batch.count, mesh))
+    new_cap = shrink_bucket_cap(counts, pd.capacity)
+    if new_cap is not None:
+        pd = shrink_pdata(pd, new_cap)
+    rep = replicate_tree(pd.batch, mesh)
+    if not unpack:
+        return None
+    return pdata_to_host(PData(rep, pd.nparts))
 
 
 @dataclasses.dataclass
@@ -92,17 +155,15 @@ def pdata_from_host(columns: Mapping[str, Any], mesh, nparts: int | None = None,
             for p, (s, e) in enumerate(slices):
                 sd[p, : e - s] = data[s:e]
                 sl[p, : e - s] = lens[s:e]
-            cols[k] = StringColumn(jnp.asarray(sd), jnp.asarray(sl))
+            cols[k] = StringColumn(sd, sl)
         else:
             arr = np.asarray(v)
             stacked = np.zeros((nparts, cap) + arr.shape[1:], arr.dtype)
             for p, (s, e) in enumerate(slices):
                 stacked[p, : e - s] = arr[s:e]
-            cols[k] = jnp.asarray(stacked)
-    counts = jnp.asarray([e - s for s, e in slices], jnp.int32)
-    batch = Batch(cols, counts)
-    sharding = batch_sharding(mesh)
-    batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+            cols[k] = stacked
+    counts = np.asarray([e - s for s, e in slices], np.int32)
+    batch = put_batch(Batch(cols, counts), mesh)
     return PData(batch, nparts)
 
 
@@ -124,10 +185,9 @@ def pdata_from_packed_strings(data: np.ndarray, lens: np.ndarray, mesh,
     for p, (s, e) in enumerate(slices):
         sd[p, : e - s] = data[s:e]
         sl[p, : e - s] = lens[s:e]
-    batch = Batch({column: StringColumn(jnp.asarray(sd), jnp.asarray(sl))},
-                  jnp.asarray([e - s for s, e in slices], jnp.int32))
-    sharding = batch_sharding(mesh)
-    batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    batch = put_batch(Batch({column: StringColumn(sd, sl)},
+                            np.asarray([e - s for s, e in slices],
+                                       np.int32)), mesh)
     return PData(batch, nparts)
 
 
@@ -145,16 +205,9 @@ def shrink_pdata(pd: PData, new_cap: int) -> PData:
 
 
 def maybe_shrink_for_collect(pd: PData) -> PData:
-    counts = np.asarray(pd.counts)
-    max_n = int(counts.max()) if counts.size else 0
-    cap = pd.capacity
-    if cap <= 1024 or cap <= 4 * max(max_n, 1):
-        return pd
-    # pow2 bucket >= max_n bounds the number of shrink-program compiles
-    bucket = 1
-    while bucket < max(max_n, 1):
-        bucket *= 2
-    return shrink_pdata(pd, min(bucket, cap))
+    # pow2 buckets bound the number of shrink-program compiles
+    new_cap = shrink_bucket_cap(np.asarray(pd.counts), pd.capacity)
+    return pd if new_cap is None else shrink_pdata(pd, new_cap)
 
 
 def pdata_to_host(pd: PData) -> Dict[str, Any]:

@@ -104,6 +104,11 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
     if k == "fn":
         new = p["fn"](dict(b.columns))
         return Batch(dict(new), b.count), no
+    if k == "mean_fin":
+        # structured mean finalization (sum/cnt -> mean) so the op
+        # serializes for cluster shipping (runtime/shiplan.py)
+        return Batch(kernels.mean_finalize_columns(dict(b.columns),
+                                                   p["cols"]), b.count), no
     if k == "filter":
         return kernels.compact(b, p["fn"](dict(b.columns))), no
     if k == "flat_tokens":
@@ -295,6 +300,12 @@ class Executor:
         self.axes = tuple(mesh.axis_names)
         self.nparts = mesh.devices.size
         self._event = event_log or (lambda e: None)
+        # Multi-process (runtime-cluster) mode: host-side reads of sharded
+        # values (overflow flags, sample lanes, counts) must first replicate
+        # over the mesh — every process executes the same replication
+        # collective, then reads its local copy.
+        from dryad_tpu.exec.data import mesh_is_multiprocess
+        self._multiproc = mesh_is_multiprocess(mesh)
         # bounded LRU keyed by stage structure + input shapes, so identical
         # re-plans (same Dataset collected twice, do_while bodies) reuse
         # compiled programs instead of growing without bound
@@ -355,8 +366,13 @@ class Executor:
         if self.nparts == 1:
             return jnp.zeros((0,), jnp.uint32)
         col = src.batch.columns[key]
-        lanes = np.asarray(_sample_lanes(col, src.counts))  # [P, S] u32
-        counts = np.asarray(src.counts)
+        lanes = _sample_lanes(col, src.counts)  # [P, S] u32
+        counts = src.counts
+        if self._multiproc:
+            from dryad_tpu.exec.data import replicate_tree
+            lanes, counts = replicate_tree((lanes, counts), self.mesh)
+        lanes = np.asarray(lanes)
+        counts = np.asarray(counts)
         samples = []
         for p_i in range(src.nparts):
             take = min(int(counts[p_i]), _SAMPLES_PER_PART)
@@ -421,6 +437,9 @@ class Executor:
                 args.append(bounds)
             t0 = time.time()
             out_batch, overflow = fn(*args)
+            if self._multiproc:
+                from dryad_tpu.exec.data import replicate_tree
+                overflow = replicate_tree(overflow, self.mesh)
             of = bool(np.asarray(overflow).any())
             self._event({"event": "stage_done", "stage": stage.id,
                          "label": stage.label, "attempt": attempt,

@@ -643,16 +643,16 @@ def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
     yielded.  Distinct keys per bucket must fit chunk capacity; raise
     ``n_buckets`` for higher-cardinality keys.
     """
-    from dryad_tpu.plan.planner import _decompose_aggs, _mean_post_fn
+    from dryad_tpu.plan.planner import _decompose_aggs
 
     partial, final, mean_cols = _decompose_aggs(dict(aggs))
     chunk_rows = src.chunk_rows
 
     pagg = jax.jit(lambda b: kernels.group_aggregate(b, list(keys), partial))
     merge = jax.jit(lambda b: kernels.group_aggregate(b, list(keys), final))
-    post = _mean_post_fn(mean_cols)
     finalize = jax.jit(
-        lambda b: Batch(post(dict(b.columns)), b.count))
+        lambda b: Batch(kernels.mean_finalize_columns(dict(b.columns),
+                                                      mean_cols), b.count))
 
     # schema of partial outputs (probe with an empty chunk)
     probe = _batch_to_chunk(pagg(_chunk_to_batch(

@@ -24,13 +24,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 from dryad_tpu import native
 from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.exec.data import PData
-from dryad_tpu.parallel.mesh import batch_sharding
 
 __all__ = ["write_store", "read_store", "store_meta",
            "StoreIntegrityError"]
@@ -243,14 +239,13 @@ def _stack_partitions(schema, part_rows: List[Dict[str, Any]],
                 d, l = part_rows[p][k]
                 sd[p, : counts[p]] = d
                 sl[p, : counts[p]] = l
-            cols[k] = StringColumn(jnp.asarray(sd), jnp.asarray(sl))
+            cols[k] = StringColumn(sd, sl)
         else:
             first = part_rows[0][k]
             stacked = np.zeros((nparts, cap) + first.shape[1:], first.dtype)
             for p in range(nparts):
                 stacked[p, : counts[p]] = part_rows[p][k]
-            cols[k] = jnp.asarray(stacked)
-    batch = Batch(cols, jnp.asarray(np.asarray(counts), jnp.int32))
-    sharding = batch_sharding(mesh)
-    batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+            cols[k] = stacked
+    from dryad_tpu.exec.data import put_batch
+    batch = put_batch(Batch(cols, np.asarray(counts, np.int32)), mesh)
     return PData(batch, nparts)

@@ -27,10 +27,25 @@ __all__ = ["hash_column", "hash_columns", "hash_batch_keys"]
 _U32 = jnp.uint32
 
 # Deterministic odd weights for byte dot-product hashing (fixed seed).
-_rng = np.random.RandomState(0xD47AD)
+# Built lazily: a module-level jnp.asarray would initialize the XLA backend
+# at import, which breaks worker processes (jax.distributed.initialize must
+# run first) and forces devices onto the pure-control-plane driver.
 _MAX_HASH_LEN = 512
-_BYTE_W1 = jnp.asarray(_rng.randint(0, 2**31, _MAX_HASH_LEN).astype(np.uint32) * 2 + 1)
-_BYTE_W2 = jnp.asarray(_rng.randint(0, 2**31, _MAX_HASH_LEN).astype(np.uint32) * 2 + 1)
+
+
+def _byte_weights():
+    # NUMPY values (not jnp): a device array built lazily inside a trace
+    # would cache that trace's tracer and leak it into later programs
+    global _BYTE_W
+    try:
+        return _BYTE_W
+    except NameError:
+        rng = np.random.RandomState(0xD47AD)
+        _BYTE_W = (rng.randint(0, 2**31, _MAX_HASH_LEN)
+                   .astype(np.uint32) * 2 + 1,
+                   rng.randint(0, 2**31, _MAX_HASH_LEN)
+                   .astype(np.uint32) * 2 + 1)
+        return _BYTE_W
 
 
 def _mix32(x: jax.Array, c1: int, c2: int) -> jax.Array:
@@ -90,8 +105,9 @@ def _hash_string(col: StringColumn) -> Tuple[jax.Array, jax.Array]:
     mask = (jnp.arange(L, dtype=jnp.int32)[None, :] < col.lengths[:, None])
     b = jnp.where(mask, col.data, 0).astype(_U32)
     # (b+1) so that a 0x00 byte differs from padding; wrapping uint32 dot.
-    hi = ((b + mask.astype(_U32)) * _BYTE_W1[:L][None, :]).sum(axis=1, dtype=_U32)
-    lo = ((b + mask.astype(_U32)) * _BYTE_W2[:L][None, :]).sum(axis=1, dtype=_U32)
+    w1, w2 = _byte_weights()
+    hi = ((b + mask.astype(_U32)) * w1[:L][None, :]).sum(axis=1, dtype=_U32)
+    lo = ((b + mask.astype(_U32)) * w2[:L][None, :]).sum(axis=1, dtype=_U32)
     lenmix = (_mix32(col.lengths, 0x85EBCA6B, 0xC2B2AE35),
               _mix32(col.lengths, 0xCC9E2D51, 0x1B873593))
     return _combine((_mix32(hi, 0xCC9E2D51, 0x85EBCA6B),

@@ -661,3 +661,18 @@ def concat2(a: Batch, b: Batch) -> Batch:
         else:
             cols[k] = jnp.take(jnp.concatenate([va, vb], axis=0), src, axis=0)
     return Batch(cols, a.count + b.count)
+
+
+def mean_finalize_columns(cols: dict, mean_cols: Sequence[str]) -> dict:
+    """Finalize decomposed means: replace {m}__sum/{m}__cnt partial columns
+    with their quotient (the FinalReduce step of the builtin Average
+    decomposition, IDecomposable.cs:34 / _decompose_aggs)."""
+    out = dict(cols)
+    for m in mean_cols:
+        s = out.pop(m + "__sum")
+        c = out.pop(m + "__cnt")
+        cf = jnp.maximum(c, 1).reshape(c.shape + (1,) * (s.ndim - 1))
+        out[m] = s / cf.astype(s.dtype) \
+            if jnp.issubdtype(s.dtype, jnp.floating) \
+            else s.astype(jnp.float32) / cf
+    return out

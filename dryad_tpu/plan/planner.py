@@ -120,21 +120,6 @@ def _has_user_decs(aggs: Dict[str, Any]) -> bool:
     return any(isinstance(v, E.Decomposable) for v in aggs.values())
 
 
-def _mean_post_fn(mean_cols: List[str]):
-    import jax.numpy as jnp
-
-    def fn(cols):
-        out = dict(cols)
-        for m in mean_cols:
-            s = out.pop(m + "__sum")
-            c = out.pop(m + "__cnt")
-            cf = jnp.maximum(c, 1).reshape(c.shape + (1,) * (s.ndim - 1))
-            out[m] = s / cf.astype(s.dtype) \
-                if jnp.issubdtype(s.dtype, jnp.floating) \
-                else s.astype(jnp.float32) / cf
-        return out
-
-    return fn
 
 
 class Planner:
@@ -362,8 +347,7 @@ class Planner:
                 body2: List[StageOp] = [
                     StageOp("group", {"keys": keys, "aggs": final})]
                 if mean_cols:
-                    body2.append(StageOp("fn", {"fn": _mean_post_fn(mean_cols),
-                                                "label": "mean-finalize"}))
+                    body2.append(StageOp("mean_fin", {"cols": mean_cols}))
                 st2 = self._new_stage([Leg(st1.id, [], ex2)], body2,
                                       "groupby-dcn")
                 return Fragment(st2.id, [], f.capacity,
@@ -371,8 +355,7 @@ class Planner:
             ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
             body = [StageOp("group", {"keys": keys, "aggs": final})]
             if mean_cols:
-                body.append(StageOp("fn", {"fn": _mean_post_fn(mean_cols),
-                                           "label": "mean-finalize"}))
+                body.append(StageOp("mean_fin", {"cols": mean_cols}))
             st = self._new_stage([Leg(f.src, f.ops, ex)], body, "groupby")
             return Fragment(st.id, [], f.capacity,
                             E.Partitioning("hash", keys))

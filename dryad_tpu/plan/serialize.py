@@ -23,7 +23,12 @@ __all__ = ["graph_to_json", "graph_from_json"]
 def _op_to_json(op: StageOp, fn_names: Dict[int, str]) -> dict:
     params = {}
     for k, v in op.params.items():
-        if callable(v):
+        if not isinstance(v, (str, int, float, bool, type(None))) \
+                and id(v) in fn_names:
+            # explicitly registered shipping name (runtime/shiplan.py) —
+            # covers non-callable opaque values (decomposable boxes) too
+            params[k] = {"__fn__": fn_names[id(v)]}
+        elif callable(v):
             params[k] = {"__fn__": fn_names.get(id(v), f"fn_{id(v):x}")}
         elif isinstance(v, bytes):
             params[k] = {"__bytes__": v.decode("latin1")}
@@ -94,7 +99,8 @@ def graph_to_json(graph: StageGraph,
                       "out_capacity": e.out_capacity,
                       "descending": e.descending,
                       "bounds_from": e.bounds_from,
-                      "bounds_key": e.bounds_key}
+                      "bounds_key": e.bounds_key,
+                      "axis": e.axis}
             legs.append({"src": src,
                          "ops": [_op_to_json(o, fn_names) for o in leg.ops],
                          "exchange": ex})
@@ -128,7 +134,7 @@ def graph_from_json(s: str, fn_table: Optional[Dict[str, Callable]] = None,
                 e = ld["exchange"]
                 ex = Exchange(e["kind"], tuple(e["keys"]), e["out_capacity"],
                               e["descending"], e["bounds_from"],
-                              e["bounds_key"])
+                              e["bounds_key"], axis=e.get("axis"))
             legs.append(Leg(lsrc, [_op_from_json(o, fn_table)
                                    for o in ld["ops"]], ex))
         stages.append(Stage(id=sd["id"], legs=legs,

@@ -1,0 +1,19 @@
+"""Multi-process runtime: job submission, per-node worker services, and the
+driver-side cluster control plane.
+
+The counterpart of the reference's layers 2/4/5 (SURVEY.md §1): job
+submission (LinqToDryad/LocalJobSubmission.cs:97-302), the cluster
+interface (ClusterInterface/Interfaces.cs:324,491), and the per-node daemon
+(ProcessService/ProcessService.cs:389).  TPU-native shape: the driver is a
+pure control plane (it owns no devices); N worker processes form a
+jax.distributed job whose global mesh carries the data plane — collectives
+over the cross-process axis are the DCN transport the reference implements
+with its TCP channel fabric.
+"""
+
+from dryad_tpu.runtime.cluster import (ClusterJobError, LocalCluster,
+                                       WorkerFailure)
+from dryad_tpu.runtime.sources import DeferredSource
+
+__all__ = ["LocalCluster", "WorkerFailure", "ClusterJobError",
+           "DeferredSource"]

@@ -1,0 +1,301 @@
+"""Driver-side cluster control plane: spawn workers, submit plans, detect
+process failure, restart.
+
+The counterpart of the reference's LocalJobSubmission
+(LinqToDryad/LocalJobSubmission.cs:97-302 — real GM + real worker processes
+on one box, its default test topology) plus the GM's process-failure
+reaction (DrVertex ReactToFailedVertex): here a dead worker is detected via
+its exited process / closed control socket; the whole gang is torn down
+(SPMD stages are gang-scheduled — one lost process stalls every collective)
+and the job is replayed on a fresh gang, sources being re-readable by
+construction (the lineage argument, SURVEY.md §3.5)."""
+
+from __future__ import annotations
+
+import os
+import select
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dryad_tpu.runtime import protocol
+
+__all__ = ["LocalCluster", "WorkerFailure", "ClusterJobError"]
+
+
+class WorkerFailure(RuntimeError):
+    """A worker process died or stopped responding mid-job."""
+
+
+class ClusterJobError(RuntimeError):
+    """The job itself raised on a worker (plan/UDF/capacity error)."""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class LocalCluster:
+    """N worker processes × D virtual devices each, on this machine.
+
+    The same control plane works for real multi-host TPU: workers would run
+    one per host with real local chips (jax.distributed over the pod), the
+    driver anywhere reachable.  ``fn_modules`` are imported by workers to
+    resolve plan callables (FN_TABLE exports + module:qualname refs)."""
+
+    def __init__(self, n_processes: int = 2, devices_per_process: int = 2,
+                 fn_modules: tuple = (), startup_timeout: float = 180.0,
+                 event_log: Optional[Callable[[dict], None]] = None,
+                 log_dir: Optional[str] = None):
+        self.n_processes = n_processes
+        self.devices_per_process = devices_per_process
+        self.fn_modules = list(fn_modules)
+        self.startup_timeout = startup_timeout
+        self.event_log = event_log
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="dryad-cluster-")
+        self._procs: List[subprocess.Popen] = []
+        self._socks: Dict[int, socket.socket] = {}
+        self._listener: Optional[socket.socket] = None
+        self._start()
+
+    @property
+    def nparts(self) -> int:
+        return self.n_processes * self.devices_per_process
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _start(self) -> None:
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(self.n_processes)
+        control_port = self._listener.getsockname()[1]
+        coord_port = _free_port()
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        env["JAX_PLATFORMS"] = "cpu"
+        # workers must import dryad_tpu regardless of their cwd — ship the
+        # package location (and the driver's sys.path additions) explicitly
+        import dryad_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(dryad_tpu.__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
+                          else []))
+
+        for pid in range(self.n_processes):
+            cmd = [sys.executable, "-m", "dryad_tpu.runtime.worker",
+                   "--coordinator", f"127.0.0.1:{coord_port}",
+                   "--control", f"127.0.0.1:{control_port}",
+                   "--num-processes", str(self.n_processes),
+                   "--process-id", str(pid),
+                   "--devices-per-process", str(self.devices_per_process),
+                   "--platform", "cpu"]
+            for m in self.fn_modules:
+                cmd += ["--fn-module", m]
+            log = open(os.path.join(self.log_dir, f"worker-{pid}.log"), "ab")
+            self._procs.append(subprocess.Popen(
+                cmd, env=env, stdout=log, stderr=subprocess.STDOUT))
+            log.close()
+
+        deadline = time.time() + self.startup_timeout
+        self._listener.settimeout(1.0)
+        while len(self._socks) < self.n_processes:
+            if time.time() > deadline:
+                self._kill_all()
+                raise WorkerFailure(
+                    f"only {len(self._socks)}/{self.n_processes} workers "
+                    f"connected within {self.startup_timeout}s"
+                    + self._log_tails())
+            self._check_deaths(during_startup=True)
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            hello = protocol.recv_msg(conn)
+            conn.setblocking(False)
+            self._socks[hello["hello"]] = conn
+
+    def _check_deaths(self, during_startup: bool = False) -> None:
+        for pid, proc in enumerate(self._procs):
+            if proc.poll() is not None:
+                self._kill_all()
+                raise WorkerFailure(
+                    f"worker {pid} exited with rc={proc.returncode}"
+                    + ("" if during_startup else " mid-job")
+                    + self._log_tails())
+
+    def _log_tails(self, n: int = 2000) -> str:
+        out = []
+        for pid in range(self.n_processes):
+            p = os.path.join(self.log_dir, f"worker-{pid}.log")
+            try:
+                with open(p, "rb") as f:
+                    f.seek(max(0, os.path.getsize(p) - n))
+                    tail = f.read().decode(errors="replace")
+                if tail.strip():
+                    out.append(f"\n--- worker {pid} log tail ---\n{tail}")
+            except OSError:
+                pass
+        return "".join(out)
+
+    def _kill_all(self) -> None:
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in self._procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._procs, self._socks = [], {}
+        if self._listener is not None:
+            self._listener.close()
+            self._listener = None
+
+    def alive(self) -> bool:
+        return (len(self._socks) == self.n_processes
+                and all(p.poll() is None for p in self._procs))
+
+    def restart(self) -> None:
+        self._kill_all()
+        self._start()
+
+    def shutdown(self) -> None:
+        for s in self._socks.values():
+            try:
+                protocol.send_msg(s, {"cmd": "stop"})
+            except OSError:
+                pass
+        time.sleep(0.2)
+        self._kill_all()
+
+    def __enter__(self) -> "LocalCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- job submission ----------------------------------------------------
+
+    def execute(self, plan_json: str,
+                source_specs: Dict[str, Dict[str, Any]],
+                collect: bool = True, store_path: Optional[str] = None,
+                store_partitioning: Optional[Dict[str, Any]] = None,
+                timeout: float = 600.0) -> Optional[Dict[str, Any]]:
+        """Submit one job to the gang; returns worker 0's host table."""
+        if not self.alive():
+            self.restart()
+        msg = {"cmd": "run", "plan": plan_json, "sources": source_specs,
+               "collect": collect, "store_path": store_path,
+               "store_partitioning": store_partitioning}
+        for s in self._socks.values():
+            s.setblocking(True)
+            protocol.send_msg(s, msg)
+            s.setblocking(False)
+
+        replies: Dict[int, dict] = {}
+        pending = set(self._socks)
+        deadline = time.time() + timeout
+        # buffered receive state per worker
+        bufs: Dict[int, bytearray] = {pid: bytearray() for pid in pending}
+        while pending:
+            if time.time() > deadline:
+                self._kill_all()
+                raise WorkerFailure(
+                    f"job timed out after {timeout}s; workers "
+                    f"{sorted(pending)} never replied" + self._log_tails())
+            try:
+                self._check_deaths()
+            except WorkerFailure:
+                raise
+            socks = {self._socks[pid]: pid for pid in pending}
+            ready, _, _ = select.select(list(socks), [], [], 0.25)
+            for s in ready:
+                pid = socks[s]
+                try:
+                    chunk = s.recv(1 << 20)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    chunk = b""
+                if not chunk:
+                    self._kill_all()
+                    raise WorkerFailure(
+                        f"worker {pid} closed its control connection "
+                        f"mid-job" + self._log_tails())
+                bufs[pid].extend(chunk)
+                reply = _try_decode(bufs[pid])
+                if reply is not None:
+                    replies[pid] = reply
+                    pending.discard(pid)
+
+            # a worker that errored before entering a collective leaves the
+            # rest blocked forever — once any error reply arrives, give the
+            # stragglers a short grace then tear the gang down
+            errs = [r for r in replies.values() if not r.get("ok")]
+            if errs and pending:
+                grace = time.time() + 5.0
+                while pending and time.time() < grace:
+                    ready, _, _ = select.select(
+                        [self._socks[p] for p in pending], [], [], 0.25)
+                    for s in ready:
+                        pid = {self._socks[p]: p for p in pending}[s]
+                        try:
+                            chunk = s.recv(1 << 20)
+                        except (BlockingIOError, InterruptedError):
+                            continue
+                        except OSError:
+                            chunk = b""
+                        if chunk:
+                            bufs[pid].extend(chunk)
+                            r = _try_decode(bufs[pid])
+                            if r is not None:
+                                replies[pid] = r
+                                pending.discard(pid)
+                        else:
+                            pending.discard(pid)
+                break
+
+        errs = {pid: r["error"] for pid, r in replies.items()
+                if not r.get("ok")}
+        if errs:
+            self._kill_all()  # gang state is unknown after an error
+            first = min(errs)
+            raise ClusterJobError(
+                f"job failed on worker(s) {sorted(errs)}; worker {first} "
+                f"error:\n{errs[first]}")
+
+        if self.event_log is not None and 0 in replies:
+            for e in replies[0].get("events", []):
+                self.event_log(dict(e, worker=0))
+        return replies.get(0, {}).get("table")
+
+
+def _try_decode(buf: bytearray):
+    """Decode one length-prefixed frame from ``buf`` if complete."""
+    import pickle
+    import struct
+    if len(buf) < 8:
+        return None
+    (n,) = struct.unpack_from("<Q", buf, 0)
+    if len(buf) < 8 + n:
+        return None
+    obj = pickle.loads(bytes(buf[8:8 + n]))
+    del buf[:8 + n]
+    return obj

@@ -1,0 +1,59 @@
+"""The SPMD job body every worker process runs for one submitted query.
+
+Mirrored determinism is the correctness contract (the reason a Dryad-style
+GM can treat vertices as replayable): all processes rebuild the same graph
+from the same JSON, execute the same stage programs in the same order, see
+the same replicated overflow flags / range bounds, and therefore make the
+same capacity-retry decisions — so the only cross-process coupling is XLA
+collectives (the data plane) plus the driver's control messages."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["execute_plan"]
+
+
+def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
+                 source_specs: Dict[str, Dict[str, Any]], mesh,
+                 event_log: Optional[Callable[[dict], None]] = None,
+                 store_path: Optional[str] = None,
+                 store_partitioning: Optional[Dict[str, Any]] = None,
+                 collect: Any = True) -> Any:
+    """Build sources, run the graph, replicate the output, and (on process
+    0) return the host table / write the store.  ``collect``: True = full
+    host table, "count" = total row count only, False = nothing."""
+    import jax
+
+    from dryad_tpu.exec.data import (PData, collect_replicated,
+                                     replicate_tree)
+    from dryad_tpu.exec.executor import Executor
+    from dryad_tpu.plan.serialize import graph_from_json
+    from dryad_tpu.runtime.sources import build_source
+
+    import numpy as np
+
+    sources = {key: build_source(spec, mesh)
+               for key, spec in source_specs.items()}
+    graph = graph_from_json(plan_json, fn_table=fn_table, sources=sources)
+    ex = Executor(mesh, event_log=event_log)
+    pd = ex.run(graph)
+
+    table = None
+    if collect == "count":
+        # scalar terminals don't need the rows — only the replicated
+        # per-partition counts (tiny int32[P] all-gather)
+        counts = np.asarray(replicate_tree(pd.batch.count, mesh))
+        table = int(counts.sum())
+    elif collect:
+        # only process 0's table goes back to the driver; the others
+        # participate in the replication collective but skip the host unpack
+        table = collect_replicated(pd, mesh,
+                                   unpack=jax.process_index() == 0)
+    if store_path is not None:
+        rep = PData(replicate_tree(pd.batch, mesh), pd.nparts)
+        if jax.process_index() == 0:
+            from dryad_tpu.io.store import write_store
+            write_store(store_path, rep,
+                        partitioning=store_partitioning)
+    return table

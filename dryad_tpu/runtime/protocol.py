@@ -1,0 +1,45 @@
+"""Length-prefixed message framing for the driver<->worker control plane.
+
+The role of the reference's vertex command protocol (SURVEY.md §2.2 "vertex
+commands", ProcessService HTTP endpoints): a tiny, explicit wire format —
+8-byte little-endian length + pickled payload.  Pickle is acceptable here
+because both ends are processes WE spawned on the same machine from the
+same codebase (a trusted local control plane, like the reference's
+GM<->daemon channel inside one cluster security domain); nothing in this
+module ever listens on a non-loopback interface.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any
+
+_LEN = struct.Struct("<Q")
+# control messages are plans + host source columns; cap frames at 4 GiB to
+# fail fast on corruption rather than allocating garbage lengths
+_MAX_FRAME = 4 << 30
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise EOFError("peer closed control connection")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_FRAME:
+        raise EOFError(f"oversized control frame ({n} bytes)")
+    return pickle.loads(_recv_exact(sock, n))

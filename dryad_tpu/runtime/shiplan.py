@@ -1,0 +1,151 @@
+"""Plan shipping: turn a planned StageGraph into (JSON plan, source specs,
+callable references) that worker processes can rebuild.
+
+The reference names vertex entry points `assembly!class.method` in its XML
+plan (QueryParser.cs:100) — the same idea here: a UDF crossing the process
+boundary must be IMPORTABLE (``module:qualname``), or pre-registered by
+name in the Context's ``fn_table`` and exported by a worker ``--fn-module``
+(a module defining ``FN_TABLE``).  Lambdas/closures cannot ship — exactly
+the reference's serializable-expression constraint.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+from typing import Any, Callable, Dict, Iterable, Tuple
+
+from dryad_tpu.plan.serialize import graph_to_json
+from dryad_tpu.plan.stages import StageGraph
+from dryad_tpu.runtime.sources import DeferredSource
+
+__all__ = ["PlanShipError", "serialize_for_cluster", "resolve_fn_table"]
+
+
+class PlanShipError(RuntimeError):
+    pass
+
+
+def _json_ok(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except TypeError:
+        return False
+
+
+def _import_ref(fn: Callable) -> str | None:
+    """``module:qualname`` if re-importing it yields the same object."""
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", None)
+    if not mod or not qual or "<" in qual:
+        return None
+    try:
+        obj: Any = importlib.import_module(mod)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+    except (ImportError, AttributeError):
+        return None
+    return f"{mod}:{qual}" if obj is fn else None
+
+
+def _collect_refs(graph: StageGraph,
+                  user_names: Dict[int, str]) -> Dict[int, str]:
+    """id(value) -> shipping name for every non-JSON op param."""
+    fn_names: Dict[int, str] = {}
+    for st in graph.stages:
+        ops = [o for leg in st.legs for o in leg.ops] + list(st.body)
+        for op in ops:
+            for k, v in op.params.items():
+                if isinstance(v, (str, int, float, bool, bytes,
+                                  type(None))):
+                    continue
+                if id(v) in user_names:
+                    fn_names[id(v)] = user_names[id(v)]
+                    continue
+                if callable(v):
+                    ref = _import_ref(v)
+                    if ref is None:
+                        raise PlanShipError(
+                            f"op {op.kind!r} param {k!r}: callable "
+                            f"{getattr(v, '__qualname__', v)!r} is not "
+                            f"importable (lambda/closure?) — move it to "
+                            f"module level, or register it by name in "
+                            f"Context(fn_table=...) and export it from a "
+                            f"worker --fn-module FN_TABLE")
+                    fn_names[id(v)] = ref
+                    continue
+                if _json_ok(v) or (isinstance(v, (tuple, list, dict))
+                                   and _json_ok_structure(v)):
+                    continue
+                raise PlanShipError(
+                    f"op {op.kind!r} param {k!r} ({type(v).__name__}) is "
+                    f"not serializable for cluster execution — register "
+                    f"it by name in Context(fn_table=...) and export it "
+                    f"from a worker --fn-module FN_TABLE")
+    return fn_names
+
+
+def _json_ok_structure(v: Any) -> bool:
+    """Matches the value shapes plan.serialize._op_to_json round-trips
+    (scalars, bytes, nested tuples/lists, dicts of those)."""
+    if isinstance(v, (tuple, list)):
+        return all(_json_ok_structure(x) for x in v)
+    if isinstance(v, dict):
+        return all(_json_ok_structure(x) for x in v.values())
+    return isinstance(v, (str, int, float, bool, bytes, type(None)))
+
+
+def serialize_for_cluster(graph: StageGraph,
+                          user_fn_table: Dict[str, Any] | None = None
+                          ) -> Tuple[str, Dict[str, Dict[str, Any]]]:
+    """Returns (plan_json, source_specs keyed "sid:leg")."""
+    user_names = {id(v): k for k, v in (user_fn_table or {}).items()}
+    fn_names = _collect_refs(graph, user_names)
+    plan_json = graph_to_json(graph, fn_names)
+    specs: Dict[str, Dict[str, Any]] = {}
+    for st in graph.stages:
+        for li, leg in enumerate(st.legs):
+            if isinstance(leg.src, tuple) and leg.src[0] == "source":
+                v = leg.src[1]
+                if not isinstance(v, DeferredSource):
+                    raise PlanShipError(
+                        "cluster execution needs deferred sources — create "
+                        "datasets through a Context constructed with "
+                        "cluster=...")
+                specs[f"{st.id}:{li}"] = v.spec
+    return plan_json, specs
+
+
+def _scan_names(plan_json: str) -> Iterable[str]:
+    d = json.loads(plan_json)
+    for st in d["stages"]:
+        ops = [o for leg in st["legs"] for o in leg["ops"]] + st["body"]
+        for op in ops:
+            for v in op["params"].values():
+                if isinstance(v, dict) and "__fn__" in v:
+                    yield v["__fn__"]
+                if isinstance(v, dict) and "__opaque__" in v:
+                    yield v["__opaque__"]
+
+
+def resolve_fn_table(plan_json: str,
+                     fn_modules: Iterable[str] = ()) -> Dict[str, Callable]:
+    """Worker-side: resolve every callable name the plan references."""
+    table: Dict[str, Any] = {}
+    for m in fn_modules:
+        mod = importlib.import_module(m)
+        table.update(getattr(mod, "FN_TABLE", {}))
+    for name in _scan_names(plan_json):
+        if name in table:
+            continue
+        if ":" in name:
+            mod_name, qual = name.split(":", 1)
+            obj: Any = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            table[name] = obj
+        else:
+            raise PlanShipError(
+                f"plan references {name!r} but no --fn-module exports it")
+    return table

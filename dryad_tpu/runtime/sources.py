@@ -1,0 +1,114 @@
+"""Deferred source descriptions for cluster execution.
+
+In single-process mode a Context constructor places data on the mesh
+immediately; in cluster mode the driver owns no devices, so a source is a
+SPEC — "these columns", "this text file", "this store path" — shipped with
+the plan and materialized by every worker identically (the reference's
+data-provider model: the plan names input partition files, vertices read
+them; DataProvider.cs, DrPartitionFile.cpp:607)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+__all__ = ["DeferredSource", "columns_spec", "text_spec", "store_spec",
+           "build_source", "count_lines"]
+
+
+class DeferredSource:
+    """Planner-visible stand-in for source data (exposes ``.capacity`` the
+    way PData does, plan/planner.py:228)."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = spec
+
+    @property
+    def capacity(self) -> int:
+        return self.spec["capacity"]
+
+
+def _block_capacity(n: int, nparts: int) -> int:
+    """Per-partition capacity of block partitioning — must match
+    exec.data._block_slices (max block = ceil split)."""
+    base, rem = divmod(n, nparts)
+    return max(1, base + (1 if rem else 0))
+
+
+def count_lines(buf: bytes) -> int:
+    """Line count matching native.pack_lines splitting (split on \\n, a
+    trailing unterminated line counts)."""
+    n = buf.count(b"\n")
+    if buf and not buf.endswith(b"\n"):
+        n += 1
+    return n
+
+
+def count_lines_file(path: str, chunk: int = 1 << 22) -> int:
+    """Streaming line count — the driver never holds the file in memory
+    (it only needs the capacity estimate; workers read the data)."""
+    n = 0
+    last = b""
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            n += b.count(b"\n")
+            last = b
+    if last and not last.endswith(b"\n"):
+        n += 1
+    return n
+
+
+def columns_spec(columns: Mapping[str, Any], nparts: int,
+                 capacity: int | None = None,
+                 str_max_len: int = 64) -> Dict[str, Any]:
+    n = 0
+    for v in columns.values():
+        n = len(v)
+        break
+    return {"kind": "columns", "columns": dict(columns),
+            "capacity": capacity or _block_capacity(n, nparts),
+            "str_max_len": str_max_len}
+
+
+def text_spec(path: str, nparts: int, column: str = "line",
+              max_line_len: int = 256) -> Dict[str, Any]:
+    return {"kind": "text", "path": path, "column": column,
+            "max_line_len": max_line_len,
+            "capacity": _block_capacity(count_lines_file(path), nparts)}
+
+
+def store_spec(path: str, nparts: int, meta: Dict[str, Any],
+               capacity: int | None = None) -> Dict[str, Any]:
+    counts = meta.get("counts", [])
+    if meta["npartitions"] == nparts:
+        cap = capacity or max(int(meta.get("capacity", 0)),
+                              max(counts or [0]), 1)
+    else:
+        cap = capacity or _block_capacity(sum(counts), nparts)
+    return {"kind": "store", "path": path, "capacity": cap}
+
+
+def build_source(spec: Dict[str, Any], mesh):
+    """Materialize a source spec as sharded PData — runs on EVERY process
+    (array creation fills only local addressable shards; no collective)."""
+    kind = spec["kind"]
+    if kind == "columns":
+        from dryad_tpu.exec.data import pdata_from_host
+        return pdata_from_host(spec["columns"], mesh,
+                               capacity=spec["capacity"],
+                               str_max_len=spec["str_max_len"])
+    if kind == "text":
+        from dryad_tpu import native
+        from dryad_tpu.exec.data import pdata_from_packed_strings
+        with open(spec["path"], "rb") as f:
+            buf = f.read()
+        data, lens = native.pack_lines(buf, spec["max_line_len"])
+        return pdata_from_packed_strings(data, lens, mesh,
+                                         column=spec["column"],
+                                         capacity=spec["capacity"])
+    if kind == "store":
+        from dryad_tpu.io.store import read_store
+        return read_store(spec["path"], mesh, capacity=spec["capacity"])
+    raise ValueError(f"unknown source kind {kind!r}")

@@ -1,0 +1,107 @@
+"""Per-node worker service: ``python -m dryad_tpu.runtime.worker``.
+
+The counterpart of the reference's per-node daemon
+(ProcessService/ProcessService.cs:389 — a process the submission layer
+starts on every machine, which then executes vertex commands from the GM).
+Here each worker joins a jax.distributed job (gloo on CPU, ICI/DCN on real
+TPU pods), connects back to the driver's control socket, and executes
+submitted plans SPMD until told to stop."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import traceback
+
+
+def _configure_jax(platform: str, devices_per_process: int) -> None:
+    if platform != "cpu":
+        # real accelerators: leave the backend choice to the environment
+        # (one worker per TPU host; local chips are the "dp" axis)
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    kept = [f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(
+        f"--xla_force_host_platform_device_count={devices_per_process}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--control", required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--devices-per-process", type=int, default=1)
+    ap.add_argument("--fn-module", action="append", default=[])
+    ap.add_argument("--platform", default="default",
+                    help="'cpu' forces N virtual CPU devices (local test "
+                         "topology); 'default' uses the environment's "
+                         "backend (real TPU hosts)")
+    args = ap.parse_args(argv)
+
+    _configure_jax(args.platform, args.devices_per_process)
+    import jax
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=args.coordinator,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id)
+
+    from dryad_tpu.parallel.mesh import make_mesh
+    from dryad_tpu.runtime import protocol
+    # cross-process boundary = the "dcn" axis; in-process devices = "dp"
+    mesh = make_mesh(hosts=args.num_processes
+                     if args.num_processes > 1 else None)
+
+    host, port = args.control.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)))
+    protocol.send_msg(sock, {"hello": args.process_id,
+                             "devices": jax.device_count()})
+
+    while True:
+        try:
+            msg = protocol.recv_msg(sock)
+        except EOFError:
+            break
+        cmd = msg.get("cmd")
+        if cmd == "stop":
+            protocol.send_msg(sock, {"bye": args.process_id})
+            break
+        if cmd == "ping":
+            protocol.send_msg(sock, {"pong": args.process_id})
+            continue
+        if cmd == "run":
+            events: list = []
+            reply: dict = {"ok": True, "pid": args.process_id}
+            try:
+                from dryad_tpu.runtime.exec_common import execute_plan
+                from dryad_tpu.runtime.shiplan import resolve_fn_table
+                fn_table = resolve_fn_table(msg["plan"], args.fn_module)
+                collect = msg.get("collect", True)
+                table = execute_plan(
+                    msg["plan"], fn_table, msg["sources"], mesh,
+                    event_log=events.append,
+                    store_path=msg.get("store_path"),
+                    store_partitioning=msg.get("store_partitioning"),
+                    collect=collect)
+                if args.process_id == 0 and collect:
+                    reply["table"] = table
+            except Exception:
+                reply = {"ok": False, "pid": args.process_id,
+                         "error": traceback.format_exc()}
+            reply["events"] = events
+            protocol.send_msg(sock, reply)
+            continue
+        protocol.send_msg(sock, {"ok": False, "pid": args.process_id,
+                                 "error": f"unknown command {cmd!r}"})
+    sock.close()
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

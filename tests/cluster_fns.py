@@ -1,0 +1,14 @@
+"""Module-level UDFs for cluster-mode tests — plan callables must be
+importable by workers (runtime/shiplan.py), the analogue of the
+reference's `assembly!class.method` vertex entries (QueryParser.cs:100)."""
+
+
+def double_v(cols):
+    return dict(cols, v=cols["v"] * 2)
+
+
+def keep_positive(cols):
+    return cols["v"] > 0
+
+
+FN_TABLE = {}

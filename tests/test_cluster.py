@@ -1,0 +1,117 @@
+"""Multi-process runtime tests: a real driver + worker-gang topology on one
+box — the counterpart of the reference's local-process test fixture
+(LocalJobSubmission.cs:97-302, SURVEY.md §4): N OS processes form a
+jax.distributed job; the driver ships serialized plans; collectives carry
+the data plane."""
+
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_fns  # noqa: E402
+
+from dryad_tpu.api.dataset import Context  # noqa: E402
+from dryad_tpu.runtime import LocalCluster, WorkerFailure  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    # workers must be able to import cluster_fns (plan UDF resolution)
+    old = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = (os.path.dirname(__file__) + os.pathsep +
+                                (old or ""))
+    cl = LocalCluster(n_processes=2, devices_per_process=2)
+    yield cl
+    cl.shutdown()
+    if old is None:
+        os.environ.pop("PYTHONPATH", None)
+    else:
+        os.environ["PYTHONPATH"] = old
+
+
+def _expected_group(k, v):
+    ks = sorted(set(k.tolist()))
+    return {kk: int(v[k == kk].sum()) for kk in ks}
+
+
+def test_cluster_select_where_group(cluster):
+    ctx = Context(cluster=cluster)
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, 13, 157).astype(np.int32)
+    v = rng.integers(-5, 20, 157).astype(np.int32)
+    ds = (ctx.from_columns({"k": k, "v": v})
+          .select(cluster_fns.double_v)
+          .where(cluster_fns.keep_positive)
+          .group_by(["k"], {"total": ("sum", "v"), "n": ("count", None)}))
+    out = ds.collect()
+    v2 = v * 2
+    mask = v2 > 0
+    exp = _expected_group(k[mask], v2[mask])
+    got = dict(zip(np.asarray(out["k"]).tolist(),
+                   np.asarray(out["total"]).tolist()))
+    assert got == exp
+    cnt = dict(zip(np.asarray(out["k"]).tolist(),
+                   np.asarray(out["n"]).tolist()))
+    exp_cnt = {kk: int(mask[k == kk].sum()) for kk in exp}
+    assert cnt == exp_cnt
+
+
+def test_cluster_orderby_and_scalars(cluster):
+    ctx = Context(cluster=cluster)
+    rng = np.random.default_rng(1)
+    v = rng.integers(0, 1_000_000, 211).astype(np.int32)
+    ds = ctx.from_columns({"v": v}).order_by([("v", False)])
+    out = ds.collect()
+    np.testing.assert_array_equal(np.asarray(out["v"]),
+                                  np.sort(v))
+    assert ctx.from_columns({"v": v}).count() == 211
+    assert ctx.from_columns({"v": v}).sum("v") == int(v.sum())
+
+
+def test_cluster_join(cluster):
+    ctx = Context(cluster=cluster)
+    left = ctx.from_columns({"k": np.arange(40, dtype=np.int32),
+                             "a": np.arange(40, dtype=np.int32) * 10})
+    right = ctx.from_columns({"k": np.arange(0, 80, 2, dtype=np.int32),
+                              "b": np.arange(40, dtype=np.int32) + 7})
+    out = left.join(right, ["k"], ["k"]).collect()
+    ks = sorted(np.asarray(out["k"]).tolist())
+    assert ks == sorted(x for x in range(40) if x % 2 == 0)
+    for kk, a, b in zip(np.asarray(out["k"]), np.asarray(out["a"]),
+                        np.asarray(out["b"])):
+        assert a == kk * 10 and b == kk // 2 + 7
+
+
+def test_cluster_store_roundtrip(cluster, tmp_path):
+    ctx = Context(cluster=cluster)
+    path = str(tmp_path / "clustered_store")
+    k = np.arange(60, dtype=np.int32) % 7
+    v = np.arange(60, dtype=np.int32)
+    ctx.from_columns({"k": k, "v": v}).hash_partition(["k"]).to_store(path)
+    out = (ctx.from_store(path)
+           .group_by(["k"], {"total": ("sum", "v")})).collect()
+    exp = _expected_group(k, v)
+    got = dict(zip(np.asarray(out["k"]).tolist(),
+                   np.asarray(out["total"]).tolist()))
+    assert got == exp
+
+
+def test_cluster_worker_failure_detection_and_restart(cluster):
+    ctx = Context(cluster=cluster)
+    v = np.arange(100, dtype=np.int32)
+    # sanity: healthy gang answers
+    assert ctx.from_columns({"v": v}).count() == 100
+    # kill one worker: the gang is gone (SPMD stages are gang-scheduled)
+    os.kill(cluster._procs[1].pid, signal.SIGKILL)
+    cluster._procs[1].wait(timeout=10)
+    with pytest.raises(WorkerFailure):
+        cluster._check_deaths()
+    assert not cluster.alive()
+    # job resubmission restarts the gang and replays from sources —
+    # process-level failure recovery (ReactToFailedVertex role)
+    assert ctx.from_columns({"v": v}).count() == 100
+    assert cluster.alive()
