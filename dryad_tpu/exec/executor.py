@@ -95,10 +95,30 @@ def _expand(b: Batch) -> Batch:
     return jax.tree.map(lambda x: x[None], b)
 
 
+# sentinel "need" value: the overflow source cannot be fixed by scaling
+_UNSCALABLE = 1 << 30
+
+
+def _needs(ns, nsl=None):
+    """Pack a (need_scale, need_slack) int32[2] needs vector."""
+    z = jnp.zeros((), jnp.int32)
+    ns = jnp.asarray(ns, jnp.int32) if ns is not None else z
+    nsl = jnp.asarray(nsl, jnp.int32) if nsl is not None else z
+    return jnp.stack([ns, nsl])
+
+
+def _scale_need(need_rows, base_capacity: int):
+    """Rows needed -> capacity scale needed (0 stays 0)."""
+    return (-(-need_rows // jnp.int32(max(base_capacity, 1)))).astype(
+        jnp.int32)
+
+
 def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
-              axes: tuple = (PARTITION_AXIS,)):
-    """Apply one StageOp to batch ``b``; returns (batch, overflow_bool)."""
-    no = jnp.zeros((), jnp.bool_)
+              axes: tuple = (PARTITION_AXIS,), slack: int = 2):
+    """Apply one StageOp to batch ``b``; returns ``(batch, needs)`` where
+    needs = int32[2] (need_scale, need_slack): 0 = fits, >0 = the measured
+    requirement for a right-sized retry, _UNSCALABLE = retrying can't help."""
+    no = jnp.zeros((2,), jnp.int32)
     k = op.kind
     p = op.params
     if k == "fn":
@@ -112,14 +132,14 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
     if k == "filter":
         return kernels.compact(b, p["fn"](dict(b.columns))), no
     if k == "flat_tokens":
-        out, of = split_tokens(b, p["column"],
-                               out_capacity=p["out_capacity"] * scale,
-                               max_token_len=p["max_token_len"],
-                               delims=p["delims"])
+        out, need_rows = split_tokens(b, p["column"],
+                                      out_capacity=p["out_capacity"] * scale,
+                                      max_token_len=p["max_token_len"],
+                                      delims=p["delims"])
         if p["lower"]:
             col = out.columns[p["column"]]
             out = Batch({p["column"]: lower_ascii(col)}, out.count)
-        return out, of
+        return out, _needs(_scale_need(need_rows, p["out_capacity"]))
     if k in ("dgroup_local", "dgroup_partial", "dgroup_merge"):
         keys = list(p["keys"])
         if k == "dgroup_local":
@@ -155,12 +175,17 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
             return p["fn"](b, jax.lax.axis_index(axes)), no
         return p["fn"](b), no
     if k == "flat_map":
-        return kernels.flat_map_expand(b, p["fn"],
-                                       p["out_capacity"] * scale)
+        out, need_rows = kernels.flat_map_expand(b, p["fn"],
+                                                 p["out_capacity"] * scale)
+        return out, _needs(_scale_need(need_rows, p["out_capacity"]))
     if k == "zip":
-        return shuffle.zip_exchange(b, others[0],
-                                    suffix=p.get("suffix", "_r"),
-                                    send_slack=2 * scale, axes=axes)
+        out, need_recv, need_slack = shuffle.zip_exchange(
+            b, others[0], suffix=p.get("suffix", "_r"),
+            send_slack=slack, axes=axes)
+        # recv fits by construction (dest partition holds <= its left rows);
+        # only send slots can fall short under skewed right-side counts
+        return out, _needs(jnp.where(need_recv > 0, _UNSCALABLE, 0),
+                           need_slack)
     if k == "row_index":
         counts = jax.lax.all_gather(b.count, axes)
         me = jax.lax.axis_index(axes)
@@ -223,6 +248,7 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
         halo_avail = jnp.where(is_last, 0, jnp.minimum(next_count, halo))
         bad = (~is_last) & (next_count < halo)
         cap = b.capacity
+        bad = jnp.where(bad, jnp.int32(_UNSCALABLE), 0)
         # splice the halo at position `count` (local rows past count are
         # padding and must not appear inside windows)
         idx_ext = jnp.arange(cap + halo, dtype=jnp.int32)
@@ -245,23 +271,24 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
                 cols[kk] = jnp.take(ext, widx, axis=0)
         # valid window starts: i + w <= count + halo_avail
         n_out = jnp.clip(b.count + halo_avail - halo, 0, cap)
-        return Batch(cols, n_out), bad
+        return Batch(cols, n_out), _needs(bad)
     if k == "recap":
         cap = p["capacity"]
         if cap >= b.capacity:
             return b.pad_to(cap), no
         trunc = jax.tree.map(
             lambda x: x[:cap] if x.ndim else x, b)
-        return trunc.with_count(jnp.minimum(b.count, cap)), b.count > cap
+        return (trunc.with_count(jnp.minimum(b.count, cap)),
+                _needs(jnp.where(b.count > cap, _UNSCALABLE, 0)))
     if k == "apply2":
         return p["fn"](b, others[0]), no
     if k == "join":
         right = others[0]
-        out, of = kernels.hash_join(
+        out, need_rows = kernels.hash_join(
             b, right, list(p["left_keys"]), list(p["right_keys"]),
             out_capacity=p["out_capacity"] * scale,
             how=p.get("how", "inner"))
-        return out, of
+        return out, _needs(_scale_need(need_rows, p["out_capacity"]))
     if k == "semi_anti":
         # canonical (sorted) column order on BOTH sides: the two legs may
         # have different column insertion orders for the same column set
@@ -274,22 +301,25 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
     raise ValueError(f"unknown op kind {k}")
 
 
-def _apply_exchange(b: Batch, ex: Exchange, scale: int, bounds,
+def _apply_exchange(b: Batch, ex: Exchange, scale: int, slack: int, bounds,
                     axes: tuple = (PARTITION_AXIS,)
                     ) -> Tuple[Batch, jax.Array]:
+    """Returns (batch, needs[2]) — see _apply_op."""
     cap = ex.out_capacity * scale
     if ex.kind == "hash":
         # empty keys = whole row; sorted so both legs of a set op agree
         keys = list(ex.keys) or sorted(b.names)
-        return shuffle.hash_exchange(b, keys, cap, send_slack=2 * scale,
-                                     axes=axes, axis=ex.axis)
-    if ex.kind == "range":
-        return shuffle.range_exchange(b, ex.bounds_key, bounds, cap,
-                                      descending=ex.descending,
-                                      send_slack=2 * scale, axes=axes)
-    if ex.kind == "broadcast":
-        return shuffle.broadcast_gather(b, cap, axes=axes)
-    raise ValueError(ex.kind)
+        out, nr, nsl = shuffle.hash_exchange(b, keys, cap, send_slack=slack,
+                                             axes=axes, axis=ex.axis)
+    elif ex.kind == "range":
+        out, nr, nsl = shuffle.range_exchange(b, ex.bounds_key, bounds, cap,
+                                              descending=ex.descending,
+                                              send_slack=slack, axes=axes)
+    elif ex.kind == "broadcast":
+        out, nr, nsl = shuffle.broadcast_gather(b, cap, axes=axes)
+    else:
+        raise ValueError(ex.kind)
+    return out, _needs(_scale_need(nr, ex.out_capacity), nsl)
 
 
 class Executor:
@@ -315,36 +345,36 @@ class Executor:
 
     # -- stage program construction ---------------------------------------
 
-    def _build_stage_fn(self, stage: Stage, scale: int, n_legs: int,
-                        has_bounds: bool):
+    def _build_stage_fn(self, stage: Stage, scale: int, slack: int,
+                        n_legs: int, has_bounds: bool):
         def per_shard(*args):
             leg_batches = [
                 _squeeze(b) for b in args[:n_legs]]
             bounds = args[n_legs] if has_bounds else None
-            overflow = jnp.zeros((), jnp.bool_)
+            needs = jnp.zeros((2,), jnp.int32)
             outs = []
             for leg, b in zip(stage.legs, leg_batches):
                 for op in leg.ops:
-                    b, of = _apply_op(b, op, scale, [], self.axes)
-                    overflow |= of
+                    b, nd = _apply_op(b, op, scale, [], self.axes, slack)
+                    needs = jnp.maximum(needs, nd)
                 if leg.exchange is not None:
-                    b, of = _apply_exchange(b, leg.exchange, scale,
+                    b, nd = _apply_exchange(b, leg.exchange, scale, slack,
                                             bounds, self.axes)
-                    overflow |= of
+                    needs = jnp.maximum(needs, nd)
                 outs.append(b)
             cur = outs[0]
             rest = outs[1:]
             for op in stage.body:
                 if op.kind in ("join", "semi_anti", "concat", "apply2",
                                "zip"):
-                    cur, of = _apply_op(cur, op, scale, rest,
-                                        self.axes)
+                    cur, nd = _apply_op(cur, op, scale, rest,
+                                        self.axes, slack)
                     rest = []
                 else:
-                    cur, of = _apply_op(cur, op, scale, [],
-                                        self.axes)
-                overflow |= of
-            return _expand(cur), overflow[None]
+                    cur, nd = _apply_op(cur, op, scale, [],
+                                        self.axes, slack)
+                needs = jnp.maximum(needs, nd)
+            return _expand(cur), needs[None]
 
         in_specs = tuple([P(self.axes)] * n_legs +
                          ([P()] if has_bounds else []))
@@ -419,13 +449,14 @@ class Executor:
                 bounds = self._range_bounds(src_pd, leg.exchange.bounds_key)
 
         scale = stage._capacity_scale
+        slack = stage._send_slack
         for attempt in range(_MAX_CAPACITY_RETRIES + 1):
-            key = (stage.fingerprint(), scale,
+            key = (stage.fingerprint(), scale, slack,
                    tuple(str(jax.tree.map(lambda x: (jnp.shape(x), x.dtype),
                                           i.batch)) for i in inputs))
             fn = self._compile_cache.get(key)
             if fn is None:
-                fn = self._build_stage_fn(stage, scale, len(inputs),
+                fn = self._build_stage_fn(stage, scale, slack, len(inputs),
                                           bounds is not None)
                 self._compile_cache[key] = fn
                 if len(self._compile_cache) > self._compile_cache_max:
@@ -436,25 +467,39 @@ class Executor:
             if bounds is not None:
                 args.append(bounds)
             t0 = time.time()
-            out_batch, overflow = fn(*args)
+            out_batch, needs = fn(*args)
             if self._multiproc:
                 from dryad_tpu.exec.data import replicate_tree
-                overflow = replicate_tree(overflow, self.mesh)
-            of = bool(np.asarray(overflow).any())
+                needs = replicate_tree(needs, self.mesh)
+            needs = np.asarray(needs)  # [P, 2]
+            need_scale = int(needs[:, 0].max())
+            need_slack = int(needs[:, 1].max())
+            of = need_scale > 0 or need_slack > 0
             self._event({"event": "stage_done", "stage": stage.id,
                          "label": stage.label, "attempt": attempt,
-                         "scale": scale, "overflow": of,
+                         "scale": scale, "slack": slack, "overflow": of,
+                         "need_scale": need_scale,
+                         "need_slack": need_slack,
                          "wall_s": round(time.time() - t0, 4)})
             if not of:
                 stage._capacity_scale = scale
+                stage._send_slack = slack
                 return PData(out_batch, self.nparts)
-            if not _stage_overflow_scalable(stage):
+            if need_scale >= _UNSCALABLE or not _stage_overflow_scalable(
+                    stage):
                 raise CapacityError(
                     f"stage {stage.id} ({stage.label}) overflowed a fixed "
-                    f"capacity (with_capacity truncation or sliding_window "
-                    f"halo) — retrying at a larger scale cannot succeed; "
-                    f"raise the declared capacity instead")
-            scale *= 2
+                    f"capacity (with_capacity truncation, sliding_window "
+                    f"halo, or a zip alignment shortfall) — retrying at a "
+                    f"larger scale cannot succeed; raise the declared "
+                    f"capacity instead")
+            # right-size from the measured requirements (the dynamic
+            # distribution managers' size feedback, DrDynamicDistributor
+            # .cpp:388): ONE retry at the exact need instead of a blind
+            # doubling ladder — a 90%-hot-key repartition converges in a
+            # single retry where doubling took three
+            scale = max(scale, need_scale)
+            slack = max(slack, min(need_slack, self.nparts))
         kinds = _stage_kinds(stage)
         hint = ""
         if kinds & _FIXED_OVERFLOW_KINDS:
@@ -464,5 +509,5 @@ class Executor:
                     "(scaling retries cannot fix it)")
         raise CapacityError(
             f"stage {stage.id} ({stage.label}) still overflowing after "
-            f"{_MAX_CAPACITY_RETRIES} capacity retries (scale={scale})"
-            + hint)
+            f"{_MAX_CAPACITY_RETRIES} capacity retries (scale={scale}, "
+            f"slack={slack})" + hint)

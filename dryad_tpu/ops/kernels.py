@@ -558,9 +558,11 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     joined = Batch(out_cols, keep.sum(dtype=jnp.int32))
     perm = jnp.argsort(~keep, stable=True)
     out = joined.gather(perm)
-    # conservative: candidate pairs dropped for capacity might have been real
-    overflow = total > out_capacity
-    return out, overflow
+    # conservative: candidate pairs dropped for capacity might have been real.
+    # NEED channel: 0 = fits, else actual candidate-pair count so the
+    # executor can right-size the retry in one shot
+    need = jnp.where(total > out_capacity, total, 0)
+    return out, need.astype(jnp.int32)
 
 
 def flat_map_expand(batch: Batch, fn, out_capacity: int
@@ -585,7 +587,8 @@ def flat_map_expand(batch: Batch, fn, out_capacity: int
             flat = v.reshape((cap * m,) + v.shape[2:])
             cols[k] = jnp.take(flat, perm, axis=0)
     out = Batch(cols, jnp.minimum(total, out_capacity))
-    return out, total > out_capacity
+    need = jnp.where(total > out_capacity, total, 0)
+    return out, need.astype(jnp.int32)
 
 
 def zip2(a: Batch, b: Batch, suffix: str = "_r") -> Batch:

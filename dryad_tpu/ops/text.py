@@ -87,4 +87,8 @@ def split_tokens(batch: Batch, column: str, out_capacity: int,
 
     out = Batch({column: StringColumn(tok_bytes, tok_len)},
                 jnp.minimum(num_tokens, out_capacity))
-    return out, num_tokens > out_capacity
+    # second return is the NEED channel: 0 = fits, else the actual row
+    # requirement — lets the executor right-size the retry in one shot
+    # (the dynamic-manager size-feedback idea, DrDynamicDistributor.cpp:388)
+    need = jnp.where(num_tokens > out_capacity, num_tokens, 0)
+    return out, need.astype(jnp.int32)

@@ -76,6 +76,9 @@ class Stage:
     label: str = ""
     token: int = dataclasses.field(default_factory=lambda: next(_stage_tokens))
     _capacity_scale: int = 1
+    # send-slot slack factor for exchanges (C = ceil(slack*cap/D)); raised
+    # by the executor from measured skew (dynamic-distribution feedback)
+    _send_slack: int = 2
 
     def fingerprint(self) -> str:
         """Structural identity for the executor's compile cache.  Two stages
