@@ -63,7 +63,15 @@ class LocalCluster:
         self._procs: List[subprocess.Popen] = []
         self._socks: Dict[int, socket.socket] = {}
         self._listener: Optional[socket.socket] = None
+        # monotonic job id: every submission is tagged, workers echo it, and
+        # schedulers discard stale replies (a finished job may leave an
+        # ignored-duplicate reply in flight — see runtime/farm.py)
+        self._job_seq = 0
         self._start()
+
+    def next_job_id(self) -> int:
+        self._job_seq += 1
+        return self._job_seq
 
     @property
     def nparts(self) -> int:
@@ -201,9 +209,10 @@ class LocalCluster:
         """Submit one job to the gang; returns worker 0's host table."""
         if not self.alive():
             self.restart()
+        job = self.next_job_id()
         msg = {"cmd": "run", "plan": plan_json, "sources": source_specs,
                "collect": collect, "store_path": store_path,
-               "store_partitioning": store_partitioning}
+               "store_partitioning": store_partitioning, "job": job}
         for s in self._socks.values():
             s.setblocking(True)
             protocol.send_msg(s, msg)
@@ -240,8 +249,12 @@ class LocalCluster:
                         f"worker {pid} closed its control connection "
                         f"mid-job" + self._log_tails())
                 bufs[pid].extend(chunk)
-                reply = _try_decode(bufs[pid])
-                if reply is not None:
+                while True:
+                    reply = _try_decode(bufs[pid])
+                    if reply is None:
+                        break
+                    if reply.get("job") != job:   # stale prior-job frame
+                        continue
                     replies[pid] = reply
                     pending.discard(pid)
 
@@ -264,8 +277,12 @@ class LocalCluster:
                             chunk = b""
                         if chunk:
                             bufs[pid].extend(chunk)
-                            r = _try_decode(bufs[pid])
-                            if r is not None:
+                            while True:
+                                r = _try_decode(bufs[pid])
+                                if r is None:
+                                    break
+                                if r.get("job") != job:
+                                    continue
                                 replies[pid] = r
                                 pending.discard(pid)
                         else:

@@ -1,0 +1,217 @@
+"""Task-farm scheduling with straggler speculation.
+
+The counterpart of the reference's per-vertex scheduling + speculative
+duplication: DrStageStatistics fits robust completion statistics and
+requests duplicates for outliers (DrStageStatistics.cpp:403-534, capped
+at 20% duplication), DrVertex::RequestDuplicate reruns the vertex
+elsewhere, first finisher wins, and a failed machine only costs the
+vertices that ran there (ReactToFailedVertex).
+
+Gang-SPMD stages cannot speculate one shard (every collective is a
+barrier), so speculation lives where tasks ARE independent: map-style
+per-partition tasks farmed over the worker processes.  Each task runs on
+one worker's LOCAL device mesh (no cross-process collectives), so tasks
+are freely duplicable, reassignable, and survive the loss of any worker
+without a gang restart.
+"""
+
+from __future__ import annotations
+
+import select
+import statistics
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dryad_tpu.runtime import protocol
+from dryad_tpu.runtime.cluster import WorkerFailure, _try_decode
+
+__all__ = ["TaskFarm", "FarmError"]
+
+
+class FarmError(RuntimeError):
+    pass
+
+
+class _Task:
+    __slots__ = ("idx", "sources", "runs", "result", "duplicated")
+
+    def __init__(self, idx: int, sources: Dict[str, Dict[str, Any]]):
+        self.idx = idx
+        self.sources = sources
+        self.runs: Dict[int, float] = {}   # worker -> dispatch time
+        self.result: Optional[Dict[str, Any]] = None
+        self.duplicated = False
+
+
+class TaskFarm:
+    """Farm one plan over many independent per-task sources.
+
+    ``run(plan_json, per_task_sources)`` executes the SAME plan once per
+    task, each with its own source bindings, and returns the per-task host
+    tables in task order.  Straggler speculation: once ``min_samples``
+    tasks have completed, a running task whose elapsed time exceeds
+    median + max(sigma * 1.4826 * MAD, rel_margin * median, abs_margin)
+    is duplicated onto an idle worker (at most ``duplication_budget`` of
+    the task count, the reference's 20% cap); the first finisher wins.
+    A dead worker's in-flight tasks are reassigned, not failed.
+    """
+
+    def __init__(self, cluster, duplication_budget: float = 0.2,
+                 outlier_sigma: float = 3.0, min_samples: int = 5,
+                 rel_margin: float = 0.5, abs_margin_s: float = 0.5,
+                 delay_hook: Optional[Callable[[int, int], float]] = None):
+        self.cluster = cluster
+        self.duplication_budget = duplication_budget
+        self.outlier_sigma = outlier_sigma
+        self.min_samples = min_samples
+        self.rel_margin = rel_margin
+        self.abs_margin_s = abs_margin_s
+        # test hook: delay_hook(task_idx, worker_id) -> seconds the worker
+        # should sleep before executing (simulates a slow machine)
+        self.delay_hook = delay_hook
+        self.events: List[dict] = []
+
+    def _emit(self, e: dict) -> None:
+        self.events.append(e)
+        if self.cluster.event_log is not None:
+            self.cluster.event_log(dict(e))
+
+    # -- scheduling --------------------------------------------------------
+
+    def _threshold(self, durations: List[float]) -> Optional[float]:
+        if len(durations) < self.min_samples:
+            return None
+        med = statistics.median(durations)
+        mad = statistics.median([abs(d - med) for d in durations])
+        margin = max(self.outlier_sigma * 1.4826 * mad,
+                     self.rel_margin * med, self.abs_margin_s)
+        return med + margin
+
+    def run(self, plan_json: str,
+            per_task_sources: List[Dict[str, Dict[str, Any]]],
+            timeout: float = 600.0) -> List[Dict[str, Any]]:
+        cl = self.cluster
+        if not cl.alive():
+            cl.restart()
+        job = cl.next_job_id()
+        tasks = [_Task(i, s) for i, s in enumerate(per_task_sources)]
+        todo: List[_Task] = list(tasks)
+        n_done = 0
+        durations: List[float] = []
+        dup_cap = max(1, int(self.duplication_budget * len(tasks)))
+        dups_used = 0
+        idle = set(cl._socks.keys())
+        dead: set = set()
+        running: Dict[int, _Task] = {}   # worker -> task
+        bufs = {pid: bytearray() for pid in cl._socks}
+        deadline = time.time() + timeout
+
+        def dispatch(task: _Task, pid: int) -> bool:
+            delay = (self.delay_hook(task.idx, pid)
+                     if self.delay_hook else 0.0)
+            sock = cl._socks[pid]
+            try:
+                sock.setblocking(True)
+                protocol.send_msg(sock, {"cmd": "run_task",
+                                         "plan": plan_json,
+                                         "sources": task.sources,
+                                         "task": task.idx, "job": job,
+                                         "delay_s": delay})
+                sock.setblocking(False)
+            except OSError:
+                worker_lost(pid)
+                return False
+            task.runs[pid] = time.time()
+            running[pid] = task
+            idle.discard(pid)
+            return True
+
+        def worker_lost(pid: int) -> None:
+            dead.add(pid)
+            idle.discard(pid)
+            task = running.pop(pid, None)
+            if task is not None and task.result is None:
+                task.runs.pop(pid, None)
+                todo.insert(0, task)
+                self._emit({"event": "task_reassigned", "task": task.idx,
+                            "worker": pid})
+            if len(dead) == cl.n_processes:
+                raise WorkerFailure(
+                    "all workers died during task farm" + cl._log_tails())
+
+        while n_done < len(tasks):
+            if time.time() > deadline:
+                raise FarmError(
+                    f"task farm timed out; {len(tasks) - n_done} tasks "
+                    f"unfinished")
+            # fill idle workers: fresh tasks first, then speculate
+            while todo and idle:
+                t = todo.pop(0)
+                if not dispatch(t, min(idle)):
+                    todo.insert(0, t)
+            if not todo and idle and dups_used < dup_cap:
+                thr = self._threshold(durations)
+                if thr is not None:
+                    now = time.time()
+                    cands = [t for t in running.values()
+                             if t.result is None and not t.duplicated
+                             and now - min(t.runs.values()) > thr]
+                    if cands:
+                        worst = max(cands,
+                                    key=lambda t: now - min(t.runs.values()))
+                        pid = min(idle)
+                        worst.duplicated = True
+                        dups_used += 1
+                        self._emit({"event": "task_duplicated",
+                                    "task": worst.idx, "worker": pid,
+                                    "elapsed_s": round(
+                                        now - min(worst.runs.values()), 3),
+                                    "threshold_s": round(thr, 3)})
+                        dispatch(worst, pid)
+
+            # liveness + replies
+            for pid, proc in enumerate(cl._procs):
+                if pid not in dead and proc.poll() is not None:
+                    worker_lost(pid)
+            live = {cl._socks[pid]: pid for pid in cl._socks
+                    if pid not in dead}
+            if not live:
+                raise WorkerFailure("no live workers" + cl._log_tails())
+            ready, _, _ = select.select(list(live), [], [], 0.1)
+            for sock in ready:
+                pid = live[sock]
+                try:
+                    chunk = sock.recv(1 << 20)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    chunk = b""
+                if not chunk:
+                    worker_lost(pid)
+                    continue
+                bufs[pid].extend(chunk)
+                while True:
+                    reply = _try_decode(bufs[pid])
+                    if reply is None:
+                        break
+                    if reply.get("job") != job:   # stale prior-job frame
+                        continue
+                    task = running.pop(pid, None)
+                    idle.add(pid)
+                    if not reply.get("ok"):
+                        raise FarmError(
+                            f"task {reply.get('task')} failed on worker "
+                            f"{pid}:\n{reply.get('error')}")
+                    t = tasks[reply["task"]]
+                    took = time.time() - t.runs.get(pid, time.time())
+                    if t.result is None:
+                        t.result = reply["table"]
+                        n_done += 1
+                        durations.append(took)
+                        self._emit({"event": "task_done", "task": t.idx,
+                                    "worker": pid,
+                                    "wall_s": round(took, 3)})
+                    else:
+                        self._emit({"event": "task_duplicate_ignored",
+                                    "task": t.idx, "worker": pid})
+        return [t.result for t in tasks]

@@ -74,9 +74,49 @@ def main(argv=None) -> int:
         if cmd == "ping":
             protocol.send_msg(sock, {"pong": args.process_id})
             continue
+        if cmd == "run_task":
+            # independent per-partition task on the LOCAL device mesh (no
+            # cross-process collectives) — the freely duplicable /
+            # reassignable unit of the task farm (runtime/farm.py;
+            # reference DrVertex::RequestDuplicate)
+            import time as _time
+
+            reply = {"ok": True, "pid": args.process_id,
+                     "task": msg.get("task"), "job": msg.get("job")}
+            try:
+                if msg.get("delay_s"):
+                    _time.sleep(msg["delay_s"])
+                from dryad_tpu.exec.data import (maybe_shrink_for_collect,
+                                                 pdata_to_host)
+                from dryad_tpu.exec.executor import Executor
+                from dryad_tpu.plan.serialize import graph_from_json
+                from dryad_tpu.runtime.shiplan import resolve_fn_table
+                from dryad_tpu.runtime.sources import build_source
+                global _LOCAL
+                try:
+                    local_mesh, local_ex = _LOCAL
+                except NameError:
+                    local_mesh = make_mesh(devices=jax.local_devices())
+                    local_ex = Executor(local_mesh)
+                    _LOCAL = (local_mesh, local_ex)
+                fn_table = resolve_fn_table(msg["plan"], args.fn_module)
+                sources = {key: build_source(spec, local_mesh)
+                           for key, spec in msg["sources"].items()}
+                graph = graph_from_json(msg["plan"], fn_table=fn_table,
+                                        sources=sources)
+                pd = local_ex.run(graph)
+                reply["table"] = pdata_to_host(
+                    maybe_shrink_for_collect(pd))
+            except Exception:
+                reply = {"ok": False, "pid": args.process_id,
+                         "task": msg.get("task"), "job": msg.get("job"),
+                         "error": traceback.format_exc()}
+            protocol.send_msg(sock, reply)
+            continue
         if cmd == "run":
             events: list = []
-            reply: dict = {"ok": True, "pid": args.process_id}
+            reply: dict = {"ok": True, "pid": args.process_id,
+                           "job": msg.get("job")}
             try:
                 from dryad_tpu.runtime.exec_common import execute_plan
                 from dryad_tpu.runtime.shiplan import resolve_fn_table
@@ -92,6 +132,7 @@ def main(argv=None) -> int:
                     reply["table"] = table
             except Exception:
                 reply = {"ok": False, "pid": args.process_id,
+                         "job": msg.get("job"),
                          "error": traceback.format_exc()}
             reply["events"] = events
             protocol.send_msg(sock, reply)

@@ -1,0 +1,117 @@
+"""Task-farm + straggler speculation tests (DrStageStatistics.cpp:403-534,
+DrVertex::RequestDuplicate parity): independent per-partition tasks over
+the worker gang, σ-outlier duplication capped at 20%, first finisher wins,
+dead workers cost only their in-flight tasks."""
+
+import os
+import signal
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_fns  # noqa: E402
+
+from dryad_tpu.api.dataset import Context  # noqa: E402
+from dryad_tpu.plan.planner import plan_query  # noqa: E402
+from dryad_tpu.runtime import LocalCluster  # noqa: E402
+from dryad_tpu.runtime.farm import TaskFarm  # noqa: E402
+from dryad_tpu.runtime.shiplan import serialize_for_cluster  # noqa: E402
+from dryad_tpu.runtime.sources import columns_spec  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    old = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = (os.path.dirname(__file__) + os.pathsep +
+                                (old or ""))
+    cl = LocalCluster(n_processes=2, devices_per_process=2)
+    yield cl
+    cl.shutdown()
+    if old is None:
+        os.environ.pop("PYTHONPATH", None)
+    else:
+        os.environ["PYTHONPATH"] = old
+
+
+def _farm_plan(cluster):
+    """One shared plan: v -> 2v, keep positive — per-task sources rebind
+    the single source leg."""
+    ctx = Context(cluster=cluster)
+    ds = (ctx.from_columns({"v": np.arange(4, dtype=np.int32)})
+          .select(cluster_fns.double_v)
+          .where(cluster_fns.keep_positive))
+    graph = plan_query(ds.node, cluster.devices_per_process, hosts=1)
+    plan_json, specs = serialize_for_cluster(graph, ctx.fn_table)
+    (src_key,) = specs.keys()
+    return plan_json, src_key
+
+
+def _tasks(cluster, src_key, n_tasks, n_rows=400):
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-50, 50, n_rows).astype(np.int32)
+    blocks = np.array_split(vals, n_tasks)
+    per_task = [{src_key: columns_spec({"v": b},
+                                       cluster.devices_per_process)}
+                for b in blocks]
+    return vals, per_task
+
+
+def _check(vals, results):
+    got = np.concatenate([np.asarray(r["v"]) for r in results])
+    exp = (vals * 2)[vals * 2 > 0]
+    assert sorted(got.tolist()) == sorted(exp.tolist())
+
+
+def test_farm_runs_tasks(cluster):
+    plan_json, src_key = _farm_plan(cluster)
+    vals, per_task = _tasks(cluster, src_key, n_tasks=6)
+    results = TaskFarm(cluster).run(plan_json, per_task)
+    assert len(results) == 6
+    _check(vals, results)
+
+
+def test_farm_speculates_on_straggler(cluster):
+    plan_json, src_key = _farm_plan(cluster)
+    # warm the compile caches so timing statistics see steady-state tasks
+    vals0, warm = _tasks(cluster, src_key, n_tasks=4)
+    TaskFarm(cluster).run(plan_json, warm)
+
+    vals, per_task = _tasks(cluster, src_key, n_tasks=8)
+    farm = TaskFarm(cluster, min_samples=3,
+                    delay_hook=lambda task, pid: 3.0 if pid == 1 else 0.0)
+    results = farm.run(plan_json, per_task)
+    _check(vals, results)
+    dups = [e for e in farm.events if e["event"] == "task_duplicated"]
+    assert dups, farm.events            # the slow worker's task was cloned
+    assert len(dups) <= max(1, int(0.2 * 8))
+    winners = [e for e in farm.events if e["event"] == "task_done"
+               and e["task"] == dups[0]["task"]]
+    assert winners and winners[0]["worker"] == 0   # fast copy won
+
+
+def test_farm_reassigns_on_worker_death(cluster):
+    if not cluster.alive():
+        cluster.restart()
+    plan_json, src_key = _farm_plan(cluster)
+    TaskFarm(cluster).run(plan_json, _tasks(cluster, src_key, 4)[1])  # warm
+    vals, per_task = _tasks(cluster, src_key, n_tasks=8)
+    # speculation disabled (min_samples unreachable): reassignment-on-death
+    # is the only way the slow worker's task can complete
+    farm = TaskFarm(cluster, min_samples=10**6,
+                    delay_hook=lambda task, pid: 8.0 if pid == 1 else 0.0)
+    killer = threading.Timer(
+        0.5, lambda: os.kill(cluster._procs[1].pid, signal.SIGKILL))
+    killer.start()
+    try:
+        results = farm.run(plan_json, per_task)
+    finally:
+        killer.cancel()
+    _check(vals, results)               # completed without worker 1
+    assert any(e["event"] == "task_reassigned" for e in farm.events)
+    assert not cluster.alive()          # the gang lost a member...
+    ctx = Context(cluster=cluster)      # ...and gang jobs auto-restart it
+    assert ctx.from_columns({"v": np.arange(10, dtype=np.int32)}).count() \
+        == 10
