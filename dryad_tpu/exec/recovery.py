@@ -50,6 +50,16 @@ class Run:
         self._results: Dict[int, PData] = {}
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
+        # record the EXECUTED plan in the event stream (Calypso topology
+        # events role) so viewers draw the DAG that actually ran — a
+        # re-planned graph gets fresh stage ids, so a separately serialized
+        # plan would not match the stage events
+        try:
+            from dryad_tpu.plan.serialize import graph_to_json
+            self.ex._event({"event": "plan",
+                            "plan": graph_to_json(graph)})
+        except Exception:
+            pass  # plan serialization must never block execution
 
     # -- public ------------------------------------------------------------
 
