@@ -49,11 +49,14 @@ class Context:
     def __init__(self, mesh=None, local_debug: bool = False,
                  event_log: Optional[Callable[[dict], None]] = None,
                  spill_dir: Optional[str] = None,
-                 cluster=None, fn_table: Optional[Mapping[str, Any]] = None):
+                 cluster=None, fn_table: Optional[Mapping[str, Any]] = None,
+                 config=None):
+        from dryad_tpu.utils.config import JobConfig
         self.cluster = cluster
         self.fn_table = dict(fn_table or {})
         self.local_debug = local_debug
         self.spill_dir = spill_dir
+        self.config = config or JobConfig()
         if cluster is not None:
             # multi-process mode (runtime.LocalCluster): the driver owns no
             # devices; plans + deferred sources ship to the worker gang
@@ -71,7 +74,8 @@ class Context:
         # 2-D (dcn, dp) meshes trigger hierarchical aggregation plans
         self.hosts = (self.mesh.devices.shape[0]
                       if len(self.mesh.axis_names) == 2 else 1)
-        self.executor = Executor(self.mesh, event_log=event_log)
+        self.executor = Executor(self.mesh, event_log=event_log,
+                                 config=self.config)
 
     # -- cluster submission -------------------------------------------------
 
@@ -80,7 +84,8 @@ class Context:
                      store_partitioning: Optional[Dict[str, Any]] = None):
         """Plan, serialize, and submit one query to the worker gang."""
         from dryad_tpu.runtime.shiplan import serialize_for_cluster
-        graph = plan_query(node, self.nparts, hosts=self.hosts)
+        graph = plan_query(node, self.nparts, hosts=self.hosts,
+                           config=self.config)
         plan_json, specs = serialize_for_cluster(graph, self.fn_table)
         # route worker events to THIS context's logger for the duration of
         # the job (several Contexts may share one cluster)
@@ -97,9 +102,10 @@ class Context:
 
     def from_columns(self, columns: Mapping[str, Any],
                      capacity: int | None = None,
-                     str_max_len: int = 64) -> "Dataset":
+                     str_max_len: int | None = None) -> "Dataset":
         """Create a partitioned dataset from host columns (FromEnumerable,
         DryadLinqContext.cs:1210)."""
+        str_max_len = str_max_len or self.config.string_max_len
         if self.cluster is not None:
             from dryad_tpu.runtime.sources import (DeferredSource,
                                                    columns_spec)
@@ -123,10 +129,11 @@ class Context:
         return Dataset(self, node)
 
     def read_text(self, path: str, column: str = "line",
-                  max_line_len: int = 256) -> "Dataset":
+                  max_line_len: int | None = None) -> "Dataset":
         """Read a text file as one record per line (FromStore for LineRecord,
         DryadLinqContext.cs:1176 + LineRecord.cs).  Line splitting + padding
         runs in the native IO engine when built."""
+        max_line_len = max_line_len or self.config.text_max_line_len
         if self.cluster is not None:
             from dryad_tpu.runtime.sources import DeferredSource, text_spec
             spec = text_spec(path, self.nparts, column=column,
@@ -394,13 +401,15 @@ class Dataset:
                 for k, v in res.items()}
 
     def join(self, other: "Dataset", left_keys: Sequence[str],
-             right_keys: Sequence[str] | None = None, expansion: float = 1.0,
+             right_keys: Sequence[str] | None = None,
+             expansion: float | None = None,
              broadcast: bool = False, how: str = "inner") -> "Dataset":
         """Equi-join.  how="left" keeps unmatched left rows with the right
         columns zero-filled."""
         return Dataset(self.ctx, E.Join(
             parents=(self.node, other.node), left_keys=tuple(left_keys),
-            right_keys=tuple(right_keys or left_keys), expansion=expansion,
+            right_keys=tuple(right_keys or left_keys),
+            expansion=expansion or self.ctx.config.join_expansion,
             broadcast_right=broadcast, how=how))
 
     def group_join(self, other: "Dataset", left_keys: Sequence[str],
@@ -463,7 +472,7 @@ class Dataset:
 
     def _materialize(self) -> PData:
         graph = plan_query(self.node, self.ctx.nparts,
-                           hosts=self.ctx.hosts)
+                           hosts=self.ctx.hosts, config=self.ctx.config)
         return self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
 
     def collect(self) -> Dict[str, Any]:
@@ -583,4 +592,5 @@ class Dataset:
 
     def explain(self) -> str:
         return plan_query(self.node, self.ctx.nparts,
-                          hosts=self.ctx.hosts).explain()
+                          hosts=self.ctx.hosts,
+                          config=self.ctx.config).explain()

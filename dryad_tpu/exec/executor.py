@@ -66,12 +66,14 @@ def _stage_overflow_scalable(stage: Stage) -> bool:
     return any(leg.exchange is not None for leg in stage.legs)
 
 
-@jax.jit
-def _sample_lanes(col, counts):
-    """[P, _SAMPLES_PER_PART] u32 ordering lanes, each partition's first
-    min(count, S) entries evenly spread over its valid rows.  Module-level
-    jit: one compile per column shape, reused across queries."""
-    S = _SAMPLES_PER_PART
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _sample_lanes(col, counts, S: int = _SAMPLES_PER_PART):
+    """[P, S] u32 ordering lanes, each partition's first min(count, S)
+    entries evenly spread over its valid rows.  Module-level jit: one
+    compile per (column shape, S), reused across queries."""
 
     def one(c_p, cnt):
         lane = shuffle.range_dest_lane(c_p)
@@ -325,10 +327,14 @@ def _apply_exchange(b: Batch, ex: Exchange, scale: int, slack: int, bounds,
 class Executor:
     """Executes StageGraphs; owns the mesh and the per-stage compile cache."""
 
-    def __init__(self, mesh, event_log: Optional[Callable[[dict], None]] = None):
+    def __init__(self, mesh,
+                 event_log: Optional[Callable[[dict], None]] = None,
+                 config=None):
+        from dryad_tpu.utils.config import JobConfig
         self.mesh = mesh
         self.axes = tuple(mesh.axis_names)
         self.nparts = mesh.devices.size
+        self.config = config or JobConfig()
         self._event = event_log or (lambda e: None)
         # Multi-process (runtime-cluster) mode: host-side reads of sharded
         # values (overflow flags, sample lanes, counts) must first replicate
@@ -341,7 +347,7 @@ class Executor:
         # compiled programs instead of growing without bound
         from collections import OrderedDict
         self._compile_cache: "OrderedDict[Any, Callable]" = OrderedDict()
-        self._compile_cache_max = 256
+        self._compile_cache_max = self.config.compile_cache_size
 
     # -- stage program construction ---------------------------------------
 
@@ -395,8 +401,9 @@ class Executor:
         DryadLinqSampler.cs:38; VERDICT r1 weak item 3)."""
         if self.nparts == 1:
             return jnp.zeros((0,), jnp.uint32)
+        S = self.config.range_samples_per_partition
         col = src.batch.columns[key]
-        lanes = _sample_lanes(col, src.counts)  # [P, S] u32
+        lanes = _sample_lanes(col, src.counts, S)  # [P, S] u32
         counts = src.counts
         if self._multiproc:
             from dryad_tpu.exec.data import replicate_tree
@@ -405,7 +412,7 @@ class Executor:
         counts = np.asarray(counts)
         samples = []
         for p_i in range(src.nparts):
-            take = min(int(counts[p_i]), _SAMPLES_PER_PART)
+            take = min(int(counts[p_i]), S)
             if take > 0:
                 samples.append(lanes[p_i, :take])
         if not samples:
@@ -449,8 +456,9 @@ class Executor:
                 bounds = self._range_bounds(src_pd, leg.exchange.bounds_key)
 
         scale = stage._capacity_scale
-        slack = stage._send_slack
-        for attempt in range(_MAX_CAPACITY_RETRIES + 1):
+        slack = stage._send_slack or self.config.initial_send_slack
+        max_retries = self.config.max_capacity_retries
+        for attempt in range(max_retries + 1):
             key = (stage.fingerprint(), scale, slack,
                    tuple(str(jax.tree.map(lambda x: (jnp.shape(x), x.dtype),
                                           i.batch)) for i in inputs))
@@ -509,5 +517,5 @@ class Executor:
                     "(scaling retries cannot fix it)")
         raise CapacityError(
             f"stage {stage.id} ({stage.label}) still overflowing after "
-            f"{_MAX_CAPACITY_RETRIES} capacity retries (scale={scale}, "
+            f"{max_retries} capacity retries (scale={scale}, "
             f"slack={slack})" + hint)

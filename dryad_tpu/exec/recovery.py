@@ -38,14 +38,18 @@ class Run:
     def __init__(self, executor, graph: StageGraph,
                  bindings: Optional[Dict[str, PData]] = None,
                  spill_dir: Optional[str] = None,
-                 failure_budget: int = 16,
+                 failure_budget: Optional[int] = None,
                  spill_compression: Optional[str] = None):
+        cfg = getattr(executor, "config", None)
         self.ex = executor
         self.graph = graph
         self.bindings = bindings or {}
         self.spill_dir = spill_dir
-        self.spill_compression = spill_compression
-        self.failure_budget = failure_budget
+        self.spill_compression = (spill_compression if spill_compression
+                                  is not None else
+                                  (cfg.spill_compression if cfg else None))
+        self.failure_budget = (failure_budget if failure_budget is not None
+                               else (cfg.failure_budget if cfg else 16))
         self.failures = 0
         self._results: Dict[int, PData] = {}
         if spill_dir:

@@ -123,9 +123,10 @@ def _has_user_decs(aggs: Dict[str, Any]) -> bool:
 
 
 class Planner:
-    def __init__(self, npartitions: int, hosts: int = 1):
+    def __init__(self, npartitions: int, hosts: int = 1, config=None):
         self.nparts = npartitions
         self.hosts = hosts  # >1 => 2-D (dcn, dp) mesh: hierarchical aggs
+        self.config = config
         self.stages: List[Stage] = []
         self.frags: Dict[int, Fragment] = {}
         self.consumers: Dict[int, int] = {}
@@ -382,9 +383,17 @@ class Planner:
             rf = self._frag(n.parents[1])
             lkeys, rkeys = tuple(n.left_keys), tuple(n.right_keys)
             out_cap = max(1, int(lf.capacity * n.expansion))
+            # auto-broadcast a small build side (JobConfig
+            # .broadcast_join_threshold; the reference's small-side
+            # broadcast-join rewrite, DrDynamicBroadcastManager role)
+            bthresh = getattr(self.config, "broadcast_join_threshold", 0.0) \
+                if self.config else 0.0
+            broadcast_right = n.broadcast_right or (
+                bthresh > 0
+                and rf.capacity * self.nparts <= bthresh * lf.capacity)
             if self.nparts == 1:
                 lex = rex = None
-            elif n.broadcast_right:
+            elif broadcast_right:
                 rex = Exchange("broadcast",
                                out_capacity=rf.capacity * self.nparts)
                 lex = None
@@ -402,7 +411,7 @@ class Planner:
                                   "how": n.how})], "join")
             # broadcast join keeps the LEFT side's distribution (each
             # partition holds matches for its own left rows only)
-            out_part = lf.partitioning if n.broadcast_right \
+            out_part = lf.partitioning if broadcast_right \
                 else E.Partitioning("hash", lkeys)
             return Fragment(st.id, [], out_cap, out_part)
 
@@ -502,5 +511,6 @@ class Planner:
         raise TypeError(f"planner: unhandled node {type(n).__name__}")
 
 
-def plan_query(root: E.Node, npartitions: int, hosts: int = 1) -> StageGraph:
-    return Planner(npartitions, hosts=hosts).plan(root)
+def plan_query(root: E.Node, npartitions: int, hosts: int = 1,
+               config=None) -> StageGraph:
+    return Planner(npartitions, hosts=hosts, config=config).plan(root)

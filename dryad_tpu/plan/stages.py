@@ -77,8 +77,9 @@ class Stage:
     token: int = dataclasses.field(default_factory=lambda: next(_stage_tokens))
     _capacity_scale: int = 1
     # send-slot slack factor for exchanges (C = ceil(slack*cap/D)); raised
-    # by the executor from measured skew (dynamic-distribution feedback)
-    _send_slack: int = 2
+    # by the executor from measured skew (dynamic-distribution feedback);
+    # None = use JobConfig.initial_send_slack
+    _send_slack: Optional[int] = None
 
     def fingerprint(self) -> str:
         """Structural identity for the executor's compile cache.  Two stages

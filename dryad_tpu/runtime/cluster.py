@@ -50,6 +50,16 @@ class LocalCluster:
     driver anywhere reachable.  ``fn_modules`` are imported by workers to
     resolve plan callables (FN_TABLE exports + module:qualname refs)."""
 
+    @classmethod
+    def from_config(cls, config, **kw) -> "LocalCluster":
+        """Build from JobConfig cluster_* knobs (overridable via kw)."""
+        base = dict(n_processes=config.cluster_processes,
+                    devices_per_process=config.cluster_devices_per_process,
+                    fn_modules=tuple(config.cluster_fn_modules),
+                    startup_timeout=config.cluster_startup_timeout_s)
+        base.update(kw)
+        return cls(**base)
+
     def __init__(self, n_processes: int = 2, devices_per_process: int = 2,
                  fn_modules: tuple = (), startup_timeout: float = 180.0,
                  event_log: Optional[Callable[[dict], None]] = None,

@@ -56,16 +56,29 @@ class TaskFarm:
     A dead worker's in-flight tasks are reassigned, not failed.
     """
 
-    def __init__(self, cluster, duplication_budget: float = 0.2,
-                 outlier_sigma: float = 3.0, min_samples: int = 5,
-                 rel_margin: float = 0.5, abs_margin_s: float = 0.5,
+    def __init__(self, cluster, duplication_budget: Optional[float] = None,
+                 outlier_sigma: Optional[float] = None,
+                 min_samples: Optional[int] = None,
+                 rel_margin: Optional[float] = None,
+                 abs_margin_s: Optional[float] = None,
+                 config=None,
                  delay_hook: Optional[Callable[[int, int], float]] = None):
+        from dryad_tpu.utils.config import JobConfig
+        cfg = config or JobConfig()
         self.cluster = cluster
-        self.duplication_budget = duplication_budget
-        self.outlier_sigma = outlier_sigma
-        self.min_samples = min_samples
-        self.rel_margin = rel_margin
-        self.abs_margin_s = abs_margin_s
+        self.duplication_budget = (
+            duplication_budget if duplication_budget is not None
+            else (cfg.speculation_duplication_budget
+                  if cfg.speculation_enabled else 0.0))
+        self.outlier_sigma = (outlier_sigma if outlier_sigma is not None
+                              else cfg.speculation_outlier_sigma)
+        self.min_samples = (min_samples if min_samples is not None
+                            else (cfg.speculation_min_samples
+                                  if cfg.speculation_enabled else 10**9))
+        self.rel_margin = (rel_margin if rel_margin is not None
+                           else cfg.speculation_rel_margin)
+        self.abs_margin_s = (abs_margin_s if abs_margin_s is not None
+                             else cfg.speculation_abs_margin_s)
         # test hook: delay_hook(task_idx, worker_id) -> seconds the worker
         # should sleep before executing (simulates a slow machine)
         self.delay_hook = delay_hook

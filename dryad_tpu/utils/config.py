@@ -1,0 +1,144 @@
+"""Typed job configuration — the DryadLinqContext knob surface.
+
+The reference exposes ~40 typed properties on DryadLinqContext
+(DryadLinqContext.cs:728-1053: JobMinNodes/MaxNodes, PartitionUncPath,
+CompressionScheme, EnableSpeculativeDuplication, MatchClientNetFrameworkVersion,
+…).  This is the TPU-native equivalent: one frozen dataclass, validated at
+construction, threaded to every subsystem.  Each field cites the subsystem
+it controls; fields whose reference counterpart is Windows/cluster plumbing
+that has no TPU meaning are deliberately absent rather than stubbed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+__all__ = ["JobConfig"]
+
+
+@dataclasses.dataclass(frozen=True)
+class JobConfig:
+    """All knobs, grouped by subsystem.  Defaults reproduce the framework's
+    historical behavior; construct with overrides and pass to
+    ``Context(config=...)``."""
+
+    # -- executor: capacity management (exec/executor.py) ------------------
+    # retries after the first overflow; each retry is right-sized from the
+    # measured need (DrDynamicDistributionManager role)
+    max_capacity_retries: int = 3
+    # initial send-slot slack factor for exchanges (C = ceil(slack*cap/D))
+    initial_send_slack: int = 2
+    # on-device sample lanes per partition for range bounds
+    # (DryadLinqSampler.cs:38 samples 0.1%; we take a fixed per-part cap)
+    range_samples_per_partition: int = 4096
+    # compiled-stage LRU entries (per executor)
+    compile_cache_size: int = 256
+
+    # -- fault tolerance (exec/recovery.py) --------------------------------
+    # replays allowed before FailureBudgetExceeded (DrFailureDictionary,
+    # DrGraph.cpp:39)
+    failure_budget: int = 16
+    # durable stage-output spill: None disables; "gzip" compresses spill
+    # partitions (GzipCompressionChannelTransform.cpp)
+    spill_compression: Optional[str] = None
+
+    # -- collect shrink policy (exec/data.py) ------------------------------
+    # capacities at or under this are never shrunk before host transfer
+    collect_shrink_min_capacity: int = 1024
+    # shrink only when capacity exceeds this multiple of the max count
+    collect_shrink_waste_factor: int = 4
+
+    # -- text ingest (api read_text / ops/text.py) -------------------------
+    text_max_line_len: int = 256
+    # default delimiters for split_words (reference LineRecord tokenizers)
+    token_delims: bytes = b" \t\r\n"
+    token_max_len: int = 32
+    string_max_len: int = 64          # from_columns string payload bytes
+
+    # -- store (io/store.py) -----------------------------------------------
+    # default compression for to_store (None | "gzip")
+    store_compression: Optional[str] = None
+    # verify fnv64 partition checksums on read (fingerprint.cpp role)
+    store_verify_checksums: bool = True
+
+    # -- out-of-core streaming (exec/ooc.py) -------------------------------
+    ooc_chunk_rows: int = 1 << 16
+    ooc_hash_buckets: int = 16
+    # in-flight device batches for the double-buffered stream
+    ooc_inflight: int = 2
+    # host-RAM budget before bucket fragments spill to disk (bytes)
+    ooc_spill_threshold_bytes: int = 1 << 30
+    ooc_spill_compression: Optional[str] = None
+
+    # -- cluster runtime (runtime/cluster.py) ------------------------------
+    cluster_processes: int = 2
+    cluster_devices_per_process: int = 2
+    cluster_startup_timeout_s: float = 180.0
+    cluster_job_timeout_s: float = 600.0
+    cluster_fn_modules: Tuple[str, ...] = ()
+
+    # -- task farm / speculation (runtime/farm.py) -------------------------
+    # EnableSpeculativeDuplication + DrStageStatistics caps
+    speculation_enabled: bool = True
+    speculation_duplication_budget: float = 0.2
+    speculation_outlier_sigma: float = 3.0
+    speculation_min_samples: int = 5
+    speculation_rel_margin: float = 0.5
+    speculation_abs_margin_s: float = 0.5
+    farm_task_timeout_s: float = 600.0
+
+    # -- planner (plan/planner.py) -----------------------------------------
+    # default fan-out allowance for join output capacity (out = expansion *
+    # max(input caps)); per-join override via Dataset.join(expansion=...)
+    join_expansion: float = 1.0
+    # broadcast the build side instead of hash-exchanging both sides when
+    # its capacity is at most this fraction of the probe side's
+    broadcast_join_threshold: float = 0.0   # 0 disables auto-broadcast
+
+    # -- iteration (api do_while) ------------------------------------------
+    max_loop_iterations: int = 1000
+
+    def __post_init__(self):
+        checks = [
+            (self.max_capacity_retries >= 0, "max_capacity_retries >= 0"),
+            (self.initial_send_slack >= 1, "initial_send_slack >= 1"),
+            (self.range_samples_per_partition >= 2,
+             "range_samples_per_partition >= 2"),
+            (self.compile_cache_size >= 1, "compile_cache_size >= 1"),
+            (self.failure_budget >= 0, "failure_budget >= 0"),
+            (self.spill_compression in (None, "gzip"),
+             "spill_compression in (None, 'gzip')"),
+            (self.store_compression in (None, "gzip"),
+             "store_compression in (None, 'gzip')"),
+            (self.ooc_spill_compression in (None, "gzip"),
+             "ooc_spill_compression in (None, 'gzip')"),
+            (self.collect_shrink_min_capacity >= 1,
+             "collect_shrink_min_capacity >= 1"),
+            (self.collect_shrink_waste_factor >= 1,
+             "collect_shrink_waste_factor >= 1"),
+            (self.text_max_line_len >= 1, "text_max_line_len >= 1"),
+            (self.token_max_len >= 1, "token_max_len >= 1"),
+            (self.string_max_len >= 1, "string_max_len >= 1"),
+            (len(self.token_delims) >= 1, "token_delims non-empty"),
+            (self.ooc_chunk_rows >= 1, "ooc_chunk_rows >= 1"),
+            (self.ooc_hash_buckets >= 1, "ooc_hash_buckets >= 1"),
+            (self.ooc_inflight >= 1, "ooc_inflight >= 1"),
+            (self.cluster_processes >= 1, "cluster_processes >= 1"),
+            (self.cluster_devices_per_process >= 1,
+             "cluster_devices_per_process >= 1"),
+            (0.0 <= self.speculation_duplication_budget <= 1.0,
+             "speculation_duplication_budget in [0, 1]"),
+            (self.speculation_min_samples >= 1,
+             "speculation_min_samples >= 1"),
+            (self.join_expansion > 0, "join_expansion > 0"),
+            (self.broadcast_join_threshold >= 0,
+             "broadcast_join_threshold >= 0"),
+            (self.max_loop_iterations >= 1, "max_loop_iterations >= 1"),
+        ]
+        for ok, msg in checks:
+            if not ok:
+                raise ValueError(f"JobConfig: {msg}")
+
+    def replace(self, **kw) -> "JobConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,104 @@
+"""JobConfig knob-surface tests (DryadLinqContext.cs:728-1053 parity):
+every knob must demonstrably change subsystem behavior, not just exist."""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context
+from dryad_tpu.exec.executor import CapacityError
+from dryad_tpu.utils.config import JobConfig
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="max_capacity_retries"):
+        JobConfig(max_capacity_retries=-1)
+    with pytest.raises(ValueError, match="spill_compression"):
+        JobConfig(spill_compression="zstd")
+    with pytest.raises(ValueError, match="duplication_budget"):
+        JobConfig(speculation_duplication_budget=1.5)
+    assert JobConfig().replace(failure_budget=2).failure_budget == 2
+
+
+def test_zero_retries_fails_on_first_overflow():
+    ctx = Context(config=JobConfig(max_capacity_retries=0))
+    rng = np.random.default_rng(0)
+    n = 30_000
+    k = np.where(rng.random(n) < 0.9, 0,
+                 rng.integers(1, 100, n)).astype(np.int32)
+    with pytest.raises(CapacityError, match="0 capacity retries"):
+        ctx.from_columns({"k": k}).hash_partition(["k"]).collect()
+
+
+def test_small_range_samples_still_sort_correctly():
+    ctx = Context(config=JobConfig(range_samples_per_partition=16))
+    v = np.random.default_rng(1).integers(0, 10**6, 5000).astype(np.int32)
+    out = ctx.from_columns({"v": v}).order_by([("v", False)]).collect()
+    np.testing.assert_array_equal(np.asarray(out["v"]), np.sort(v))
+
+
+def test_failure_budget_zero():
+    from dryad_tpu.exec.recovery import FailureBudgetExceeded, Run
+    from dryad_tpu.plan.planner import plan_query
+    ctx = Context(config=JobConfig(failure_budget=0))
+    ds = ctx.from_columns({"v": np.arange(100, dtype=np.int32)}) \
+        .group_by(["v"], {"n": ("count", None)})
+    graph = plan_query(ds.node, ctx.nparts, config=ctx.config)
+    run = Run(ctx.executor, graph)
+    run.output()
+    with pytest.raises(FailureBudgetExceeded):
+        run.invalidate(graph.out_stage)
+
+
+def test_auto_broadcast_join_threshold():
+    cfg = JobConfig(broadcast_join_threshold=0.5)
+    ctx = Context(config=cfg)
+    big = ctx.from_columns({"k": np.arange(10_000, dtype=np.int32) % 50,
+                            "v": np.arange(10_000, dtype=np.int32)})
+    tiny = ctx.from_columns({"k": np.arange(50, dtype=np.int32),
+                             "w": np.arange(50, dtype=np.int32) * 2})
+    joined = big.join(tiny, ["k"], ["k"])
+    assert "broadcast" in joined.explain()     # rewrite fired
+    out = joined.collect()
+    assert len(out["k"]) == 10_000
+    assert (np.asarray(out["w"]) == np.asarray(out["k"]) * 2).all()
+    # without the knob the same join hash-exchanges both sides
+    ctx2 = Context()
+    joined2 = ctx2.from_columns(
+        {"k": np.arange(10_000, dtype=np.int32) % 50,
+         "v": np.arange(10_000, dtype=np.int32)}).join(
+        ctx2.from_columns({"k": np.arange(50, dtype=np.int32),
+                           "w": np.arange(50, dtype=np.int32) * 2}),
+        ["k"], ["k"])
+    assert "broadcast" not in joined2.explain()
+
+
+def test_join_expansion_default_avoids_retry():
+    events, events2 = [], []
+    k = np.arange(2000, dtype=np.int32) % 500
+    rk = np.repeat(np.arange(500, dtype=np.int32), 4)   # 4x fan-out
+    # generous source capacity so the exchange itself never overflows and
+    # only the join fan-out is at play
+    # default expansion 1.0: output 16x pairs per key -> overflow retry
+    ctx = Context(event_log=events.append)
+    ctx.from_columns({"k": k}, capacity=600).join(
+        ctx.from_columns({"k": rk, "w": rk}, capacity=600),
+        ["k"], ["k"]).collect()
+    assert any(e.get("overflow") for e in events
+               if e.get("event") == "stage_done")
+    # config join_expansion=4: right-sized up front, no retry
+    ctx2 = Context(event_log=events2.append,
+                   config=JobConfig(join_expansion=4.0))
+    ctx2.from_columns({"k": k}, capacity=600).join(
+        ctx2.from_columns({"k": rk, "w": rk}, capacity=600),
+        ["k"], ["k"]).collect()
+    assert not any(e.get("overflow") for e in events2
+                   if e.get("event") == "stage_done")
+
+
+def test_text_defaults_from_config(tmp_path):
+    p = str(tmp_path / "t.txt")
+    with open(p, "w") as f:
+        f.write("abcdefghij\nklm\n")
+    ctx = Context(config=JobConfig(text_max_line_len=4))
+    out = ctx.read_text(p).collect()
+    assert out["line"] == [b"abcd", b"klm"]   # truncation knob applied
