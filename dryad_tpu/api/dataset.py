@@ -128,29 +128,38 @@ class Context:
                         _partitioning=partitioning, host=host)
         return Dataset(self, node)
 
-    def read_text(self, path: str, column: str = "line",
+    def read_text(self, path, column: str = "line",
                   max_line_len: int | None = None) -> "Dataset":
-        """Read a text file as one record per line (FromStore for LineRecord,
-        DryadLinqContext.cs:1176 + LineRecord.cs).  Line splitting + padding
-        runs in the native IO engine when built."""
+        """Read text as one record per line (FromStore for LineRecord,
+        DryadLinqContext.cs:1176 + LineRecord.cs).  ``path`` may be a single
+        file, a glob pattern, a directory, or a list of those — multi-file
+        inputs are enumerated and packed in parallel (DrPartitionFile
+        input-partition enumeration, DataPath.cs:124).  Line splitting +
+        padding runs in the native IO engine when built."""
+        from dryad_tpu.io.providers import expand_paths, read_text_files
         max_line_len = max_line_len or self.config.text_max_line_len
+        paths = expand_paths(path)
         if self.cluster is not None:
             from dryad_tpu.runtime.sources import DeferredSource, text_spec
-            spec = text_spec(path, self.nparts, column=column,
+            spec = text_spec(paths, self.nparts, column=column,
                              max_line_len=max_line_len)
             node = E.Source(parents=(), data=DeferredSource(spec),
                             _npartitions=self.nparts)
             return Dataset(self, node)
-        from dryad_tpu import native
         from dryad_tpu.exec.data import pdata_from_packed_strings
-        with open(path, "rb") as f:
-            buf = f.read()
-        data, lens = native.pack_lines(buf, max_line_len)
+        data, lens, _ = read_text_files(paths, max_line_len)
         pdata = pdata_from_packed_strings(data, lens, self.mesh,
                                           column=column)
         host = {column: [bytes(r[:l]) for r, l in
                          zip(data, lens)]} if self.local_debug else None
         return self.from_pdata(pdata, host=host)
+
+    def read(self, uri: str, **kw) -> "Dataset":
+        """URI-scheme dispatch (DataProvider.cs / concreterchannel.cpp:44-49):
+        ``file://`` text, ``store://`` partitioned store, plus any scheme
+        registered via io.providers.register_provider."""
+        from dryad_tpu.io.providers import open_uri
+        return open_uri(self, uri, **kw)
 
     def from_store(self, path: str, capacity: int | None = None) -> "Dataset":
         """Load a persisted dataset (FromStore, DryadLinqContext.cs:1176).

@@ -72,11 +72,15 @@ def columns_spec(columns: Mapping[str, Any], nparts: int,
             "str_max_len": str_max_len}
 
 
-def text_spec(path: str, nparts: int, column: str = "line",
+def text_spec(path, nparts: int, column: str = "line",
               max_line_len: int = 256) -> Dict[str, Any]:
-    return {"kind": "text", "path": path, "column": column,
+    """``path``: one file path or a list of file paths (already expanded by
+    io.providers.expand_paths; workers read them from the shared fs)."""
+    paths = [path] if isinstance(path, str) else list(path)
+    n = sum(count_lines_file(p) for p in paths)
+    return {"kind": "text", "paths": paths, "column": column,
             "max_line_len": max_line_len,
-            "capacity": _block_capacity(count_lines_file(path), nparts)}
+            "capacity": _block_capacity(n, nparts)}
 
 
 def store_spec(path: str, nparts: int, meta: Dict[str, Any],
@@ -100,11 +104,10 @@ def build_source(spec: Dict[str, Any], mesh):
                                capacity=spec["capacity"],
                                str_max_len=spec["str_max_len"])
     if kind == "text":
-        from dryad_tpu import native
         from dryad_tpu.exec.data import pdata_from_packed_strings
-        with open(spec["path"], "rb") as f:
-            buf = f.read()
-        data, lens = native.pack_lines(buf, spec["max_line_len"])
+        from dryad_tpu.io.providers import read_text_files
+        paths = spec.get("paths") or [spec["path"]]
+        data, lens, _ = read_text_files(paths, spec["max_line_len"])
         return pdata_from_packed_strings(data, lens, mesh,
                                          column=spec["column"],
                                          capacity=spec["capacity"])

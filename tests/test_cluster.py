@@ -115,3 +115,14 @@ def test_cluster_worker_failure_detection_and_restart(cluster):
     # process-level failure recovery (ReactToFailedVertex role)
     assert ctx.from_columns({"v": v}).count() == 100
     assert cluster.alive()
+
+
+def test_cluster_read_text_multifile(cluster, tmp_path):
+    (tmp_path / "a.txt").write_text("one two\nthree\n")
+    (tmp_path / "b.txt").write_text("four\nfive six seven\n")
+    ctx = Context(cluster=cluster)
+    ds = ctx.read_text(str(tmp_path / "*.txt"))
+    assert ds.count() == 4
+    words = (ds.split_words("line", out_capacity=256)
+             .group_by(["line"], {"n": ("count", None)})).collect()
+    assert sorted(int(x) for x in words["n"]) == [1] * 7
