@@ -130,9 +130,31 @@ def _chunk_to_batch(chunk: HChunk, capacity: int) -> Batch:
     return Batch(cols, jnp.asarray(chunk.n, jnp.int32))
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def _slice_rows(batch: Batch, m: int) -> Batch:
+    """Device-side leading-dim slice (valid rows sit at the front after
+    every compacting kernel)."""
+    return jax.tree.map(lambda x: x[:m] if x.ndim else x, batch)
+
+
 def _batch_to_chunk(batch: Batch) -> HChunk:
-    """Fetch a device Batch's valid rows to host (blocks)."""
+    """Fetch a device Batch's valid rows to host (blocks).
+
+    The device->host link can be orders of magnitude slower than HBM (on a
+    remote-tunnel chip it is the bottleneck), so the batch is sliced ON
+    DEVICE to the next pow2 >= count before transfer — pow2 buckets bound
+    the number of slice-program compiles while cutting the transfer from
+    full capacity to ~valid rows (channelbuffer write-coalescing role)."""
     n = int(batch.count)
+    cap = 0
+    for v in batch.columns.values():
+        cap = v.data.shape[0] if isinstance(v, StringColumn) else v.shape[0]
+        break
+    m = 1
+    while m < max(n, 1):
+        m *= 2
+    if m < cap:
+        batch = _slice_rows(batch, m)
     cols: Dict[str, HostCol] = {}
     for k, v in batch.columns.items():
         if isinstance(v, StringColumn):

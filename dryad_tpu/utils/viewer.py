@@ -69,7 +69,7 @@ def _collect_stages(events) -> Dict[int, Dict[str, Any]]:
         s = stages.setdefault(sid, {
             "label": e.get("label", f"stage {sid}"), "runs": [],
             "retries": 0, "replays": 0, "scale": 1, "slack": 2,
-            "wall_s": 0.0})
+            "wall_s": 0.0, "compile_s": 0.0, "rows": 0, "out_bytes": 0})
         if e.get("label"):
             s["label"] = e["label"]
         if e["event"] == "stage_done":
@@ -79,6 +79,11 @@ def _collect_stages(events) -> Dict[int, Dict[str, Any]]:
                               "overflow": bool(e.get("overflow")),
                               "scale": e.get("scale", 1)})
             s["wall_s"] += wall
+            s["compile_s"] += float(e.get("compile_s", 0.0))
+            if e.get("rows") is not None:
+                s["rows"] = int(sum(e["rows"]))
+            if e.get("out_bytes"):
+                s["out_bytes"] = int(e["out_bytes"])
             s["scale"] = max(s["scale"], e.get("scale", 1))
             s["slack"] = max(s["slack"], e.get("slack", 2))
             if e.get("overflow"):
@@ -187,6 +192,7 @@ def _svg_gantt(stages, order) -> str:
 def _table(stages, order) -> str:
     head = ("<tr><th>stage</th><th>label</th><th>runs</th><th>retries</th>"
             "<th>replays</th><th>scale</th><th>slack</th>"
+            "<th>rows</th><th>out&nbsp;MiB</th><th>compile&nbsp;s</th>"
             "<th>wall&nbsp;s</th></tr>")
     rows = []
     for sid in order:
@@ -195,7 +201,10 @@ def _table(stages, order) -> str:
             f"<tr><td>{sid}</td><td>{html.escape(str(s['label']))}</td>"
             f"<td>{len(s['runs'])}</td><td>{s['retries']}</td>"
             f"<td>{s['replays']}</td><td>{s['scale']}</td>"
-            f"<td>{s['slack']}</td><td>{s['wall_s']:.3f}</td></tr>")
+            f"<td>{s['slack']}</td><td>{s['rows']}</td>"
+            f"<td>{s['out_bytes'] / (1 << 20):.1f}</td>"
+            f"<td>{s['compile_s']:.3f}</td>"
+            f"<td>{s['wall_s']:.3f}</td></tr>")
     return f"<table>{head}{''.join(rows)}</table>"
 
 
