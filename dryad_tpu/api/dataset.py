@@ -198,10 +198,41 @@ class Context:
         predicate on the collected current table) can stop early.
         """
         if self.cluster is not None:
-            raise NotImplementedError(
-                "do_while is not yet supported in cluster mode — run "
-                "iterative queries in-process or checkpoint per iteration "
-                "via to_store/from_store")
+            # iterate by re-submitting the planned body, binding the
+            # previous iteration's collected table as the loop source —
+            # the body plan's fingerprints are identical every round, so
+            # workers (persistent executors, runtime/exec_common.py) compile
+            # each stage once.  Reference DoWhile re-runs the loop subgraph
+            # per iteration the same way (DryadLinqQueryGen.cs:3353).
+            import dataclasses as _dc
+
+            from dryad_tpu.runtime.sources import (DeferredSource,
+                                                   columns_spec)
+            ph = E.Placeholder(parents=(), name="__loop",
+                               _npartitions=self.nparts)
+            body_node = body(Dataset(self, ph)).node
+
+            def subst(node):
+                if isinstance(node, E.Placeholder) and node.name == "__loop":
+                    spec = columns_spec(cur, self.nparts)
+                    return E.Source(parents=(),
+                                    data=DeferredSource(spec),
+                                    _npartitions=self.nparts)
+                new_parents = tuple(subst(p) for p in node.parents)
+                if new_parents == node.parents:
+                    return node
+                return _dc.replace(node, parents=new_parents)
+
+            cur = init.collect()
+            for _ in range(min(n_iters, self.config.max_loop_iterations)):
+                cur = self._cluster_run(subst(body_node))
+                if cond is not None and not cond(cur):
+                    break
+            node = E.Source(parents=(),
+                            data=DeferredSource(
+                                columns_spec(cur, self.nparts)),
+                            _npartitions=self.nparts, host=cur)
+            return Dataset(self, node)
         if self.local_debug:
             cur_host = _oracle.run_oracle(init.node)
             ph = E.Placeholder(parents=(), name="__loop",

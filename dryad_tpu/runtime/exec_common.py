@@ -13,6 +13,19 @@ from typing import Any, Callable, Dict, Optional
 
 __all__ = ["execute_plan"]
 
+_EXECUTORS: Dict[int, Any] = {}
+
+
+def _gang_executor(mesh):
+    """One persistent Executor per mesh, so the compiled-stage cache
+    survives across submitted jobs (iterative queries re-submit the same
+    body plan every iteration — identical fingerprints must hit)."""
+    from dryad_tpu.exec.executor import Executor
+    ex = _EXECUTORS.get(id(mesh))
+    if ex is None:
+        ex = _EXECUTORS[id(mesh)] = Executor(mesh)
+    return ex
+
 
 def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
                  source_specs: Dict[str, Dict[str, Any]], mesh,
@@ -36,7 +49,8 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
     sources = {key: build_source(spec, mesh)
                for key, spec in source_specs.items()}
     graph = graph_from_json(plan_json, fn_table=fn_table, sources=sources)
-    ex = Executor(mesh, event_log=event_log)
+    ex = _gang_executor(mesh)
+    ex._event = event_log or (lambda e: None)
     pd = ex.run(graph)
 
     table = None

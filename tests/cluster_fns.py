@@ -12,3 +12,7 @@ def keep_positive(cols):
 
 
 FN_TABLE = {}
+
+
+def inc_v(cols):
+    return dict(cols, v=cols["v"] + 1)

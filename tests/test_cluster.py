@@ -126,3 +126,14 @@ def test_cluster_read_text_multifile(cluster, tmp_path):
     words = (ds.split_words("line", out_capacity=256)
              .group_by(["line"], {"n": ("count", None)})).collect()
     assert sorted(int(x) for x in words["n"]) == [1] * 7
+
+
+def test_cluster_do_while(cluster):
+    ctx = Context(cluster=cluster)
+    init = ctx.from_columns({"v": np.arange(8, dtype=np.int32)})
+    out = ctx.do_while(init, lambda d: d.select(cluster_fns.inc_v),
+                       n_iters=5,
+                       cond=lambda t: int(max(t["v"])) < 10).collect()
+    # stop fires when max v reaches 10 (3 iterations: 7 -> 10)
+    np.testing.assert_array_equal(np.sort(np.asarray(out["v"])),
+                                  np.arange(8) + 3)
