@@ -68,7 +68,10 @@ class Run:
     # -- public ------------------------------------------------------------
 
     def output(self) -> PData:
-        return self.result(self.graph.out_stage)
+        out = self.result(self.graph.out_stage)
+        self.ex._event({"event": "progress", "done": len(self._results),
+                        "total": len(self.graph.stages), "pct": 100.0})
+        return out
 
     def result(self, sid: int) -> PData:
         if sid in self._results:
@@ -84,6 +87,12 @@ class Run:
         out = self.ex._run_stage(stage, self._results, self.bindings)
         self._results[sid] = out
         self._save_spill(sid, out)
+        # progress percentage pushed to the event stream (the reference
+        # pushes it to the launcher, DrGraph.cpp:109-110)
+        total = len(self.graph.stages)
+        self.ex._event({"event": "progress", "done": len(self._results),
+                        "total": total,
+                        "pct": round(100.0 * len(self._results) / total, 1)})
         return out
 
     def invalidate(self, sid: int, count_failure: bool = True,

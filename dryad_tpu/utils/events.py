@@ -17,14 +17,33 @@ from typing import Any, Dict, List, Optional
 __all__ = ["EventLog", "job_report"]
 
 
-class EventLog:
-    """In-memory + optional JSONL-file event sink."""
+# event kinds by verbosity level (DRYAD_LOGGING_LEVEL role,
+# LinqToDryadJM.cs:213): 0=errors only, 1=+stage/job lifecycle, 2=all
+_LEVELS = {
+    "stage_replay": 0, "worker_failed": 0,
+    "stage_done": 1, "plan": 1, "stage_spilled": 1, "stage_restored": 1,
+    "task_done": 1, "task_duplicated": 1, "task_reassigned": 1,
+    "progress": 2, "task_duplicate_ignored": 2,
+}
 
-    def __init__(self, path: Optional[str] = None):
+
+class EventLog:
+    """In-memory + optional JSONL-file event sink.
+
+    ``level`` filters by verbosity (default: env ``DRYAD_LOGGING_LEVEL`` or
+    2 = everything); unknown event kinds always pass."""
+
+    def __init__(self, path: Optional[str] = None,
+                 level: Optional[int] = None):
+        import os
         self.events: List[Dict[str, Any]] = []
         self._f = open(path, "a") if path else None
+        self.level = (level if level is not None
+                      else int(os.environ.get("DRYAD_LOGGING_LEVEL", "2")))
 
     def __call__(self, event: Dict[str, Any]) -> None:
+        if _LEVELS.get(event.get("event"), 0) > self.level:
+            return
         e = dict(event)
         e.setdefault("ts", round(time.time(), 4))
         self.events.append(e)
