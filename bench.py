@@ -53,6 +53,8 @@ def _stage_breakdown(log):
 
 
 def main():
+    global _T0
+    _T0 = time.time()
     import jax
 
     from benchmarks import micro
@@ -148,6 +150,95 @@ def main():
     # bytes crossing the exchange per second: key(10)+lens(4)+payload(4)
     ooc_shuffle_gbps = n_ooc * 18 / ooc_d2 / (1 << 30)
 
+    # ---- configs 3-5 (GroupByReduce / PageRank x10 / k-means) ----
+    # BASELINE.md asks for per-stage wall clock for these.  First compiles
+    # through the remote tunnel cost 40-140s per app, so each config runs
+    # ONCE (events split compile from run) and only while the time budget
+    # (BENCH_BUDGET_S) allows; skipped configs report the last recorded
+    # single-run measurement from benchmarks/extra_results.json, clearly
+    # dated — never passed off as fresh.
+    import os
+
+    budget = float(os.environ.get("BENCH_BUDGET_S", "480"))
+
+    def _remaining():
+        return budget - (time.time() - _T0)
+
+    def _stage_sums(log):
+        comp = sum(e.get("compile_s", 0) for e in log.of_type("stage_done"))
+        runw = sum(e.get("wall_s", 0) for e in log.of_type("stage_done"))
+        return round(comp, 2), round(runw, 3)
+
+    last = {}
+    try:
+        import json as _json
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "extra_results.json")) as f:
+            last = _json.load(f)
+    except OSError:
+        pass
+
+    def _last(name):
+        out = {"skipped_for_budget": True}
+        if name in last:
+            out["last_measured"] = dict(last[name],
+                                        date=last.get("measured_date"))
+        return out
+
+    extras = {}
+    from dryad_tpu.apps import groupbyreduce, kmeans, pagerank
+
+    if _remaining() > 90:
+        _note("bench: groupbyreduce...")
+        gb_log = EventLog()
+        ctx3 = Context(mesh=mesh, event_log=gb_log)
+        n_gb = 2_000_000
+        pairs = groupbyreduce.gen_pairs(n_gb, 10_000)
+        t0 = time.time()
+        groupbyreduce.groupbyreduce_query(ctx3.from_columns(pairs)).collect()
+        comp, runw = _stage_sums(gb_log)
+        extras["groupbyreduce"] = {
+            "rows": n_gb, "wall_s_incl_compile": round(time.time() - t0, 2),
+            "compile_s": comp, "stage_run_s": runw,
+            "rows_per_sec_chip_run": round(n_gb / max(runw, 1e-9) / nchips,
+                                           1),
+            "stages_wall_s": _stage_breakdown(gb_log)}
+    else:
+        extras["groupbyreduce"] = _last("groupbyreduce")
+
+    if _remaining() > 100:
+        _note("bench: kmeans...")
+        km_log = EventLog()
+        ctx5 = Context(mesh=mesh, event_log=km_log)
+        pts, _ = kmeans.gen_points(500_000, 8, 16)
+        t0 = time.time()
+        kmeans.kmeans(ctx5, pts, 16, n_iters=5)
+        comp, runw = _stage_sums(km_log)
+        extras["kmeans_5iter"] = {
+            "points": 500_000, "dim": 8, "k": 16,
+            "wall_s_incl_compile": round(time.time() - t0, 2),
+            "compile_s": comp, "stage_run_s": runw,
+            "stages_wall_s": _stage_breakdown(km_log)}
+    else:
+        extras["kmeans_5iter"] = _last("kmeans_5iter")
+
+    if _remaining() > 230:
+        _note("bench: pagerank x10...")
+        pr_log = EventLog()
+        ctx4 = Context(mesh=mesh, event_log=pr_log)
+        n_nodes, n_edges = 100_000, 1_000_000
+        edges = pagerank.gen_graph(n_nodes, n_edges)
+        t0 = time.time()
+        pagerank.pagerank(ctx4, edges, n_nodes, n_iters=10)
+        comp, runw = _stage_sums(pr_log)
+        extras["pagerank_10iter"] = {
+            "nodes": n_nodes, "edges": n_edges,
+            "wall_s_incl_compile": round(time.time() - t0, 2),
+            "compile_s": comp, "stage_run_s": runw,
+            "stages_wall_s": _stage_breakdown(pr_log)}
+    else:
+        extras["pagerank_10iter"] = _last("pagerank_10iter")
+
     # ---- shuffle vs line rate ----
     if "all_to_all_gbps_per_device" in m:
         line_rate = m["all_to_all_gbps_per_device"]
@@ -191,11 +282,15 @@ def main():
                 "rows_per_sec_chip": round(ooc_rows, 1),
                 "shuffle_gbps_achieved": round(ooc_shuffle_gbps, 4),
             },
+            **extras,
             "shuffle": {
                 "fabric": fabric,
                 "shuffle_gbps_achieved": round(achieved, 4),
                 "shuffle_gbps_line_rate": round(line_rate, 4),
                 "pct_of_line_rate": round(100 * achieved / line_rate, 1),
+                **({"note": "pct>100 = link-rate variance on the shared "
+                            "remote tunnel between the two measurements"}
+                   if achieved > line_rate else {}),
             },
             "transport": {k: (round(v, 4) if isinstance(v, float) else v)
                           for k, v in m.items()},

@@ -20,7 +20,7 @@ Key idioms:
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -243,7 +243,9 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     count/mean-parts) merges partial aggregates — that is how the distributed
     GroupBy works (planner splits it into local combine -> shuffle -> merge).
     """
-    sb, seg, is_start, num_groups = _group_segments(batch, key_names)
+    hi, lo = hash_batch_keys(batch, key_names)
+    order, seg, is_start, num_groups = _hash_sort_segments(
+        hi, lo, batch.valid_mask())
     cap = batch.capacity
     n_valid = batch.count
 
@@ -258,16 +260,29 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     counts_g = jnp.where(gmask, end_excl - start_pos, 0)
 
     out_cols = {}
-    # representative row per group = its segment's first (sorted) row
-    rep = sb.gather(jnp.where(gmask, start_pos, 0))
+    # representative row per group = its segment's first (sorted) row —
+    # gathered straight from the ORIGINAL batch (compose the sort
+    # permutation with the start positions) so the full sorted batch is
+    # never materialized; agg value columns are sorted individually
+    # (narrow [cap] gathers instead of one wide string gather)
+    rep_idx = jnp.take(order, jnp.where(gmask, start_pos, 0))
+    rep = batch.gather(rep_idx)
     for k in key_names:
         out_cols[k] = rep.columns[k]
+
+    sorted_cols: Dict[str, Any] = {}
+
+    def _sorted_col(name):
+        if name not in sorted_cols:
+            sorted_cols[name] = jnp.take(batch.columns[name], order,
+                                         axis=0)
+        return sorted_cols[name]
 
     for out_name, (kind, vname) in aggs.items():
         if kind == "count":
             out = counts_g
         elif kind in ("sum", "mean"):
-            v = sb.columns[vname]
+            v = _sorted_col(vname)
             if jnp.issubdtype(v.dtype, jnp.floating):
                 # floats keep per-segment accumulation (scatter): the
                 # prefix-difference trick costs ~1e-3 relative error under
@@ -285,15 +300,17 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
                     if jnp.issubdtype(s.dtype, jnp.floating) \
                     else s.astype(jnp.float32) / c
         elif kind == "min":
-            out = jax.ops.segment_min(sb.columns[vname], seg, num_segments=cap)
+            out = jax.ops.segment_min(_sorted_col(vname), seg,
+                                      num_segments=cap)
         elif kind == "max":
-            out = jax.ops.segment_max(sb.columns[vname], seg, num_segments=cap)
+            out = jax.ops.segment_max(_sorted_col(vname), seg,
+                                      num_segments=cap)
         elif kind == "any":
-            s = _seg_sum_sorted(sb.columns[vname].astype(jnp.int32),
+            s = _seg_sum_sorted(_sorted_col(vname).astype(jnp.int32),
                                 start_pos, end_excl, num_groups, n_valid)
             out = s > 0
         elif kind == "all":
-            s = _seg_sum_sorted(sb.columns[vname].astype(jnp.int32),
+            s = _seg_sum_sorted(_sorted_col(vname).astype(jnp.int32),
                                 start_pos, end_excl, num_groups, n_valid)
             out = s == counts_g
         else:
