@@ -380,7 +380,13 @@ class Executor:
                     cur, nd = _apply_op(cur, op, scale, [],
                                         self.axes, slack)
                 needs = jnp.maximum(needs, nd)
-            return _expand(cur), needs[None]
+            # ONE small per-shard info vector [need_scale, need_slack,
+            # out_count]: the executor host-fetches exactly one array per
+            # stage — a second fetch per stage costs a full link round
+            # trip, which dominates iterative jobs on high-latency links
+            info = jnp.concatenate([needs,
+                                    cur.count.astype(jnp.int32)[None]])
+            return _expand(cur), info[None]
 
         in_specs = tuple([P(self.axes)] * n_legs +
                          ([P()] if has_bounds else []))
@@ -482,19 +488,16 @@ class Executor:
             else:
                 self._compile_cache.move_to_end(key)
             t0 = time.time()
-            out_batch, needs = fn(*args)
+            out_batch, info = fn(*args)
             if self._multiproc:
                 from dryad_tpu.exec.data import replicate_tree
-                needs, out_counts = replicate_tree(
-                    (needs, out_batch.count), self.mesh)
-            else:
-                out_counts = out_batch.count
-            needs = np.asarray(needs)  # [P, 2]  (device sync point)
+                info = replicate_tree(info, self.mesh)
+            info = np.asarray(info)  # [P, 3]  (the ONE device sync point)
             wall = time.time() - t0
-            need_scale = int(needs[:, 0].max())
-            need_slack = int(needs[:, 1].max())
+            need_scale = int(info[:, 0].max())
+            need_slack = int(info[:, 1].max())
             of = need_scale > 0 or need_slack > 0
-            rows = np.asarray(out_counts).tolist()
+            rows = info[:, 2].tolist()
             out_bytes = int(sum(
                 x.size * x.dtype.itemsize
                 for x in jax.tree.leaves(out_batch)))
