@@ -508,6 +508,27 @@ class Dataset:
         """Replicate to every partition (small datasets)."""
         return Dataset(self.ctx, E.Broadcast(parents=(self.node,)))
 
+    def cache(self) -> "Dataset":
+        """Materialize NOW and reuse the result in later queries — the
+        reference's materialized-temp-table pattern (ToStore + FromStore
+        around loop-invariant subqueries; temp outputs committed at
+        DrVertex.h:325).  Essential under ``do_while``: the loop body
+        re-executes everything it references each iteration, so hoist
+        loop-invariant joins/aggregations with ``.cache()`` first."""
+        if self.ctx.local_debug:
+            t = _oracle.run_oracle(self.node)
+            node = E.Source(parents=(), data=None,
+                            _npartitions=self.ctx.nparts, host=t)
+            return Dataset(self.ctx, node)
+        part = self.node.partitioning
+        if self.ctx.cluster is not None:
+            # cluster v1: round-trip through the driver (partitioning
+            # claims drop — the re-shipped source is block-partitioned)
+            t = self.ctx._cluster_run(self.node)
+            return self.ctx.from_columns(t)
+        pd = self._materialize()
+        return self.ctx.from_pdata(pd, partitioning=part)
+
     # -- terminals ---------------------------------------------------------
 
     def _materialize(self) -> PData:

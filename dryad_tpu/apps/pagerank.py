@@ -32,8 +32,9 @@ def pagerank(ctx: Context, edges: dict, n_nodes: int, n_iters: int = 10,
              damping: float = 0.85) -> dict:
     edges_ds = ctx.from_columns(edges)
     deg = edges_ds.group_by(["src"], {"deg": ("count", None)})
-    # edges joined with out-degree once, outside the loop
-    edges_deg = edges_ds.join(deg, ["src"], ["src"], expansion=2.0)
+    # edges joined with out-degree ONCE, materialized outside the loop —
+    # without .cache() the do_while body re-runs this join every superstep
+    edges_deg = edges_ds.join(deg, ["src"], ["src"], expansion=2.0).cache()
 
     nodes = {"node": np.arange(n_nodes, dtype=np.int32),
              "rank": np.full(n_nodes, 1.0 / n_nodes, np.float32)}

@@ -162,3 +162,28 @@ def test_zip_misaligned_partitions(ctx, dbg):
         np.testing.assert_array_equal(np.asarray(got[col]),
                                       np.asarray(exp[col]),
                                       err_msg=col)
+
+
+def test_cache_materializes_once():
+    import numpy as np
+
+    from dryad_tpu import Context
+    events = []
+    ctx = Context(event_log=events.append)
+    base = ctx.from_columns({"k": np.arange(100, dtype=np.int32) % 7,
+                             "v": np.arange(100, dtype=np.int32)})
+    agg = base.group_by(["k"], {"s": ("sum", "v")}).cache()
+    mark = len(events)
+    assert any(e.get("event") == "stage_done"
+               for e in events)              # cache ran the query eagerly
+    r1 = agg.collect()
+    r2 = agg.where(lambda c: c["s"] > 0).count()
+    # downstream queries never re-ran the groupby (only output/filter
+    # stages were added after the cache point)
+    assert all(e.get("label") != "groupby"
+               for e in events[mark:] if e.get("event") == "stage_done")
+    exp = {kk: int(sum(v for k2, v in zip(np.arange(100) % 7,
+                                          np.arange(100)) if k2 == kk))
+           for kk in range(7)}
+    got = dict(zip(r1["k"].tolist(), r1["s"].tolist()))
+    assert got == exp and r2 == 7
