@@ -160,7 +160,8 @@ def _alloc_part_views(schema, n: int) -> Tuple[List[np.ndarray],
     return segs, cols
 
 
-def read_store(path: str, mesh, capacity: Optional[int] = None) -> PData:
+def read_store(path: str, mesh, capacity: Optional[int] = None,
+               partitions: Optional[List[int]] = None) -> PData:
     """Load a dataset store as sharded PData (FromStore,
     DryadLinqContext.cs:1176).
 
@@ -169,22 +170,28 @@ def read_store(path: str, mesh, capacity: Optional[int] = None) -> PData:
     preserved), so persisted hash/range placement — honored by
     ``from_store`` for shuffle elimination — stays valid.  Only when the
     counts differ are rows re-blocked evenly (and ``from_store`` then drops
-    the partitioning claim)."""
+    the partitioning claim).
+
+    ``partitions`` reads only the listed store partitions (the per-task
+    input granularity of the task farm — one vertex per partition file,
+    DrPartitionFile.cpp:607)."""
     meta = store_meta(path)
-    nparts_store = meta["npartitions"]
-    counts = meta["counts"]
+    part_ids = (list(range(meta["npartitions"])) if partitions is None
+                else list(partitions))
+    counts = [meta["counts"][p] for p in part_ids]
+    nparts_store = len(part_ids)
     schema = meta["schema"]
     nparts = mesh.devices.size
 
     paths, segments, partviews = [], [], []
-    for p in range(nparts_store):
-        segs, cols = _alloc_part_views(schema, counts[p])
+    for p in part_ids:
+        segs, cols = _alloc_part_views(schema, meta["counts"][p])
         paths.append(_part_path(path, p))
         segments.append(segs)
         partviews.append(cols)
     native.read_files(paths, segments,
                       compress=(meta.get("compression") == "gzip"))
-    verify_checksums(path, meta, segments)
+    verify_checksums(path, meta, segments, partitions=part_ids)
 
     if nparts_store == nparts:
         # verbatim per-partition load: placement-preserving

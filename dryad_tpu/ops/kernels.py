@@ -391,18 +391,38 @@ def _emit_finalized(out_cols, out_name, fin, merged, valid_rows):
         out_cols[cname] = jnp.where(m, v, 0)
 
 
+def resolve_dec_spec(spec):
+    """Dec spec -> (seed, merge, finalize) callables.  Specs are either a
+    plan.expr.Decomposable (user-defined; ships by fn_table registration)
+    or a ("__builtin__", kind, col) tag rebuilt here on the executing side
+    (keeps plans serializable — runtime/shiplan.py)."""
+    if isinstance(spec, tuple) and len(spec) == 3 and \
+            spec[0] == "__builtin__":
+        from dryad_tpu.plan.planner import _builtin_as_decomposable
+        d = _builtin_as_decomposable(spec[1], spec[2])
+        return (d.seed, d.merge, d.finalize)
+    if hasattr(spec, "seed"):
+        return (spec.seed, spec.merge, spec.finalize)
+    return spec  # already a triple (direct kernel callers)
+
+
+def _resolve_decs(decs):
+    return {k: resolve_dec_spec(v) for k, v in decs.items()}
+
+
 def group_decompose_partial(batch: Batch, key_names: Sequence[str],
                             decs: Dict[str, Tuple], state_box: Dict
                             ) -> Batch:
     """Map-side combine for user-defined decomposable aggregates.
 
-    decs: out_name -> (seed, merge, finalize) callables.  ``seed(columns)``
+    decs: out_name -> dec spec (see resolve_dec_spec).  ``seed(columns)``
     maps the row columns to a state pytree (vectorized over rows);
     ``merge(a, b)`` is the associative combine.  Output: key columns + the
     flattened state leaves as columns ``{out}@{i}``; the treedefs are
     published into ``state_box`` for the merge/finalize stage
     (reference IDecomposable.cs:34 Initialize/Seed/Accumulate).
     """
+    decs = _resolve_decs(decs)
     out_cols, merged_states, num_groups, valid_rows = _group_states(
         batch, key_names, decs, state_box)
     for out_name, merged in merged_states.items():
@@ -416,6 +436,7 @@ def group_decompose_local(batch: Batch, key_names: Sequence[str],
                           decs: Dict[str, Tuple], state_box: Dict) -> Batch:
     """Single-pass decomposable GroupBy (co-located input): seed + merge +
     FinalReduce in one fused kernel."""
+    decs = _resolve_decs(decs)
     out_cols, merged_states, num_groups, valid_rows = _group_states(
         batch, key_names, decs, state_box)
     for out_name, merged in merged_states.items():
@@ -430,6 +451,7 @@ def group_decompose_merge(batch: Batch, key_names: Sequence[str],
     """Reduce-side merge of partial states (columns ``{out}@{i}``), plus
     FinalReduce when ``finalize`` (reference IDecomposable.cs:34
     RecursiveAccumulate/FinalReduce)."""
+    decs = _resolve_decs(decs)
     sb, seg, is_start, num_groups = _group_segments(batch, key_names)
     cap = batch.capacity
 

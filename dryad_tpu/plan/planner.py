@@ -102,17 +102,19 @@ def _builtin_as_decomposable(kind: str, col: Optional[str]):
     raise ValueError(f"aggregate kind {kind!r} not decomposable")
 
 
-def _normalize_decs(aggs: Dict[str, Any]) -> Dict[str, Tuple]:
-    """aggs (builtin tuples and/or Decomposables) -> out -> (seed, merge,
-    finalize) triples."""
+def _normalize_decs(aggs: Dict[str, Any]) -> Dict[str, Any]:
+    """aggs (builtin tuples and/or Decomposables) -> out -> SHIPPABLE dec
+    spec: the user's Decomposable object itself (registrable by name for
+    cluster shipping) or a ("__builtin__", kind, col) tag rebuilt on the
+    executing side.  Kernels resolve specs to (seed, merge, finalize)
+    triples at trace time (ops.kernels.resolve_dec_spec)."""
     out = {}
     for name, spec in aggs.items():
         if isinstance(spec, E.Decomposable):
-            out[name] = (spec.seed, spec.merge, spec.finalize)
+            out[name] = spec
         else:
             kind, col = spec
-            d = _builtin_as_decomposable(kind, col)
-            out[name] = (d.seed, d.merge, d.finalize)
+            out[name] = ("__builtin__", kind, col)
     return out
 
 

@@ -20,68 +20,82 @@ from dryad_tpu.plan.stages import Exchange, Leg, Stage, StageGraph, StageOp
 __all__ = ["graph_to_json", "graph_from_json"]
 
 
-def _op_to_json(op: StageOp, fn_names: Dict[int, str]) -> dict:
-    params = {}
-    for k, v in op.params.items():
-        if not isinstance(v, (str, int, float, bool, type(None))) \
-                and id(v) in fn_names:
+# params carrying planner-internal mutable state shared between ops of one
+# plan (decomposable treedef boxes): contents are rebuilt at trace time on
+# the executing side, but IDENTITY must survive — partial and merge stages
+# share one box instance
+_EPHEMERAL_PARAMS = {"box"}
+
+
+def _op_to_json(op: StageOp, fn_names: Dict[int, str],
+                shared: Dict[int, int]) -> dict:
+    def enc(v: Any, pname: str) -> Any:
+        if isinstance(v, (str, int, float, bool, type(None))):
+            return v
+        if id(v) in fn_names:
             # explicitly registered shipping name (runtime/shiplan.py) —
-            # covers non-callable opaque values (decomposable boxes) too
-            params[k] = {"__fn__": fn_names[id(v)]}
-        elif callable(v):
-            params[k] = {"__fn__": fn_names.get(id(v), f"fn_{id(v):x}")}
-        elif isinstance(v, bytes):
-            params[k] = {"__bytes__": v.decode("latin1")}
-        elif isinstance(v, tuple):
-            params[k] = {"__tuple__": list(v)}
-        elif isinstance(v, dict):
+            # covers non-callable values (user Decomposables) too
+            return {"__fn__": fn_names[id(v)]}
+        if callable(v):
+            return {"__fn__": fn_names.get(id(v), f"fn_{id(v):x}")}
+        if isinstance(v, bytes):
+            return {"__bytes__": v.decode("latin1")}
+        if pname in _EPHEMERAL_PARAMS and isinstance(v, dict):
+            sid = shared.setdefault(id(v), len(shared))
+            return {"__ephemeral__": sid}
+        if isinstance(v, (tuple, list)):
+            return {"__tuple__": [enc(x, pname) for x in v]}
+        if isinstance(v, dict):
             try:
-                enc = {kk: list(vv) if isinstance(vv, tuple) else vv
-                       for kk, vv in v.items()}
-                json.dumps(enc)
-                params[k] = {"__dict__": enc}
+                json.dumps(v)
+                return {"__dict__": dict(v)}
             except TypeError:
-                # opaque structured param (e.g. decomposable seed/merge/
-                # finalize triples, treedef boxes): structurally noted only;
-                # re-execution re-binds via fn_table like other UDFs
-                params[k] = {"__opaque__": f"{op.kind}.{k}"}
-        else:
-            params[k] = v
-    return {"kind": op.kind, "params": params}
+                return {"__dict__": {kk: enc(vv, pname)
+                                     for kk, vv in v.items()}}
+        # opaque leaf: structurally noted; re-execution re-binds via
+        # fn_table like other UDFs
+        return {"__opaque__": f"{op.kind}.{pname}"}
+
+    return {"kind": op.kind,
+            "params": {k: enc(v, k) for k, v in op.params.items()}}
 
 
-def _op_from_json(d: dict, fn_table: Optional[Dict[str, Callable]]) -> StageOp:
-    params: Dict[str, Any] = {}
-    for k, v in d["params"].items():
+def _op_from_json(d: dict, fn_table: Optional[Dict[str, Callable]],
+                  shared: Dict[int, dict]) -> StageOp:
+    def dec(v: Any) -> Any:
         if isinstance(v, dict) and "__fn__" in v:
             name = v["__fn__"]
             if fn_table is None or name not in fn_table:
                 raise KeyError(
-                    f"plan references callable {name!r}; pass it in fn_table")
-            params[k] = fn_table[name]
-        elif isinstance(v, dict) and "__bytes__" in v:
-            params[k] = v["__bytes__"].encode("latin1")
-        elif isinstance(v, dict) and "__opaque__" in v:
+                    f"plan references callable {name!r}; pass it in "
+                    f"fn_table")
+            return fn_table[name]
+        if isinstance(v, dict) and "__bytes__" in v:
+            return v["__bytes__"].encode("latin1")
+        if isinstance(v, dict) and "__ephemeral__" in v:
+            return shared.setdefault(v["__ephemeral__"], {})
+        if isinstance(v, dict) and "__opaque__" in v:
             name = v["__opaque__"]
             if fn_table is None or name not in fn_table:
                 raise KeyError(
                     f"plan references opaque param {name!r}; pass it in "
                     f"fn_table")
-            params[k] = fn_table[name]
-        elif isinstance(v, dict) and "__tuple__" in v:
-            params[k] = tuple(tuple(x) if isinstance(x, list) else x
-                              for x in v["__tuple__"])
-        elif isinstance(v, dict) and "__dict__" in v:
-            params[k] = {kk: tuple(vv) if isinstance(vv, list) else vv
-                         for kk, vv in v["__dict__"].items()}
-        else:
-            params[k] = v
-    return StageOp(d["kind"], params)
+            return fn_table[name]
+        if isinstance(v, dict) and "__tuple__" in v:
+            return tuple(dec(x) for x in v["__tuple__"])
+        if isinstance(v, dict) and "__dict__" in v:
+            return {kk: dec(vv) for kk, vv in v["__dict__"].items()}
+        if isinstance(v, list):   # legacy tuple-in-dict encoding
+            return tuple(dec(x) for x in v)
+        return v
+
+    return StageOp(d["kind"], {k: dec(v) for k, v in d["params"].items()})
 
 
 def graph_to_json(graph: StageGraph,
                   fn_names: Optional[Dict[int, str]] = None) -> str:
     fn_names = fn_names or {}
+    shared: Dict[int, int] = {}
     stages = []
     for st in graph.stages:
         legs = []
@@ -102,10 +116,12 @@ def graph_to_json(graph: StageGraph,
                       "bounds_key": e.bounds_key,
                       "axis": e.axis}
             legs.append({"src": src,
-                         "ops": [_op_to_json(o, fn_names) for o in leg.ops],
+                         "ops": [_op_to_json(o, fn_names, shared)
+                                 for o in leg.ops],
                          "exchange": ex})
         stages.append({"id": st.id, "label": st.label, "legs": legs,
-                       "body": [_op_to_json(o, fn_names) for o in st.body]})
+                       "body": [_op_to_json(o, fn_names, shared)
+                                for o in st.body]})
     return json.dumps({"version": 1, "stages": stages,
                        "out_stage": graph.out_stage}, indent=1)
 
@@ -115,6 +131,7 @@ def graph_from_json(s: str, fn_table: Optional[Dict[str, Callable]] = None,
     """Rebuild a StageGraph.  ``sources`` maps (stage_id, leg_index) source
     slots — keyed "sid:leg" — to bound data handles."""
     d = json.loads(s)
+    shared: Dict[int, dict] = {}
     stages = []
     for sd in d["stages"]:
         legs = []
@@ -135,10 +152,10 @@ def graph_from_json(s: str, fn_table: Optional[Dict[str, Callable]] = None,
                 ex = Exchange(e["kind"], tuple(e["keys"]), e["out_capacity"],
                               e["descending"], e["bounds_from"],
                               e["bounds_key"], axis=e.get("axis"))
-            legs.append(Leg(lsrc, [_op_from_json(o, fn_table)
+            legs.append(Leg(lsrc, [_op_from_json(o, fn_table, shared)
                                    for o in ld["ops"]], ex))
         stages.append(Stage(id=sd["id"], legs=legs,
-                            body=[_op_from_json(o, fn_table)
+                            body=[_op_from_json(o, fn_table, shared)
                                   for o in sd["body"]],
                             label=sd["label"]))
     return StageGraph(stages, d["out_stage"])

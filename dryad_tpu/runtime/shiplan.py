@@ -26,14 +26,6 @@ class PlanShipError(RuntimeError):
     pass
 
 
-def _json_ok(v: Any) -> bool:
-    try:
-        json.dumps(v)
-        return True
-    except TypeError:
-        return False
-
-
 def _import_ref(fn: Callable) -> str | None:
     """``module:qualname`` if re-importing it yields the same object."""
     mod = getattr(fn, "__module__", None)
@@ -49,51 +41,56 @@ def _import_ref(fn: Callable) -> str | None:
     return f"{mod}:{qual}" if obj is fn else None
 
 
+# serializer-ephemeral params (rebuilt on the executing side) need no refs
+_EPHEMERAL_PARAMS = {"box"}
+
+
 def _collect_refs(graph: StageGraph,
                   user_names: Dict[int, str]) -> Dict[int, str]:
-    """id(value) -> shipping name for every non-JSON op param."""
+    """id(value) -> shipping name for every non-JSON value reachable from
+    op params (recursing into dicts/tuples — e.g. user Decomposables
+    inside a group's ``decs`` dict)."""
     fn_names: Dict[int, str] = {}
+
+    def visit(v: Any, op, pname: str) -> None:
+        if isinstance(v, (str, int, float, bool, bytes, type(None))):
+            return
+        if id(v) in user_names:
+            fn_names[id(v)] = user_names[id(v)]
+            return
+        if callable(v):
+            ref = _import_ref(v)
+            if ref is None:
+                raise PlanShipError(
+                    f"op {op.kind!r} param {pname!r}: callable "
+                    f"{getattr(v, '__qualname__', v)!r} is not importable "
+                    f"(lambda/closure?) — move it to module level, or "
+                    f"register it by name in Context(fn_table=...) and "
+                    f"export it from a worker --fn-module FN_TABLE")
+            fn_names[id(v)] = ref
+            return
+        if isinstance(v, (tuple, list)):
+            for x in v:
+                visit(x, op, pname)
+            return
+        if isinstance(v, dict):
+            for x in v.values():
+                visit(x, op, pname)
+            return
+        raise PlanShipError(
+            f"op {op.kind!r} param {pname!r} ({type(v).__name__}) is "
+            f"not serializable for cluster execution — register it by "
+            f"name in Context(fn_table=...) and export it from a worker "
+            f"--fn-module FN_TABLE")
+
     for st in graph.stages:
         ops = [o for leg in st.legs for o in leg.ops] + list(st.body)
         for op in ops:
             for k, v in op.params.items():
-                if isinstance(v, (str, int, float, bool, bytes,
-                                  type(None))):
+                if k in _EPHEMERAL_PARAMS:
                     continue
-                if id(v) in user_names:
-                    fn_names[id(v)] = user_names[id(v)]
-                    continue
-                if callable(v):
-                    ref = _import_ref(v)
-                    if ref is None:
-                        raise PlanShipError(
-                            f"op {op.kind!r} param {k!r}: callable "
-                            f"{getattr(v, '__qualname__', v)!r} is not "
-                            f"importable (lambda/closure?) — move it to "
-                            f"module level, or register it by name in "
-                            f"Context(fn_table=...) and export it from a "
-                            f"worker --fn-module FN_TABLE")
-                    fn_names[id(v)] = ref
-                    continue
-                if _json_ok(v) or (isinstance(v, (tuple, list, dict))
-                                   and _json_ok_structure(v)):
-                    continue
-                raise PlanShipError(
-                    f"op {op.kind!r} param {k!r} ({type(v).__name__}) is "
-                    f"not serializable for cluster execution — register "
-                    f"it by name in Context(fn_table=...) and export it "
-                    f"from a worker --fn-module FN_TABLE")
+                visit(v, op, k)
     return fn_names
-
-
-def _json_ok_structure(v: Any) -> bool:
-    """Matches the value shapes plan.serialize._op_to_json round-trips
-    (scalars, bytes, nested tuples/lists, dicts of those)."""
-    if isinstance(v, (tuple, list)):
-        return all(_json_ok_structure(x) for x in v)
-    if isinstance(v, dict):
-        return all(_json_ok_structure(x) for x in v.values())
-    return isinstance(v, (str, int, float, bool, bytes, type(None)))
 
 
 def serialize_for_cluster(graph: StageGraph,
@@ -118,15 +115,23 @@ def serialize_for_cluster(graph: StageGraph,
 
 
 def _scan_names(plan_json: str) -> Iterable[str]:
+    def walk(v):
+        if isinstance(v, dict):
+            if "__fn__" in v:
+                yield v["__fn__"]
+            if "__opaque__" in v:
+                yield v["__opaque__"]
+            for x in v.values():
+                yield from walk(x)
+        elif isinstance(v, list):
+            for x in v:
+                yield from walk(x)
+
     d = json.loads(plan_json)
     for st in d["stages"]:
         ops = [o for leg in st["legs"] for o in leg["ops"]] + st["body"]
         for op in ops:
-            for v in op["params"].values():
-                if isinstance(v, dict) and "__fn__" in v:
-                    yield v["__fn__"]
-                if isinstance(v, dict) and "__opaque__" in v:
-                    yield v["__opaque__"]
+            yield from walk(op["params"])
 
 
 def resolve_fn_table(plan_json: str,

@@ -84,14 +84,21 @@ def text_spec(path, nparts: int, column: str = "line",
 
 
 def store_spec(path: str, nparts: int, meta: Dict[str, Any],
-               capacity: int | None = None) -> Dict[str, Any]:
+               capacity: int | None = None,
+               partitions: list | None = None) -> Dict[str, Any]:
+    """``partitions`` restricts to the listed store partitions — the
+    per-task input granularity for farming a big store (one task per
+    partition group, DrPartitionFile.cpp:607 role)."""
     counts = meta.get("counts", [])
-    if meta["npartitions"] == nparts:
+    if partitions is not None:
+        counts = [counts[p] for p in partitions]
+    if partitions is None and meta["npartitions"] == nparts:
         cap = capacity or max(int(meta.get("capacity", 0)),
                               max(counts or [0]), 1)
     else:
         cap = capacity or _block_capacity(sum(counts), nparts)
-    return {"kind": "store", "path": path, "capacity": cap}
+    return {"kind": "store", "path": path, "capacity": cap,
+            "partitions": partitions}
 
 
 def build_source(spec: Dict[str, Any], mesh):
@@ -113,5 +120,6 @@ def build_source(spec: Dict[str, Any], mesh):
                                          capacity=spec["capacity"])
     if kind == "store":
         from dryad_tpu.io.store import read_store
-        return read_store(spec["path"], mesh, capacity=spec["capacity"])
+        return read_store(spec["path"], mesh, capacity=spec["capacity"],
+                          partitions=spec.get("partitions"))
     raise ValueError(f"unknown source kind {kind!r}")

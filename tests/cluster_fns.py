@@ -16,3 +16,21 @@ FN_TABLE = {}
 
 def inc_v(cols):
     return dict(cols, v=cols["v"] + 1)
+
+
+def _topsum_seed(cols):
+    return cols["v"]
+
+
+def _topsum_merge(a, b):
+    return a + b
+
+
+def make_sum_dec():
+    from dryad_tpu.plan.expr import Decomposable
+    return Decomposable(_topsum_seed, _topsum_merge, None)
+
+
+# registered-by-name objects for cluster shipping (shiplan FN_TABLE path)
+SUM_DEC = make_sum_dec()
+FN_TABLE = {"sum_dec": SUM_DEC}

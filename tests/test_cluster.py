@@ -137,3 +137,48 @@ def test_cluster_do_while(cluster):
     # stop fires when max v reaches 10 (3 iterations: 7 -> 10)
     np.testing.assert_array_equal(np.sort(np.asarray(out["v"])),
                                   np.arange(8) + 3)
+
+
+def test_cluster_setops_and_group_join(cluster):
+    ctx = Context(cluster=cluster)
+    a = ctx.from_columns({"k": np.arange(30, dtype=np.int32)})
+    b = ctx.from_columns({"k": np.arange(20, 50, dtype=np.int32)})
+    inter = a.intersect(b).collect()
+    assert sorted(np.asarray(inter["k"]).tolist()) == list(range(20, 30))
+    ex = a.except_(b).collect()
+    assert sorted(np.asarray(ex["k"]).tolist()) == list(range(20))
+    # group_join: each LEFT row paired with the aggregate of its matching
+    # right group
+    left = ctx.from_columns({"k": np.arange(3, dtype=np.int32)})
+    right = ctx.from_columns({"k": np.arange(10, dtype=np.int32) % 3,
+                              "v": np.arange(10, dtype=np.int32)})
+    out = left.group_join(right, ["k"],
+                          {"total": ("sum", "v"),
+                           "n": ("count", None)}).collect()
+    got = {int(k): (int(t), int(n)) for k, t, n in
+           zip(out["k"], out["total"], out["n"])}
+    ks = np.arange(10) % 3
+    vs = np.arange(10)
+    exp = {kk: (int(vs[ks == kk].sum()), int((ks == kk).sum()))
+           for kk in range(3)}
+    assert got == exp
+
+
+def test_cluster_registered_decomposable(cluster):
+    """User Decomposable shipped via FN_TABLE registration on both ends
+    (Context(fn_table=...) naming + worker --fn-module resolution)."""
+    cl2 = LocalCluster(n_processes=2, devices_per_process=2,
+                       fn_modules=("cluster_fns",))
+    try:
+        ctx = Context(cluster=cl2,
+                      fn_table={"sum_dec": cluster_fns.SUM_DEC})
+        k = np.arange(40, dtype=np.int32) % 5
+        v = np.arange(40, dtype=np.int32)
+        out = ctx.from_columns({"k": k, "v": v}).group_by(
+            ["k"], {"s": cluster_fns.SUM_DEC}).collect()
+        got = dict(zip(np.asarray(out["k"]).tolist(),
+                       np.asarray(out["s"]).tolist()))
+        exp = {kk: int(v[k == kk].sum()) for kk in range(5)}
+        assert got == exp
+    finally:
+        cl2.shutdown()

@@ -115,3 +115,31 @@ def test_farm_reassigns_on_worker_death(cluster):
     ctx = Context(cluster=cluster)      # ...and gang jobs auto-restart it
     assert ctx.from_columns({"v": np.arange(10, dtype=np.int32)}).count() \
         == 10
+
+
+def test_farm_over_store_partitions(cluster, tmp_path):
+    """Per-task input = a group of store partitions (the reference's
+    one-vertex-per-partition-file model, DrPartitionFile.cpp:607)."""
+    import numpy as np
+
+    from dryad_tpu.io.store import store_meta
+    from dryad_tpu.runtime.sources import store_spec
+
+    if not cluster.alive():
+        cluster.restart()
+    ctx = Context(cluster=cluster)
+    path = str(tmp_path / "farm_store")
+    vals = np.arange(200, dtype=np.int32) - 100
+    ctx.from_columns({"v": vals}).to_store(path)
+    meta = store_meta(path)
+    nparts = meta["npartitions"]
+    plan_json, src_key = _farm_plan(cluster)
+    groups = [list(range(i, min(i + 2, nparts)))
+              for i in range(0, nparts, 2)]
+    per_task = [{src_key: store_spec(path, cluster.devices_per_process,
+                                     meta, partitions=g)}
+                for g in groups]
+    results = TaskFarm(cluster).run(plan_json, per_task)
+    got = np.concatenate([np.asarray(r["v"]) for r in results])
+    exp = (vals * 2)[vals * 2 > 0]
+    assert sorted(got.tolist()) == sorted(exp.tolist())
