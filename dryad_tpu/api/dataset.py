@@ -179,7 +179,8 @@ class Context:
             node = E.Source(parents=(), data=DeferredSource(spec),
                             _npartitions=self.nparts, _partitioning=part)
             return Dataset(self, node)
-        pdata = read_store(path, self.mesh, capacity=capacity)
+        pdata = read_store(path, self.mesh, capacity=capacity,
+                           verify=self.config.store_verify_checksums)
         return self.from_pdata(pdata, partitioning=part)
 
     # -- iteration ---------------------------------------------------------
@@ -285,10 +286,17 @@ class Dataset:
                                           label=label))
 
     def split_words(self, column: str, out_capacity: int,
-                    max_token_len: int = 24,
-                    delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>",
+                    max_token_len: int | None = None,
+                    delims: bytes | None = None,
                     lower: bool = False) -> "Dataset":
-        """Tokenizing SelectMany (the WordCount flat-map)."""
+        """Tokenizing SelectMany (the WordCount flat-map).  Token length
+        and delimiter defaults come from JobConfig (token_max_len,
+        token_delims + punctuation)."""
+        cfg = self.ctx.config
+        if max_token_len is None:
+            max_token_len = cfg.token_max_len
+        if delims is None:
+            delims = cfg.token_delims
         return Dataset(self.ctx, E.FlatTokens(
             parents=(self.node,), column=column, out_capacity=out_capacity,
             max_token_len=max_token_len, delims=delims, lower=lower))
@@ -558,6 +566,8 @@ class Dataset:
         GzipCompressionChannelTransform.cpp)."""
         from dryad_tpu.io.store import write_store
         part = self.node.partitioning
+        if compression is None:
+            compression = self.ctx.config.store_compression
         if self.ctx.cluster is not None:
             if compression is not None:
                 raise NotImplementedError(

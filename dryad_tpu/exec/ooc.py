@@ -189,10 +189,13 @@ class ChunkSource:
     # -- constructors ------------------------------------------------------
 
     @staticmethod
-    def from_arrays(columns: Dict[str, Any], chunk_rows: int,
+    def from_arrays(columns: Dict[str, Any], chunk_rows: int | None = None,
                     str_max_len: int = 64) -> "ChunkSource":
         """Slice host arrays (dense ndarrays or str/bytes lists) into
         chunks."""
+        if chunk_rows is None:
+            from dryad_tpu.utils.config import JobConfig
+            chunk_rows = JobConfig().ooc_chunk_rows
         conv: Dict[str, HostCol] = {}
         n = None
         for k, v in columns.items():
@@ -594,7 +597,7 @@ def _sorted_bucket_chunks(schema, frags: List[HChunk],
 def external_sort(src: ChunkSource, keys: Sequence[Tuple[str, bool]],
                   n_buckets: int | None = None,
                   spill_dir: Optional[str] = None,
-                  depth: int = 2) -> Iterator[HChunk]:
+                  depth: int | None = None) -> Iterator[HChunk]:
     """Globally sort an arbitrarily large chunk stream; yields sorted
     chunks in order.  Device working set stays O(chunk_rows).
 
@@ -604,6 +607,9 @@ def external_sort(src: ChunkSource, keys: Sequence[Tuple[str, bool]],
     range buckets make concatenation globally sorted, exactly the
     TeraSort plan (sampling + RangePartition, BASELINE.md config 2).
     """
+    if depth is None:
+        from dryad_tpu.utils.config import JobConfig
+        depth = JobConfig().ooc_inflight
     chunk_rows = src.chunk_rows
     key0, desc0 = keys[0]
 
@@ -652,8 +658,8 @@ def external_sort(src: ChunkSource, keys: Sequence[Tuple[str, bool]],
 
 def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
                               aggs: Dict[str, Tuple[str, Optional[str]]],
-                              n_buckets: int = 64,
-                              depth: int = 2) -> Iterator[HChunk]:
+                              n_buckets: int | None = None,
+                              depth: int | None = None) -> Iterator[HChunk]:
     """GroupBy+aggregate over an arbitrarily large chunk stream.
 
     Per chunk (on device): partial aggregate, then hash-scatter the partial
@@ -665,6 +671,12 @@ def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
     yielded.  Distinct keys per bucket must fit chunk capacity; raise
     ``n_buckets`` for higher-cardinality keys.
     """
+    if depth is None or n_buckets is None:
+        from dryad_tpu.utils.config import JobConfig
+        _cfg = JobConfig()
+        depth = depth if depth is not None else _cfg.ooc_inflight
+        n_buckets = (n_buckets if n_buckets is not None
+                     else _cfg.ooc_hash_buckets)
     from dryad_tpu.plan.planner import _decompose_aggs
 
     partial, final, mean_cols = _decompose_aggs(dict(aggs))

@@ -161,7 +161,8 @@ def _alloc_part_views(schema, n: int) -> Tuple[List[np.ndarray],
 
 
 def read_store(path: str, mesh, capacity: Optional[int] = None,
-               partitions: Optional[List[int]] = None) -> PData:
+               partitions: Optional[List[int]] = None,
+               verify: bool = True) -> PData:
     """Load a dataset store as sharded PData (FromStore,
     DryadLinqContext.cs:1176).
 
@@ -191,7 +192,8 @@ def read_store(path: str, mesh, capacity: Optional[int] = None,
         partviews.append(cols)
     native.read_files(paths, segments,
                       compress=(meta.get("compression") == "gzip"))
-    verify_checksums(path, meta, segments, partitions=part_ids)
+    if verify:
+        verify_checksums(path, meta, segments, partitions=part_ids)
 
     if nparts_store == nparts:
         # verbatim per-partition load: placement-preserving

@@ -52,8 +52,8 @@ class JobConfig:
     # -- text ingest (api read_text / ops/text.py) -------------------------
     text_max_line_len: int = 256
     # default delimiters for split_words (reference LineRecord tokenizers)
-    token_delims: bytes = b" \t\r\n"
-    token_max_len: int = 32
+    token_delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>"
+    token_max_len: int = 24
     string_max_len: int = 64          # from_columns string payload bytes
 
     # -- store (io/store.py) -----------------------------------------------
@@ -63,13 +63,12 @@ class JobConfig:
     store_verify_checksums: bool = True
 
     # -- out-of-core streaming (exec/ooc.py) -------------------------------
+    # default chunk size for ChunkSource constructors
     ooc_chunk_rows: int = 1 << 16
-    ooc_hash_buckets: int = 16
-    # in-flight device batches for the double-buffered stream
+    # default scatter fan-out for streaming_group_aggregate
+    ooc_hash_buckets: int = 64
+    # in-flight device batches for the double-buffered stream (depth)
     ooc_inflight: int = 2
-    # host-RAM budget before bucket fragments spill to disk (bytes)
-    ooc_spill_threshold_bytes: int = 1 << 30
-    ooc_spill_compression: Optional[str] = None
 
     # -- cluster runtime (runtime/cluster.py) ------------------------------
     cluster_processes: int = 2
@@ -111,8 +110,6 @@ class JobConfig:
              "spill_compression in (None, 'gzip')"),
             (self.store_compression in (None, "gzip"),
              "store_compression in (None, 'gzip')"),
-            (self.ooc_spill_compression in (None, "gzip"),
-             "ooc_spill_compression in (None, 'gzip')"),
             (self.collect_shrink_min_capacity >= 1,
              "collect_shrink_min_capacity >= 1"),
             (self.collect_shrink_waste_factor >= 1,
