@@ -92,9 +92,10 @@ class Context:
         prev_log = self.cluster.event_log
         self.cluster.event_log = self._event_log
         try:
-            return self.cluster.execute(plan_json, specs, collect=collect,
-                                        store_path=store_path,
-                                        store_partitioning=store_partitioning)
+            return self.cluster.execute(
+                plan_json, specs, collect=collect, store_path=store_path,
+                store_partitioning=store_partitioning, config=self.config,
+                timeout=self.config.cluster_job_timeout_s)
         finally:
             self.cluster.event_log = prev_log
 
@@ -198,6 +199,11 @@ class Context:
         are compiled once and reused (shapes are stable).  ``cond`` (host
         predicate on the collected current table) can stop early.
         """
+        if n_iters > self.config.max_loop_iterations:
+            raise ValueError(
+                f"n_iters={n_iters} exceeds JobConfig.max_loop_iterations="
+                f"{self.config.max_loop_iterations}; raise the knob "
+                f"explicitly for longer loops")
         if self.cluster is not None:
             # iterate by re-submitting the planned body, binding the
             # previous iteration's collected table as the loop source —
@@ -225,7 +231,7 @@ class Context:
                 return _dc.replace(node, parents=new_parents)
 
             cur = init.collect()
-            for _ in range(min(n_iters, self.config.max_loop_iterations)):
+            for _ in range(n_iters):
                 cur = self._cluster_run(subst(body_node))
                 if cond is not None and not cond(cur):
                     break

@@ -47,6 +47,8 @@ def expand_paths(spec) -> List[str]:
         out: List[str] = []
         for s in spec:
             out.extend(expand_paths(s))
+        if not out:
+            raise FileNotFoundError("empty path list")
         return out
     if isinstance(spec, str) and any(c in spec for c in "*?["):
         hits = sorted(_glob.glob(spec))

@@ -215,14 +215,18 @@ class LocalCluster:
                 source_specs: Dict[str, Dict[str, Any]],
                 collect: bool = True, store_path: Optional[str] = None,
                 store_partitioning: Optional[Dict[str, Any]] = None,
+                config=None,
                 timeout: float = 600.0) -> Optional[Dict[str, Any]]:
-        """Submit one job to the gang; returns worker 0's host table."""
+        """Submit one job to the gang; returns worker 0's host table.
+        ``config`` (a JobConfig) rides the pickle control message so the
+        driver's executor knobs apply on the workers."""
         if not self.alive():
             self.restart()
         job = self.next_job_id()
         msg = {"cmd": "run", "plan": plan_json, "sources": source_specs,
                "collect": collect, "store_path": store_path,
-               "store_partitioning": store_partitioning, "job": job}
+               "store_partitioning": store_partitioning, "job": job,
+               "config": config}
         for s in self._socks.values():
             s.setblocking(True)
             protocol.send_msg(s, msg)

@@ -16,14 +16,19 @@ __all__ = ["execute_plan"]
 _EXECUTORS: Dict[int, Any] = {}
 
 
-def _gang_executor(mesh):
+def _gang_executor(mesh, config=None):
     """One persistent Executor per mesh, so the compiled-stage cache
     survives across submitted jobs (iterative queries re-submit the same
-    body plan every iteration — identical fingerprints must hit)."""
+    body plan every iteration — identical fingerprints must hit).  The
+    driver's JobConfig (shipped with each job) is applied per job."""
     from dryad_tpu.exec.executor import Executor
+    from dryad_tpu.utils.config import JobConfig
     ex = _EXECUTORS.get(id(mesh))
     if ex is None:
-        ex = _EXECUTORS[id(mesh)] = Executor(mesh)
+        ex = _EXECUTORS[id(mesh)] = Executor(mesh, config=config)
+    cfg = config or JobConfig()
+    ex.config = cfg
+    ex._compile_cache_max = cfg.compile_cache_size
     return ex
 
 
@@ -32,7 +37,7 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
                  event_log: Optional[Callable[[dict], None]] = None,
                  store_path: Optional[str] = None,
                  store_partitioning: Optional[Dict[str, Any]] = None,
-                 collect: Any = True) -> Any:
+                 collect: Any = True, config=None) -> Any:
     """Build sources, run the graph, replicate the output, and (on process
     0) return the host table / write the store.  ``collect``: True = full
     host table, "count" = total row count only, False = nothing."""
@@ -49,7 +54,7 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
     sources = {key: build_source(spec, mesh)
                for key, spec in source_specs.items()}
     graph = graph_from_json(plan_json, fn_table=fn_table, sources=sources)
-    ex = _gang_executor(mesh)
+    ex = _gang_executor(mesh, config)
     ex._event = event_log or (lambda e: None)
     pd = ex.run(graph)
 

@@ -79,6 +79,7 @@ class TaskFarm:
                            else cfg.speculation_rel_margin)
         self.abs_margin_s = (abs_margin_s if abs_margin_s is not None
                              else cfg.speculation_abs_margin_s)
+        self.task_timeout_s = cfg.farm_task_timeout_s
         # test hook: delay_hook(task_idx, worker_id) -> seconds the worker
         # should sleep before executing (simulates a slow machine)
         self.delay_hook = delay_hook
@@ -102,7 +103,7 @@ class TaskFarm:
 
     def run(self, plan_json: str,
             per_task_sources: List[Dict[str, Dict[str, Any]]],
-            timeout: float = 600.0) -> List[Dict[str, Any]]:
+            timeout: Optional[float] = None) -> List[Dict[str, Any]]:
         cl = self.cluster
         if not cl.alive():
             cl.restart()
@@ -111,13 +112,18 @@ class TaskFarm:
         todo: List[_Task] = list(tasks)
         n_done = 0
         durations: List[float] = []
-        dup_cap = max(1, int(self.duplication_budget * len(tasks)))
+        # 0 budget = speculation off; otherwise floor at one duplicate so
+        # small farms can still speculate (the fraction cap is the
+        # reference's 20% rule, DrStageStatistics.cpp)
+        dup_cap = (0 if self.duplication_budget <= 0
+                   else max(1, int(self.duplication_budget * len(tasks))))
         dups_used = 0
         idle = set(cl._socks.keys())
         dead: set = set()
         running: Dict[int, _Task] = {}   # worker -> task
         bufs = {pid: bytearray() for pid in cl._socks}
-        deadline = time.time() + timeout
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self.task_timeout_s)
 
         def dispatch(task: _Task, pid: int) -> bool:
             delay = (self.delay_hook(task.idx, pid)

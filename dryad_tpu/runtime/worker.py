@@ -127,7 +127,7 @@ def main(argv=None) -> int:
                     event_log=events.append,
                     store_path=msg.get("store_path"),
                     store_partitioning=msg.get("store_partitioning"),
-                    collect=collect)
+                    collect=collect, config=msg.get("config"))
                 if args.process_id == 0 and collect:
                     reply["table"] = table
             except Exception:
