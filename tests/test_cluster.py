@@ -182,3 +182,17 @@ def test_cluster_registered_decomposable(cluster):
         assert got == exp
     finally:
         cl2.shutdown()
+
+
+def test_cluster_zip_strings_take(cluster):
+    ctx = Context(cluster=cluster)
+    words = [f"w{i:03d}" for i in range(40)]
+    a = ctx.from_columns({"s": words})
+    b = ctx.from_columns({"x": np.arange(40, dtype=np.int32) * 2})
+    z = a.zip_with(b).collect()
+    assert [w.decode() for w in z["s"]] == words
+    np.testing.assert_array_equal(np.asarray(z["x"]), np.arange(40) * 2)
+    # global sort on a string key + global take
+    top = (ctx.from_columns({"s": words[::-1]})
+           .order_by([("s", False)]).take(5)).collect()
+    assert [w.decode() for w in top["s"]] == words[:5]
