@@ -40,6 +40,11 @@ def _const_key_like(cols):
         n = len(v)
     return jnp.zeros((n,), jnp.int32)
 
+
+def _add_agg_key(cols):
+    """Module-level (importable, hence cluster-shippable) agg-key mapper."""
+    return dict(cols, __agg_key=_const_key_like(cols))
+
 __all__ = ["Context", "Dataset"]
 
 
@@ -442,9 +447,7 @@ class Dataset:
         *AsQuery aggregates + IDecomposable.cs:34): runs the decomposable
         protocol over ONE global group and returns the finalized value(s).
         """
-        const = self.select(
-            lambda c: dict(c, __agg_key=_const_key_like(c)),
-            label="agg-key")
+        const = self.select(_add_agg_key, label="agg-key")
         out = const.group_by(["__agg_key"], {"agg": dec}).collect()
         res = {k: v for k, v in out.items() if k != "__agg_key"}
         if set(res.keys()) == {"agg"}:
@@ -610,8 +613,15 @@ class Dataset:
             t = _oracle.run_oracle(self.node)
             return orc._agg(kind, list(t[column]))
         if self.ctx.cluster is not None:
-            t = self.ctx._cluster_run(self.node)
-            return orc._agg(kind, list(t[column]))
+            # ship a const-key group-by so only ONE aggregated row crosses
+            # the control plane (not the whole table)
+            const = self.select(_add_agg_key, label="agg-key")
+            agg_node = E.GroupByAgg(parents=(const.node,),
+                                    keys=("__agg_key",),
+                                    aggs={"out": (kind, column)})
+            t = self.ctx._cluster_run(agg_node)
+            v = np.asarray(t["out"])
+            return v[0] if v.shape and v.shape[0] == 1 else v
         pd = self._materialize()
         import jax
         import jax.numpy as jnp

@@ -196,3 +196,14 @@ def test_cluster_zip_strings_take(cluster):
     top = (ctx.from_columns({"s": words[::-1]})
            .order_by([("s", False)]).take(5)).collect()
     assert [w.decode() for w in top["s"]] == words[:5]
+
+
+def test_cluster_scalar_ships_one_row(cluster):
+    ctx = Context(cluster=cluster)
+    rng = np.random.default_rng(5)
+    v = rng.integers(-100, 100, 500).astype(np.int32)
+    ds = ctx.from_columns({"v": v})
+    assert ds.sum("v") == int(v.sum())
+    assert ds.min("v") == int(v.min())
+    assert ds.max("v") == int(v.max())
+    assert abs(float(ds.mean("v")) - float(v.mean())) < 1e-3
