@@ -8,7 +8,7 @@ are XLA collectives over ICI; a host-side DAG scheduler provides replay-based
 fault tolerance.  See SURVEY.md for the reference analysis.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from dryad_tpu.api.dataset import Context, Dataset  # noqa: F401,E402
 from dryad_tpu.parallel.mesh import make_mesh  # noqa: F401,E402
