@@ -226,7 +226,9 @@ class Context:
 
             def subst(node):
                 if isinstance(node, E.Placeholder) and node.name == "__loop":
-                    spec = columns_spec(cur, self.nparts)
+                    spec = columns_spec(
+                        cur, self.nparts,
+                        str_max_len=self.config.string_max_len)
                     return E.Source(parents=(),
                                     data=DeferredSource(spec),
                                     _npartitions=self.nparts)
@@ -241,8 +243,9 @@ class Context:
                 if cond is not None and not cond(cur):
                     break
             node = E.Source(parents=(),
-                            data=DeferredSource(
-                                columns_spec(cur, self.nparts)),
+                            data=DeferredSource(columns_spec(
+                                cur, self.nparts,
+                                str_max_len=self.config.string_max_len)),
                             _npartitions=self.nparts, host=cur)
             return Dataset(self, node)
         if self.local_debug:
@@ -562,7 +565,8 @@ class Dataset:
         else:
             from dryad_tpu.exec.data import maybe_shrink_for_collect
             out = pdata_to_host(
-                maybe_shrink_for_collect(self._materialize()))
+                maybe_shrink_for_collect(self._materialize(),
+                                         config=self.ctx.config))
         if isinstance(self.node, E.Take):
             n = self.node.n
             out = {k: v[:n] for k, v in out.items()}

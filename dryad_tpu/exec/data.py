@@ -59,11 +59,15 @@ def replicate_tree(tree, mesh):
     return jax.jit(lambda t: t, out_shardings=rep)(tree)
 
 
-def shrink_bucket_cap(counts: np.ndarray, cap: int) -> int | None:
+def shrink_bucket_cap(counts: np.ndarray, cap: int,
+                      min_capacity: int = 1024,
+                      waste_factor: int = 4) -> int | None:
     """Shared shrink-before-collect policy: pow2 bucket >= max count when
-    the capacity is grossly oversized, else None (no shrink)."""
+    the capacity is grossly oversized, else None (no shrink).  Thresholds
+    come from JobConfig.collect_shrink_min_capacity /
+    collect_shrink_waste_factor."""
     max_n = int(counts.max()) if counts.size else 0
-    if cap <= 1024 or cap <= 4 * max(max_n, 1):
+    if cap <= min_capacity or cap <= waste_factor * max(max_n, 1):
         return None
     bucket = 1
     while bucket < max(max_n, 1):
@@ -71,15 +75,24 @@ def shrink_bucket_cap(counts: np.ndarray, cap: int) -> int | None:
     return min(bucket, cap)
 
 
-def collect_replicated(pd: "PData", mesh,
-                       unpack: bool = True) -> Optional[Dict[str, Any]]:
+def _shrink_knobs(config) -> tuple:
+    if config is None:
+        from dryad_tpu.utils.config import JobConfig
+        config = JobConfig()
+    return (config.collect_shrink_min_capacity,
+            config.collect_shrink_waste_factor)
+
+
+def collect_replicated(pd: "PData", mesh, unpack: bool = True,
+                       config=None) -> Optional[Dict[str, Any]]:
     """Multi-process collect: shrink (deterministically, mirrored on every
     process), replicate over the mesh, and unpack host-side.  All processes
     must call this (the replication is a collective); pass ``unpack=False``
     on processes that don't need the host table (they return None without
     paying the host-side string unpack)."""
     counts = np.asarray(replicate_tree(pd.batch.count, mesh))
-    new_cap = shrink_bucket_cap(counts, pd.capacity)
+    new_cap = shrink_bucket_cap(counts, pd.capacity,
+                                *_shrink_knobs(config))
     if new_cap is not None:
         pd = shrink_pdata(pd, new_cap)
     rep = replicate_tree(pd.batch, mesh)
@@ -204,9 +217,10 @@ def shrink_pdata(pd: PData, new_cap: int) -> PData:
     return PData(_shrink_batch(pd.batch, new_cap), pd.nparts)
 
 
-def maybe_shrink_for_collect(pd: PData) -> PData:
+def maybe_shrink_for_collect(pd: PData, config=None) -> PData:
     # pow2 buckets bound the number of shrink-program compiles
-    new_cap = shrink_bucket_cap(np.asarray(pd.counts), pd.capacity)
+    new_cap = shrink_bucket_cap(np.asarray(pd.counts), pd.capacity,
+                                *_shrink_knobs(config))
     return pd if new_cap is None else shrink_pdata(pd, new_cap)
 
 

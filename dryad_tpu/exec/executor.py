@@ -349,6 +349,15 @@ class Executor:
         self._compile_cache: "OrderedDict[Any, Callable]" = OrderedDict()
         self._compile_cache_max = self.config.compile_cache_size
 
+    def apply_config(self, config) -> None:
+        """Re-point a persistent executor at a new job's JobConfig (worker
+        processes keep one executor per mesh across submitted jobs)."""
+        from dryad_tpu.utils.config import JobConfig
+        self.config = config or JobConfig()
+        self._compile_cache_max = self.config.compile_cache_size
+        while len(self._compile_cache) > self._compile_cache_max:
+            self._compile_cache.popitem(last=False)
+
     # -- stage program construction ---------------------------------------
 
     def _build_stage_fn(self, stage: Stage, scale: int, slack: int,

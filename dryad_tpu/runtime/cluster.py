@@ -72,6 +72,12 @@ class LocalCluster:
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="dryad-cluster-")
         self._procs: List[subprocess.Popen] = []
         self._socks: Dict[int, socket.socket] = {}
+        # per-worker receive buffers persist ACROSS jobs (cleared only on
+        # restart): a speculated task's losing duplicate reply may arrive
+        # after the farm returns, possibly split across recv() calls — a
+        # call-local buffer would discard the partial prefix and leave the
+        # next job decoding from mid-frame
+        self._bufs: Dict[int, bytearray] = {}
         self._listener: Optional[socket.socket] = None
         # monotonic job id: every submission is tagged, workers echo it, and
         # schedulers discard stale replies (a finished job may leave an
@@ -143,6 +149,7 @@ class LocalCluster:
             hello = protocol.recv_msg(conn)
             conn.setblocking(False)
             self._socks[hello["hello"]] = conn
+            self._bufs[hello["hello"]] = bytearray()
 
     def _check_deaths(self, during_startup: bool = False) -> None:
         for pid, proc in enumerate(self._procs):
@@ -181,7 +188,7 @@ class LocalCluster:
                 s.close()
             except OSError:
                 pass
-        self._procs, self._socks = [], {}
+        self._procs, self._socks, self._bufs = [], {}, {}
         if self._listener is not None:
             self._listener.close()
             self._listener = None
@@ -209,6 +216,109 @@ class LocalCluster:
     def __exit__(self, *exc) -> None:
         self.shutdown()
 
+    def __del__(self):
+        # a dropped cluster must not leak worker processes: workers linger
+        # on a severed control socket (by design, see retire_worker), so
+        # the driver-side GC is the line of defense for abandoned clusters
+        try:
+            self._kill_all()
+        except Exception:
+            pass
+
+    def _recv_frames(self, pid: int, job: int):
+        """One non-blocking drain of ``pid``'s socket: returns
+        ``(replies_for_job, alive)``.  ``alive=False`` means the socket is
+        closed/broken — the caller picks the site-appropriate reaction
+        (gang teardown, grace-period skip, or farm reassignment)."""
+        s = self._socks.get(pid)
+        if s is None:
+            return [], False
+        try:
+            chunk = s.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return [], True
+        except OSError:
+            return [], False
+        if not chunk:
+            return [], False
+        self._bufs[pid].extend(chunk)
+        return self._decode_job_frames(pid, job), True
+
+    def _decode_job_frames(self, pid: int, job: int) -> List[dict]:
+        """Decode every complete frame buffered for ``pid``, returning the
+        ones tagged with ``job`` (stale prior-job frames — e.g. a losing
+        speculative duplicate's late reply — are discarded).  A corrupt
+        frame tears the whole gang down (the stream is desynced)."""
+        out: List[dict] = []
+        try:
+            while True:
+                r = _try_decode(self._bufs[pid])
+                if r is None:
+                    break
+                if r.get("job") != job:
+                    continue
+                out.append(r)
+        except WorkerFailure:
+            self._kill_all()
+            raise
+        return out
+
+    def wait_quiescent(self, timeout: float = 60.0) -> None:
+        """Block until every worker answers a fresh ping — i.e. has drained
+        all previously queued work (a losing speculative duplicate from a
+        prior farm run, for example).  Useful before timing-sensitive
+        submissions."""
+        job = self.next_job_id()
+        for pid, s in self._socks.items():
+            try:
+                s.setblocking(True)
+                protocol.send_msg(s, {"cmd": "ping", "job": job})
+                s.setblocking(False)
+            except OSError:
+                self._kill_all()
+                raise WorkerFailure(
+                    f"worker {pid} unreachable during quiescence ping"
+                    + self._log_tails())
+        pending = set(self._socks)
+        deadline = time.time() + timeout
+        while pending:
+            if time.time() > deadline:
+                raise WorkerFailure(
+                    f"workers {sorted(pending)} not quiescent after "
+                    f"{timeout}s" + self._log_tails())
+            socks = {self._socks[p]: p for p in pending}
+            ready, _, _ = select.select(list(socks), [], [], 0.25)
+            for s in ready:
+                pid = socks[s]
+                frames, ok = self._recv_frames(pid, job)
+                if not ok:
+                    self._kill_all()
+                    raise WorkerFailure(
+                        f"worker {pid} closed its control connection"
+                        + self._log_tails())
+                for r in frames:
+                    if "pong" in r:
+                        pending.discard(pid)
+
+    def retire_worker(self, pid: int) -> None:
+        """Remove one worker from the gang by severing its control socket
+        (the reference abandons the vertex on timeout,
+        ReactToFailedVertex).  The process is deliberately NOT killed:
+        killing any jax.distributed client (coordinator or not) risks a
+        heartbeat-failure cascade through the surviving workers mid-farm.
+        A retired worker notices the severed socket and lingers quietly
+        (runtime/worker.py) until the next gang restart kills it; severing
+        alone already prevents a half-written reply from wedging the next
+        job's blocking send.  The cluster is no longer ``alive()``
+        afterwards, so the next gang job triggers a full restart."""
+        s = self._socks.pop(pid, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._bufs.pop(pid, None)
+
     # -- job submission ----------------------------------------------------
 
     def execute(self, plan_json: str,
@@ -235,8 +345,6 @@ class LocalCluster:
         replies: Dict[int, dict] = {}
         pending = set(self._socks)
         deadline = time.time() + timeout
-        # buffered receive state per worker
-        bufs: Dict[int, bytearray] = {pid: bytearray() for pid in pending}
         while pending:
             if time.time() > deadline:
                 self._kill_all()
@@ -251,24 +359,13 @@ class LocalCluster:
             ready, _, _ = select.select(list(socks), [], [], 0.25)
             for s in ready:
                 pid = socks[s]
-                try:
-                    chunk = s.recv(1 << 20)
-                except (BlockingIOError, InterruptedError):
-                    continue
-                except OSError:
-                    chunk = b""
-                if not chunk:
+                frames, ok = self._recv_frames(pid, job)
+                if not ok:
                     self._kill_all()
                     raise WorkerFailure(
                         f"worker {pid} closed its control connection "
                         f"mid-job" + self._log_tails())
-                bufs[pid].extend(chunk)
-                while True:
-                    reply = _try_decode(bufs[pid])
-                    if reply is None:
-                        break
-                    if reply.get("job") != job:   # stale prior-job frame
-                        continue
+                for reply in frames:
                     replies[pid] = reply
                     pending.discard(pid)
 
@@ -283,23 +380,12 @@ class LocalCluster:
                         [self._socks[p] for p in pending], [], [], 0.25)
                     for s in ready:
                         pid = {self._socks[p]: p for p in pending}[s]
-                        try:
-                            chunk = s.recv(1 << 20)
-                        except (BlockingIOError, InterruptedError):
+                        frames, ok = self._recv_frames(pid, job)
+                        if not ok:
+                            pending.discard(pid)
                             continue
-                        except OSError:
-                            chunk = b""
-                        if chunk:
-                            bufs[pid].extend(chunk)
-                            while True:
-                                r = _try_decode(bufs[pid])
-                                if r is None:
-                                    break
-                                if r.get("job") != job:
-                                    continue
-                                replies[pid] = r
-                                pending.discard(pid)
-                        else:
+                        for r in frames:
+                            replies[pid] = r
                             pending.discard(pid)
                 break
 
@@ -319,14 +405,10 @@ class LocalCluster:
 
 
 def _try_decode(buf: bytearray):
-    """Decode one length-prefixed frame from ``buf`` if complete."""
-    import pickle
-    import struct
-    if len(buf) < 8:
-        return None
-    (n,) = struct.unpack_from("<Q", buf, 0)
-    if len(buf) < 8 + n:
-        return None
-    obj = pickle.loads(bytes(buf[8:8 + n]))
-    del buf[:8 + n]
-    return obj
+    """Decode one buffered frame (protocol.try_decode), mapping framing
+    corruption to WorkerFailure — the caller tears the gang down
+    (_decode_job_frames)."""
+    try:
+        return protocol.try_decode(buf)
+    except protocol.FrameError as e:
+        raise WorkerFailure(str(e))

@@ -22,13 +22,10 @@ def _gang_executor(mesh, config=None):
     body plan every iteration — identical fingerprints must hit).  The
     driver's JobConfig (shipped with each job) is applied per job."""
     from dryad_tpu.exec.executor import Executor
-    from dryad_tpu.utils.config import JobConfig
     ex = _EXECUTORS.get(id(mesh))
     if ex is None:
-        ex = _EXECUTORS[id(mesh)] = Executor(mesh, config=config)
-    cfg = config or JobConfig()
-    ex.config = cfg
-    ex._compile_cache_max = cfg.compile_cache_size
+        ex = _EXECUTORS[id(mesh)] = Executor(mesh)
+    ex.apply_config(config)  # the single config-application point
     return ex
 
 
@@ -68,7 +65,8 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
         # only process 0's table goes back to the driver; the others
         # participate in the replication collective but skip the host unpack
         table = collect_replicated(pd, mesh,
-                                   unpack=jax.process_index() == 0)
+                                   unpack=jax.process_index() == 0,
+                                   config=config)
     if store_path is not None:
         rep = PData(replicate_tree(pd.batch, mesh), pd.nparts)
         if jax.process_index() == 0:

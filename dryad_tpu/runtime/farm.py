@@ -23,7 +23,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from dryad_tpu.runtime import protocol
-from dryad_tpu.runtime.cluster import WorkerFailure, _try_decode
+from dryad_tpu.runtime.cluster import WorkerFailure
 
 __all__ = ["TaskFarm", "FarmError"]
 
@@ -33,12 +33,13 @@ class FarmError(RuntimeError):
 
 
 class _Task:
-    __slots__ = ("idx", "sources", "runs", "result", "duplicated")
+    __slots__ = ("idx", "sources", "runs", "delays", "result", "duplicated")
 
     def __init__(self, idx: int, sources: Dict[str, Dict[str, Any]]):
         self.idx = idx
         self.sources = sources
         self.runs: Dict[int, float] = {}   # worker -> dispatch time
+        self.delays: Dict[int, float] = {}  # worker -> commanded test delay
         self.result: Optional[Dict[str, Any]] = None
         self.duplicated = False
 
@@ -65,6 +66,7 @@ class TaskFarm:
                  delay_hook: Optional[Callable[[int, int], float]] = None):
         from dryad_tpu.utils.config import JobConfig
         cfg = config or JobConfig()
+        self.config = cfg
         self.cluster = cluster
         self.duplication_budget = (
             duplication_budget if duplication_budget is not None
@@ -103,7 +105,12 @@ class TaskFarm:
 
     def run(self, plan_json: str,
             per_task_sources: List[Dict[str, Dict[str, Any]]],
-            timeout: Optional[float] = None) -> List[Dict[str, Any]]:
+            timeout: Optional[float] = None,
+            task_timeout_s: Optional[float] = None
+            ) -> List[Dict[str, Any]]:
+        """``timeout`` bounds the whole farm run (None = unbounded);
+        ``task_timeout_s`` overrides JobConfig.farm_task_timeout_s for
+        legitimately slow tasks."""
         cl = self.cluster
         if not cl.alive():
             cl.restart()
@@ -118,12 +125,29 @@ class TaskFarm:
         dup_cap = (0 if self.duplication_budget <= 0
                    else max(1, int(self.duplication_budget * len(tasks))))
         dups_used = 0
-        idle = set(cl._socks.keys())
+        # a worker is idle only once it answers THIS job's ping: a pong
+        # proves it drained any still-running losing duplicate from a
+        # previous farm run, so per-task timers never include stale queue
+        # time (which would falsely retire a healthy worker)
+        idle: set = set()
+        ping_t: Dict[int, float] = {}
+        for pid in list(cl._socks):
+            sock = cl._socks[pid]
+            try:
+                sock.setblocking(True)
+                protocol.send_msg(sock, {"cmd": "ping", "job": job})
+                sock.setblocking(False)
+                ping_t[pid] = time.time()
+            except OSError:
+                pass   # handled as dead below
         dead: set = set()
         running: Dict[int, _Task] = {}   # worker -> task
-        bufs = {pid: bytearray() for pid in cl._socks}
-        deadline = time.time() + (timeout if timeout is not None
-                                  else self.task_timeout_s)
+        # overall farm deadline only when the caller passes one explicitly;
+        # the config knob is PER-TASK (reference per-vertex semantics) and
+        # is enforced against each dispatched run below
+        deadline = None if timeout is None else time.time() + timeout
+        task_timeout = (task_timeout_s if task_timeout_s is not None
+                        else self.task_timeout_s)
 
         def dispatch(task: _Task, pid: int) -> bool:
             delay = (self.delay_hook(task.idx, pid)
@@ -135,12 +159,14 @@ class TaskFarm:
                                          "plan": plan_json,
                                          "sources": task.sources,
                                          "task": task.idx, "job": job,
+                                         "config": self.config,
                                          "delay_s": delay})
                 sock.setblocking(False)
             except OSError:
                 worker_lost(pid)
                 return False
             task.runs[pid] = time.time()
+            task.delays[pid] = delay
             running[pid] = task
             idle.discard(pid)
             return True
@@ -149,7 +175,8 @@ class TaskFarm:
             dead.add(pid)
             idle.discard(pid)
             task = running.pop(pid, None)
-            if task is not None and task.result is None:
+            if (task is not None and task.result is None
+                    and task not in todo):
                 task.runs.pop(pid, None)
                 todo.insert(0, task)
                 self._emit({"event": "task_reassigned", "task": task.idx,
@@ -159,13 +186,42 @@ class TaskFarm:
                     "all workers died during task farm" + cl._log_tails())
 
         while n_done < len(tasks):
-            if time.time() > deadline:
+            if deadline is not None and time.time() > deadline:
                 raise FarmError(
                     f"task farm timed out; {len(tasks) - n_done} tasks "
                     f"unfinished")
-            # fill idle workers: fresh tasks first, then speculate
+            # per-task timeout: a run stuck past the task timeout means its
+            # worker is wedged — retire that worker (the reference abandons
+            # the vertex's process, ReactToFailedVertex) so the task
+            # reassigns elsewhere and a half-written reply can't wedge the
+            # next job's blocking send.  A pid still in `running` has not
+            # replied, so this applies even when a duplicate already won the
+            # task.  Commanded test delays (delay_hook) extend the budget —
+            # they simulate slowness, not a wedge.
+            now = time.time()
+            for pid, t in list(running.items()):
+                budget = task_timeout + t.delays.get(pid, 0.0)
+                if now - t.runs.get(pid, now) > budget:
+                    self._emit({"event": "task_timeout", "task": t.idx,
+                                "worker": pid, "timeout_s": task_timeout})
+                    cl.retire_worker(pid)
+                    worker_lost(pid)
+            # a worker that never answered the idle-gate ping within the
+            # task budget is wedged on prior work — retire it too
+            for pid, t0 in list(ping_t.items()):
+                if pid not in dead and now - t0 > task_timeout:
+                    self._emit({"event": "worker_ping_timeout",
+                                "worker": pid, "timeout_s": task_timeout})
+                    ping_t.pop(pid, None)
+                    cl.retire_worker(pid)
+                    worker_lost(pid)
+            # fill idle workers: fresh tasks first, then speculate.  A task
+            # reassigned by worker-loss/timeout may since have finished via
+            # a surviving duplicate — skip those
             while todo and idle:
                 t = todo.pop(0)
+                if t.result is not None:
+                    continue
                 if not dispatch(t, min(idle)):
                     todo.insert(0, t)
             if not todo and idle and dups_used < dup_cap:
@@ -179,14 +235,18 @@ class TaskFarm:
                         worst = max(cands,
                                     key=lambda t: now - min(t.runs.values()))
                         pid = min(idle)
-                        worst.duplicated = True
-                        dups_used += 1
-                        self._emit({"event": "task_duplicated",
-                                    "task": worst.idx, "worker": pid,
-                                    "elapsed_s": round(
-                                        now - min(worst.runs.values()), 3),
-                                    "threshold_s": round(thr, 3)})
-                        dispatch(worst, pid)
+                        # burn the budget slot only if the clone actually
+                        # dispatched — a failed send must leave the
+                        # straggler cloneable elsewhere
+                        if dispatch(worst, pid):
+                            worst.duplicated = True
+                            dups_used += 1
+                            self._emit({"event": "task_duplicated",
+                                        "task": worst.idx, "worker": pid,
+                                        "elapsed_s": round(
+                                            now - min(worst.runs.values()),
+                                            3),
+                                        "threshold_s": round(thr, 3)})
 
             # liveness + replies
             for pid, proc in enumerate(cl._procs):
@@ -199,29 +259,30 @@ class TaskFarm:
             ready, _, _ = select.select(list(live), [], [], 0.1)
             for sock in ready:
                 pid = live[sock]
-                try:
-                    chunk = sock.recv(1 << 20)
-                except (BlockingIOError, InterruptedError):
-                    continue
-                except OSError:
-                    chunk = b""
-                if not chunk:
+                frames, ok = cl._recv_frames(pid, job)
+                if not ok:
                     worker_lost(pid)
                     continue
-                bufs[pid].extend(chunk)
-                while True:
-                    reply = _try_decode(bufs[pid])
-                    if reply is None:
-                        break
-                    if reply.get("job") != job:   # stale prior-job frame
+                for reply in frames:
+                    if "pong" in reply:      # idle-gate ping answered
+                        ping_t.pop(pid, None)
+                        idle.add(pid)
                         continue
-                    task = running.pop(pid, None)
+                    running.pop(pid, None)
                     idle.add(pid)
+                    t = (tasks[reply["task"]]
+                         if reply.get("task") is not None else None)
                     if not reply.get("ok"):
+                        # a losing duplicate's failure costs nothing once
+                        # the winner delivered (first-finisher-wins)
+                        if t is not None and t.result is not None:
+                            self._emit({"event":
+                                        "task_duplicate_failed_ignored",
+                                        "task": t.idx, "worker": pid})
+                            continue
                         raise FarmError(
                             f"task {reply.get('task')} failed on worker "
                             f"{pid}:\n{reply.get('error')}")
-                    t = tasks[reply["task"]]
                     took = time.time() - t.runs.get(pid, time.time())
                     if t.result is None:
                         t.result = reply["table"]

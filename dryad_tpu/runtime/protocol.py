@@ -43,3 +43,32 @@ def recv_msg(sock: socket.socket) -> Any:
     if n > _MAX_FRAME:
         raise EOFError(f"oversized control frame ({n} bytes)")
     return pickle.loads(_recv_exact(sock, n))
+
+
+class FrameError(RuntimeError):
+    """The byte stream is desynced from the framing — unrecoverable."""
+
+
+def try_decode(buf: bytearray) -> Any:
+    """Decode one frame from an accumulation buffer if complete, else None.
+
+    The non-blocking sibling of recv_msg — ONE place owns the wire format.
+    A length beyond _MAX_FRAME or an undecodable payload means the stream
+    lost framing; the poisoned bytes are dropped (so a persistent buffer
+    cannot re-raise on the next decode) and FrameError is raised."""
+    if len(buf) < _LEN.size:
+        return None
+    (n,) = _LEN.unpack_from(buf, 0)
+    if n > _MAX_FRAME:
+        del buf[:]
+        raise FrameError(f"corrupt control frame: length {n} exceeds "
+                         f"{_MAX_FRAME} byte cap")
+    if len(buf) < _LEN.size + n:
+        return None
+    try:
+        obj = pickle.loads(bytes(buf[_LEN.size:_LEN.size + n]))
+    except Exception as e:
+        del buf[:]
+        raise FrameError(f"corrupt control frame payload: {e!r}")
+    del buf[:_LEN.size + n]
+    return obj

@@ -57,22 +57,43 @@ def main(argv=None) -> int:
     mesh = make_mesh(hosts=args.num_processes
                      if args.num_processes > 1 else None)
 
+    # snapshot the spawning driver's pid NOW — by the time a severed socket
+    # is observed the kernel may already have reparented us, and a late
+    # getppid() would capture pid 1 and linger forever
+    parent_pid = os.getppid()
     host, port = args.control.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)))
     protocol.send_msg(sock, {"hello": args.process_id,
                              "devices": jax.device_count()})
 
+    def _send_reply(obj) -> bool:
+        """Send a control reply; on a severed socket (the driver retired
+        this worker, runtime/cluster.py retire_worker) return False
+        instead of crashing the process."""
+        try:
+            protocol.send_msg(sock, obj)
+            return True
+        except OSError:
+            return False
+
+    lost_control = False
     while True:
         try:
             msg = protocol.recv_msg(sock)
-        except EOFError:
+        except (EOFError, OSError):
+            lost_control = True
             break
         cmd = msg.get("cmd")
         if cmd == "stop":
-            protocol.send_msg(sock, {"bye": args.process_id})
+            _send_reply({"bye": args.process_id})
             break
         if cmd == "ping":
-            protocol.send_msg(sock, {"pong": args.process_id})
+            # echo the job tag: a pong proves the worker has DRAINED all
+            # prior work queued on its socket (the farm's idle gate)
+            if not _send_reply({"pong": args.process_id,
+                                "job": msg.get("job")}):
+                lost_control = True
+                break
             continue
         if cmd == "run_task":
             # independent per-partition task on the LOCAL device mesh (no
@@ -99,6 +120,8 @@ def main(argv=None) -> int:
                     local_mesh = make_mesh(devices=jax.local_devices())
                     local_ex = Executor(local_mesh)
                     _LOCAL = (local_mesh, local_ex)
+                cfg = msg.get("config")
+                local_ex.apply_config(cfg)
                 fn_table = resolve_fn_table(msg["plan"], args.fn_module)
                 sources = {key: build_source(spec, local_mesh)
                            for key, spec in msg["sources"].items()}
@@ -106,12 +129,14 @@ def main(argv=None) -> int:
                                         sources=sources)
                 pd = local_ex.run(graph)
                 reply["table"] = pdata_to_host(
-                    maybe_shrink_for_collect(pd))
+                    maybe_shrink_for_collect(pd, config=cfg))
             except Exception:
                 reply = {"ok": False, "pid": args.process_id,
                          "task": msg.get("task"), "job": msg.get("job"),
                          "error": traceback.format_exc()}
-            protocol.send_msg(sock, reply)
+            if not _send_reply(reply):
+                lost_control = True
+                break
             continue
         if cmd == "run":
             events: list = []
@@ -135,11 +160,25 @@ def main(argv=None) -> int:
                          "job": msg.get("job"),
                          "error": traceback.format_exc()}
             reply["events"] = events
-            protocol.send_msg(sock, reply)
+            if not _send_reply(reply):
+                lost_control = True
+                break
             continue
-        protocol.send_msg(sock, {"ok": False, "pid": args.process_id,
-                                 "error": f"unknown command {cmd!r}"})
+        if not _send_reply({"ok": False, "pid": args.process_id,
+                            "error": f"unknown command {cmd!r}"}):
+            lost_control = True
+            break
     sock.close()
+    if lost_control:
+        # the driver retired us (severed socket) but the gang is still
+        # running: exiting now would kill our jax.distributed client (and,
+        # for process 0, the coordinator itself), cascading heartbeat
+        # failures through the surviving workers mid-farm.  Linger until
+        # the driver's gang restart kills us — or until we are orphaned.
+        import time as _time
+        while os.getppid() == parent_pid:
+            _time.sleep(1.0)
+        return 0
     jax.distributed.shutdown()
     return 0
 

@@ -97,6 +97,10 @@ def test_farm_reassigns_on_worker_death(cluster):
         cluster.restart()
     plan_json, src_key = _farm_plan(cluster)
     TaskFarm(cluster).run(plan_json, _tasks(cluster, src_key, 4)[1])  # warm
+    # drain any losing duplicate still sleeping from the previous test —
+    # the farm's idle gate would otherwise (correctly) never dispatch to
+    # worker 1 before the killer fires, and no reassignment would occur
+    cluster.wait_quiescent()
     vals, per_task = _tasks(cluster, src_key, n_tasks=8)
     # speculation disabled (min_samples unreachable): reassignment-on-death
     # is the only way the slow worker's task can complete
