@@ -407,6 +407,14 @@ class Dataset:
         return Dataset(self.ctx, E.AssumePartitioning(
             parents=(self.node,), kind="range", keys=tuple(keys)))
 
+    def assume_order_by(self, keys: Sequence[str]) -> "Dataset":
+        """Declare (without sorting) that the data is globally sorted
+        ascending by ``keys`` — partitions hold disjoint ascending key
+        ranges (AssumeOrderBy, DryadLinqQueryable.cs:3639).  A subsequent
+        ``order_by`` whose ascending keys are a prefix of ``keys`` skips
+        the range exchange and only sorts locally."""
+        return self.assume_range_partition(keys)
+
     def take(self, n: int) -> "Dataset":
         return Dataset(self.ctx, E.Take(parents=(self.node,), n=n))
 
@@ -464,8 +472,12 @@ class Dataset:
              right_keys: Sequence[str] | None = None,
              expansion: float | None = None,
              broadcast: bool = False, how: str = "inner") -> "Dataset":
-        """Equi-join.  how="left" keeps unmatched left rows with the right
-        columns zero-filled."""
+        """Equi-join.  ``how`` in inner/left/right/full: "left" keeps
+        unmatched left rows with right columns zero-filled; "right" keeps
+        unmatched right rows (left non-key columns zero-filled, left key
+        columns carrying the right key values); "full" keeps both.
+        Broadcast is only honored for inner/left (a replicated right side
+        cannot detect its unmatched rows without duplication)."""
         return Dataset(self.ctx, E.Join(
             parents=(self.node, other.node), left_keys=tuple(left_keys),
             right_keys=tuple(right_keys or left_keys),

@@ -564,6 +564,12 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     output being dropped — probability ~2^-32 per pair, same collision
     budget documented on group_by.
 
+    ``how="right"``: mirrored — right rows without a match emit ONE row
+    with the LEFT non-key columns zero-filled and the left key columns
+    taken from the right keys.  ``how="full"`` combines both.  Unmatched
+    right rows are appended after the matched output (reference right/full
+    outer join lowering, DryadLinqQueryable.cs:3639-area operator family).
+
     Output capacity is the static ``out_capacity``.  ``overflow`` is a
     conservative bool: True whenever the number of *candidate* pairs (hash
     matches before real-key verification) exceeds ``out_capacity`` — in that
@@ -596,12 +602,13 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     start = jnp.searchsorted(rkey, lh, side="left")
     stop = jnp.searchsorted(rkey, lh, side="right")
     mult = jnp.where(lvalid, stop - start, 0)
-    if how == "left":
+    if how not in ("inner", "left", "right", "full"):
+        raise ValueError(f"unknown join how={how!r}")
+    left_synth = how in ("left", "full")
+    if left_synth:
         # unmatched left rows still occupy one output slot (synthetic)
         synth_row = lvalid & (mult == 0)
         mult = jnp.where(synth_row, 1, mult)
-    elif how != "inner":
-        raise ValueError(f"unknown join how={how!r}")
 
     # output slot -> (left row, right row) via prefix sums
     cum = jnp.cumsum(mult)
@@ -618,8 +625,9 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     # candidates that landed in the right-side padding region, whose contents
     # are unspecified and may hold stale real keys
     eq = _keys_equal(left, lid_c, left_keys, rs, rid, right_keys)
-    keep = slot_valid & eq & (rid < right.count)
-    if how == "left":
+    keep_match = slot_valid & eq & (rid < right.count)
+    keep = keep_match
+    if left_synth:
         synth_slot = slot_valid & jnp.take(synth_row, lid_c)
         keep = keep | synth_slot
 
@@ -634,7 +642,7 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
         name = k if k not in out_cols else k + suffix
         if isinstance(v, StringColumn):
             g = v.gather(rid)
-            if how == "left":
+            if left_synth:
                 z = synth_slot
                 g = StringColumn(
                     jnp.where(z[:, None], 0, g.data),
@@ -642,7 +650,7 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
             out_cols[name] = g
         else:
             g = jnp.take(v, rid, axis=0)
-            if how == "left":
+            if left_synth:
                 z = synth_slot.reshape(
                     synth_slot.shape + (1,) * (g.ndim - 1))
                 g = jnp.where(z, 0, g)
@@ -653,8 +661,53 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     # conservative: candidate pairs dropped for capacity might have been real.
     # NEED channel: 0 = fits, else actual candidate-pair count so the
     # executor can right-size the retry in one shot
-    need = jnp.where(total > out_capacity, total, 0)
-    return out, need.astype(jnp.int32)
+    need = jnp.where(total > out_capacity, total, 0).astype(jnp.int32)
+    if how in ("right", "full"):
+        # right rows whose segment produced no VERIFIED match get one
+        # synthetic output row each, appended after the matched rows.  A
+        # match dropped only by capacity overflow marks its right row
+        # matched=False, inflating u — harmless: need already forces a
+        # right-sized retry in that case.
+        matched = jnp.zeros((right.capacity,), jnp.int32).at[rid].max(
+            keep_match.astype(jnp.int32))
+        unmatched = rs.valid_mask() & (matched == 0)
+        ru = compact(rs, unmatched)
+        u = ru.count
+        key_map = dict(zip(left_keys, right_keys))
+        synth_cols: Dict[str, Any] = {}
+        for k, v in left.columns.items():
+            if k in key_map:
+                rv = ru.columns[key_map[k]]
+                if isinstance(v, StringColumn):
+                    L = v.max_len
+                    d = rv.data
+                    if rv.max_len < L:
+                        d = jnp.pad(d, ((0, 0), (0, L - rv.max_len)))
+                    elif rv.max_len > L:
+                        d = d[:, :L]
+                    synth_cols[k] = StringColumn(
+                        d, jnp.minimum(rv.lengths, L))
+                else:
+                    synth_cols[k] = rv.astype(v.dtype)
+            elif isinstance(v, StringColumn):
+                synth_cols[k] = StringColumn(
+                    jnp.zeros((right.capacity, v.max_len), jnp.uint8),
+                    jnp.zeros((right.capacity,), jnp.int32))
+            else:
+                synth_cols[k] = jnp.zeros((right.capacity,) + v.shape[1:],
+                                          v.dtype)
+        for k, v in ru.columns.items():
+            if k in rkeyset:
+                continue
+            name = k if k not in synth_cols else k + suffix
+            synth_cols[name] = v
+        merged = concat2(out, Batch(synth_cols, u))
+        out = merged.gather(
+            jnp.arange(out_capacity, dtype=jnp.int32),
+            count=jnp.minimum(merged.count, out_capacity))
+        need = jnp.where(total + u > out_capacity, total + u,
+                         need).astype(jnp.int32)
+    return out, need
 
 
 def flat_map_expand(batch: Batch, fn, out_capacity: int

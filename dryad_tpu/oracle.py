@@ -263,29 +263,47 @@ def run_oracle(root: E.Node, bindings: Dict[str, Table] | None = None) -> Table:
                 (k if k not in lt else k + "_r") for k in rextra]
             out: Table = {k: [] for k in out_names}
             how = getattr(n, "how", "inner")
+
+            def _zero_of(proto):
+                if isinstance(proto, list):
+                    return b""
+                p = np.asarray(proto)
+                return np.zeros((1,) + p.shape[1:], p.dtype)[0]
+
+            matched_right: set = set()
             for i in range(_nrows(lt)):
                 k = _key_of({kk: lt[kk][i] for kk in n.left_keys},
                             tuple(n.left_keys))
                 matches = rmap.get(k, ())
+                matched_right.update(matches)
                 for j in matches:
                     for kk in lt.keys():
                         out[kk].append(lt[kk][i])
                     for kk in rextra:
                         name = kk if kk not in lt else kk + "_r"
                         out[name].append(rt[kk][j])
-                if how == "left" and not matches:
+                if how in ("left", "full") and not matches:
                     # unmatched left row: right columns zero-filled
                     for kk in lt.keys():
                         out[kk].append(lt[kk][i])
                     for kk in rextra:
                         name = kk if kk not in lt else kk + "_r"
-                        proto = rt[kk]
-                        if isinstance(proto, list):
-                            out[name].append(b"")
+                        out[name].append(_zero_of(rt[kk]))
+            if how in ("right", "full"):
+                key_map = dict(zip(n.left_keys, n.right_keys))
+                for j in range(_nrows(rt)):
+                    if j in matched_right:
+                        continue
+                    # unmatched right row: left key columns take the right
+                    # key values, other left columns zero-filled
+                    for kk in lt.keys():
+                        if kk in key_map:
+                            out[kk].append(rt[key_map[kk]][j])
                         else:
-                            z = np.zeros((1,) + np.asarray(proto).shape[1:],
-                                         np.asarray(proto).dtype)
-                            out[name].append(z[0])
+                            out[kk].append(_zero_of(lt[kk]))
+                    for kk in rextra:
+                        name = kk if kk not in lt else kk + "_r"
+                        out[name].append(rt[kk][j])
             return {k: (v if v and isinstance(v[0], bytes) else np.asarray(v))
                     for k, v in out.items()}
         if isinstance(n, E.OrderBy):

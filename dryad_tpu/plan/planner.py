@@ -393,6 +393,10 @@ class Planner:
             broadcast_right = n.broadcast_right or (
                 bthresh > 0
                 and rf.capacity * self.nparts <= bthresh * lf.capacity)
+            if n.how in ("right", "full"):
+                # a replicated right side would emit its unmatched rows once
+                # PER PARTITION — right/full joins must co-locate by key
+                broadcast_right = False
             if self.nparts == 1:
                 lex = rex = None
             elif broadcast_right:
@@ -419,10 +423,29 @@ class Planner:
 
         if isinstance(n, E.OrderBy):
             f = self._frag(n.parents[0])
+            sort_keys = tuple(k for k, _ in n.keys)
+            all_asc = all(not d for _, d in n.keys)
             if self.nparts == 1:
                 f.ops.append(StageOp("sort", {"keys": tuple(n.keys)}))
-                f.partitioning = E.Partitioning(
-                    "range", tuple(k for k, _ in n.keys))
+                f.partitioning = (E.Partitioning("range", sort_keys)
+                                  if all_asc else E.Partitioning.none())
+                return f
+            pkeys = f.partitioning.keys
+            if (f.partitioning.kind == "range" and all_asc
+                    and len(sort_keys) <= len(pkeys)
+                    and sort_keys == pkeys[:len(sort_keys)]):
+                # Exchange elimination (AssumeOrderBy,
+                # DryadLinqQueryable.cs:3639): sound only when the requested
+                # ascending sort keys are a PREFIX of the claimed range keys.
+                # "range(keys)" guarantees globally-sorted-by-keys data in
+                # partition order but NOT that key ties are co-located
+                # (assume_order_by data may split a tie run across
+                # partitions), so a sort introducing any key beyond the
+                # claim — or any descending direction — must keep its
+                # exchange.  A stable local prefix sort of
+                # already-(claim-)sorted partitions preserves the FULL
+                # claim, so the original partitioning survives.
+                f.ops.append(StageOp("sort", {"keys": tuple(n.keys)}))
                 return f
             src_id, f = self._materialize(f, label="sort-input")
             primary, desc = n.keys[0]
@@ -432,9 +455,13 @@ class Planner:
             st = self._new_stage(
                 [Leg(src_id, [], ex)],
                 [StageOp("sort", {"keys": tuple(n.keys)})], "orderby")
+            # the exchange ranges on the primary only, but it routes equal
+            # primary lanes to ONE destination (ties co-located), and the
+            # local sort orders each partition by the full key list — the
+            # output is globally sorted by all sort keys when ascending
             return Fragment(st.id, [], f.capacity,
-                            E.Partitioning("range",
-                                           tuple(k for k, _ in n.keys)))
+                            E.Partitioning("range", sort_keys)
+                            if all_asc else E.Partitioning.none())
 
         if isinstance(n, E.SetOp):
             lf = self._frag(n.parents[0])

@@ -1,0 +1,152 @@
+"""Right/full outer joins + assume_order_by (VERDICT r2 item 10).
+
+Reference parity: the right/full outer join operator family and AssumeOrderBy
+(DryadLinqQueryable.cs:3639).  Every test compares the mesh executor against
+the sequential oracle (the LocalDebug pattern, SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context
+from tests.utils import assert_same_rows
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return Context()
+
+
+@pytest.fixture(scope="module")
+def dbg():
+    return Context(local_debug=True)
+
+
+def _sides(c, seed=0):
+    rng = np.random.RandomState(seed)
+    left = c.from_columns(
+        {"k": rng.randint(0, 12, 80).astype(np.int32),
+         "lv": rng.randn(80).astype(np.float32)}, capacity=32)
+    right = c.from_columns(
+        {"k": rng.randint(6, 18, 60).astype(np.int32),
+         "rv": np.arange(60, dtype=np.int32)}, capacity=32)
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["right", "full"])
+def test_outer_join(ctx, dbg, how):
+    def q(c):
+        l, r = _sides(c)
+        return l.join(r, ["k"], expansion=16.0, how=how)
+
+    assert_same_rows(q(ctx).collect(), q(dbg).collect())
+
+
+def test_right_join_disjoint_keys(ctx, dbg):
+    """No key overlap at all: right join = right rows with zero-filled left
+    columns; full join = both sides zero-filled on the other side."""
+    def q(c, how):
+        l = c.from_columns({"k": np.arange(0, 20, dtype=np.int32),
+                            "lv": np.ones(20, np.float32)}, capacity=8)
+        r = c.from_columns({"k": np.arange(100, 130, dtype=np.int32),
+                            "rv": np.arange(30, dtype=np.int32)}, capacity=8)
+        return l.join(r, ["k"], expansion=8.0, how=how)
+
+    for how in ("right", "full"):
+        assert_same_rows(q(ctx, how).collect(), q(dbg, how).collect())
+
+
+def test_outer_join_string_keys(ctx, dbg):
+    words_l = [b"apple", b"pear", b"fig", b"plum", b"apple", b"kiwi"] * 4
+    words_r = [b"fig", b"mango", b"apple", b"dates"] * 3
+
+    def q(c, how):
+        l = c.from_columns({"w": list(words_l),
+                            "lv": np.arange(len(words_l), dtype=np.int32)},
+                           capacity=8)
+        r = c.from_columns({"w": list(words_r),
+                            "rv": np.arange(len(words_r), dtype=np.int32)},
+                           capacity=8)
+        return l.join(r, ["w"], expansion=16.0, how=how)
+
+    for how in ("right", "full"):
+        assert_same_rows(q(ctx, how).collect(), q(dbg, how).collect())
+
+
+def test_right_join_different_key_names(ctx, dbg):
+    """Left key column carries the right key values for unmatched rows."""
+    def q(c, how):
+        l = c.from_columns({"a": np.arange(10, dtype=np.int32),
+                            "lv": np.arange(10, dtype=np.int32) * 2},
+                           capacity=4)
+        r = c.from_columns({"b": np.arange(5, 15, dtype=np.int32),
+                            "rv": np.arange(10, dtype=np.int32) * 3},
+                           capacity=4)
+        return l.join(r, ["a"], ["b"], expansion=4.0, how=how)
+
+    for how in ("right", "full"):
+        assert_same_rows(q(ctx, how).collect(), q(dbg, how).collect())
+
+
+def test_full_join_broadcast_request_ignored(ctx, dbg):
+    """broadcast=True must not replicate the right side of a full join
+    (unmatched right rows would be emitted once per partition)."""
+    def q(c):
+        l, r = _sides(c, seed=3)
+        return l.join(r, ["k"], expansion=16.0, broadcast=True, how="full")
+
+    assert_same_rows(q(ctx).collect(), q(dbg).collect())
+
+
+def test_assume_order_by_skips_exchange(ctx):
+    rng = np.random.RandomState(7)
+    base = ctx.from_columns(
+        {"k": rng.randint(0, 1000, 128).astype(np.int32),
+         "v": rng.randn(128).astype(np.float32)}, capacity=32)
+    stored = base.order_by([("k", False)])._materialize()
+    loaded = ctx.from_pdata(stored)
+
+    plan = (loaded.assume_order_by(["k"])
+            .order_by([("k", False)]).explain())
+    assert "=>range" not in plan
+
+    got = loaded.assume_order_by(["k"]).order_by([("k", False)]).collect()
+    assert np.all(np.diff(np.asarray(got["k"])) >= 0)
+    assert len(got["k"]) == 128
+
+
+def test_assume_order_by_composite_claim_prefix_only(ctx):
+    """A composite claim range(a,b) may split equal-'a' runs across
+    partitions, so only sorts whose ascending keys are a PREFIX of the
+    claim may skip the exchange; introducing a new key (c) must keep it
+    (code-review r3 finding)."""
+    rng = np.random.RandomState(9)
+    n = 96
+    base = ctx.from_columns(
+        {"a": np.repeat(np.arange(8, dtype=np.int32), n // 8),
+         "b": rng.randint(0, 100, n).astype(np.int32),
+         "c": rng.permutation(n).astype(np.int32)}, capacity=16)
+    claimed = base.assume_order_by(["a", "b"])
+    # prefix sort (a) elides; (a, c) adds a key -> must keep the exchange
+    assert "=>range" not in claimed.order_by([("a", False)]).explain()
+    plan = claimed.order_by([("a", False), ("c", False)]).explain()
+    assert "=>range" in plan
+    got = claimed.order_by([("a", False), ("c", False)]).collect()
+    a, c = np.asarray(got["a"]), np.asarray(got["c"])
+    assert np.all(np.diff(a) >= 0)
+    for grp in range(8):
+        assert np.all(np.diff(c[a == grp]) >= 0)
+
+
+def test_descending_sort_drops_range_claim(ctx):
+    """After a DESCENDING sort the partitions hold descending ranges; a
+    subsequent ascending order_by must NOT skip its exchange."""
+    rng = np.random.RandomState(8)
+    base = ctx.from_columns(
+        {"k": rng.randint(0, 1000, 128).astype(np.int32)}, capacity=32)
+    plan = (base.order_by([("k", True)])
+            .order_by([("k", False)]).explain())
+    assert plan.count("=>range") == 2
+    got = (base.order_by([("k", True)])
+           .order_by([("k", False)]).collect())
+    assert np.all(np.diff(np.asarray(got["k"])) >= 0)
