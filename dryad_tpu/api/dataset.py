@@ -452,6 +452,47 @@ class Dataset:
         return Dataset(self.ctx, E.GroupByAgg(
             parents=(self.node,), keys=tuple(keys), aggs=dict(aggs)))
 
+    def group_apply(self, keys: Sequence[str], fn,
+                    group_capacity: int, max_groups: int | None = None,
+                    out_rows: int = 1, out_capacity: int | None = None
+                    ) -> "Dataset":
+        """GroupBy yielding group CONTENTS to an arbitrary per-group fn —
+        the reference's general GroupBy result selector
+        (DryadLinqVertex.cs:510-753): any non-decomposable per-group
+        computation (median, mode, custom reductions) is expressible here.
+
+        ``fn(cols, count) -> (out_cols, mask)``: cols are one group's
+        columns as [group_capacity, ...] arrays (rows >= count are
+        unspecified — mask by count); out_cols are [out_rows, ...] and
+        mask is [out_rows] bool.  Group keys are attached to the output
+        automatically.  ``group_capacity`` bounds a single group's rows
+        (overflow triggers a measured-need retry); ``max_groups`` bounds
+        per-partition distinct keys (default: the input capacity).  The
+        dense regroup materializes max_groups x group_capacity cells per
+        column — size both knobs for the workload."""
+        return Dataset(self.ctx, E.GroupApply(
+            parents=(self.node,), keys=tuple(keys), fn=fn,
+            group_capacity=group_capacity, max_groups=max_groups,
+            out_rows=out_rows, out_capacity=out_capacity))
+
+    def group_top_k(self, keys: Sequence[str], k: int, by: str,
+                    descending: bool = True) -> "Dataset":
+        """Per-group top-k rows by ``by`` (all columns kept; ties keep
+        original order).  Structured — no callable, ships to clusters
+        without fn_table registration."""
+        return Dataset(self.ctx, E.GroupTopK(
+            parents=(self.node,), keys=tuple(keys), k=k, by=by,
+            descending=descending))
+
+    def group_median(self, keys: Sequence[str], by: str,
+                     out: str | None = None) -> "Dataset":
+        """One row per group: keys + the LOWER median of ``by`` (element
+        (n-1)//2 of the ascending order — always an actual group element,
+        unlike numpy's interpolated even-size median)."""
+        return Dataset(self.ctx, E.GroupRankSelect(
+            parents=(self.node,), keys=tuple(keys), by=by, rank="median",
+            out=out))
+
     def aggregate(self, dec: "E.Decomposable"):
         """Whole-dataset user-defined aggregation (the reference's
         user-combinable Aggregate operator, DryadLinqQueryable.cs

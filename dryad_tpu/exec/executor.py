@@ -46,7 +46,8 @@ class CapacityError(RuntimeError):
 
 
 # Op kinds whose overflow is fixed by doubling out_capacity on retry.
-_SCALABLE_OVERFLOW_KINDS = {"flat_tokens", "flat_map", "join", "zip"}
+_SCALABLE_OVERFLOW_KINDS = {"flat_tokens", "flat_map", "join", "zip",
+                            "group_apply"}
 # Op kinds whose overflow CANNOT be fixed by scaling: `recap` truncates to a
 # user-fixed capacity, `sliding_window` overflows when a neighbor partition
 # lacks halo rows — retrying at a bigger scale just re-runs the same failure.
@@ -155,6 +156,22 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
     if k == "group":
         keys = list(p["keys"])
         return kernels.group_aggregate(b, keys, dict(p["aggs"])), no
+    if k == "group_apply":
+        G0, C0, O0 = p["max_groups"], p["group_capacity"], p["out_capacity"]
+        out, ng, ms, tot = kernels.group_regroup_apply(
+            b, list(p["keys"]), p["fn"], G0 * scale, C0 * scale,
+            p["out_rows"], O0 * scale)
+        ns = jnp.maximum(jnp.maximum(
+            jnp.where(ng > G0 * scale, _scale_need(ng, G0), 0),
+            jnp.where(ms > C0 * scale, _scale_need(ms, C0), 0)),
+            jnp.where(tot > O0 * scale, _scale_need(tot, O0), 0))
+        return out, _needs(ns)
+    if k == "group_top_k":
+        return kernels.group_top_k(b, list(p["keys"]), p["k"], p["by"],
+                                   p["descending"]), no
+    if k == "group_rank":
+        return kernels.group_rank_select(b, list(p["keys"]), p["by"],
+                                         p["rank"], p["out"]), no
     if k == "distinct":
         keys = list(p["keys"]) or None
         return kernels.distinct(b, keys), no

@@ -251,6 +251,101 @@ def run_oracle(root: E.Node, bindings: Dict[str, Table] | None = None) -> Table:
                             agg_out_names.append(cname)
             return {k: (v if v and isinstance(v[0], bytes) else np.asarray(v))
                     for k, v in out.items()}
+        if isinstance(n, (E.GroupApply, E.GroupTopK, E.GroupRankSelect)):
+            t = ev(n.parents[0])
+            nrows = _nrows(t)
+            groups: Dict[tuple, List[int]] = collections.defaultdict(list)
+            order: List[tuple] = []
+            for i in range(nrows):
+                k = _key_of({kk: t[kk][i] for kk in n.keys}, tuple(n.keys))
+                if k not in groups:
+                    order.append(k)
+                groups[k].append(i)
+            if isinstance(n, E.GroupTopK):
+                idx: List[int] = []
+                for k in order:
+                    g = groups[k]
+                    # python sorted is stable even with reverse=True, same
+                    # as the device's stable inverted-lane lexsort
+                    top = sorted(g, key=lambda i: t[n.by][i],
+                                 reverse=n.descending)[:n.k]
+                    idx.extend(top)
+                return _take_rows(t, idx)
+            if isinstance(n, E.GroupRankSelect):
+                out: Table = {k: [] for k in n.keys}
+                oname = n.out or n.by
+                out[oname] = []
+                for k in order:
+                    g = sorted(groups[k], key=lambda i: t[n.by][i])
+                    if n.rank == "median":
+                        pick = g[(len(g) - 1) // 2]
+                    elif n.rank == "min":
+                        pick = g[0]
+                    else:
+                        pick = g[-1]
+                    for kk, kv in zip(n.keys, k):
+                        out[kk].append(kv)
+                    out[oname].append(t[n.by][pick])
+                return {k: (v if v and isinstance(v[0], bytes)
+                            else np.asarray(v)) for k, v in out.items()}
+            # GroupApply: run the SAME fn per group (jax works eagerly on
+            # numpy inputs), padding each group to group_capacity — rows
+            # past count are zeros, which fn must not read (the device
+            # contract: rows >= count are unspecified)
+            import jax.numpy as jnp
+
+            from dryad_tpu.data.columnar import StringColumn
+            # the device right-sizes group_capacity via measured-need
+            # retries, so the eager reference must be exact regardless of
+            # the declared capacity: pad to the largest group
+            C = max([n.group_capacity] + [len(g) for g in groups.values()])
+            out_rows: List[Dict[str, Any]] = []
+            for k in order:
+                g = groups[k]
+                cols: Dict[str, Any] = {}
+                for kk, v in t.items():
+                    if isinstance(v, list):
+                        L = max([len(b) for b in v] or [1]) or 1
+                        data = np.zeros((C, L), np.uint8)
+                        lens = np.zeros((C,), np.int32)
+                        for r, i in enumerate(g[:C]):
+                            b = v[i]
+                            data[r, :len(b)] = np.frombuffer(b, np.uint8)
+                            lens[r] = len(b)
+                        cols[kk] = StringColumn(jnp.asarray(data),
+                                                jnp.asarray(lens))
+                    else:
+                        arr = np.asarray(v)
+                        p = np.zeros((C,) + arr.shape[1:], arr.dtype)
+                        p[:min(len(g), C)] = arr[g[:C]]
+                        # hand fn jax arrays, exactly as on device — numpy
+                        # arrays fancy-indexed by jax index arrays return
+                        # wrong results silently
+                        cols[kk] = jnp.asarray(p)
+                oc, mask = n.fn(cols, jnp.int32(len(g)))
+                mask = np.asarray(mask).astype(bool)
+                for r in np.nonzero(mask)[0]:
+                    row: Dict[str, Any] = {}
+                    for kk, kv in zip(n.keys, k):
+                        row[kk] = kv
+                    for cname, cv in oc.items():
+                        if isinstance(cv, StringColumn):
+                            d = np.asarray(cv.data)[r]
+                            l = int(np.asarray(cv.lengths)[r])
+                            row[cname] = bytes(d[:l])
+                        else:
+                            row[cname] = np.asarray(cv)[r]
+                    out_rows.append(row)
+            if not out_rows:
+                names = list(n.keys)
+            else:
+                names = list(out_rows[0].keys())
+            res: Table = {kk: [] for kk in names}
+            for row in out_rows:
+                for kk in names:
+                    res[kk].append(row[kk])
+            return {k: (v if v and isinstance(v[0], bytes)
+                        else np.asarray(v)) for k, v in res.items()}
         if isinstance(n, E.Join):
             lt, rt = ev(n.parents[0]), ev(n.parents[1])
             rmap: Dict[tuple, List[int]] = collections.defaultdict(list)

@@ -23,7 +23,8 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 __all__ = [
     "Partitioning", "Node", "Source", "Placeholder", "Map", "Filter",
-    "FlatTokens", "GroupByAgg", "Join", "OrderBy", "Distinct", "Concat",
+    "FlatTokens", "GroupByAgg", "GroupApply", "GroupTopK", "GroupRankSelect",
+    "Join", "OrderBy", "Distinct", "Concat",
     "HashRepartition", "RangeRepartition", "Broadcast", "ApplyPerPartition",
     "Take", "SetOp", "WithCapacity", "CrossApply", "FlatMap", "Zip",
     "SlidingWindow", "WithRowIndex", "AssumePartitioning", "SkipTake",
@@ -193,6 +194,58 @@ class GroupByAgg(Node):
     parents: Tuple[Node, ...]
     keys: Tuple[str, ...]
     aggs: Dict[str, Any]
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", tuple(self.keys))
+
+
+@_node
+class GroupApply(Node):
+    """GroupBy yielding group CONTENTS to an arbitrary per-group fn — the
+    reference's general GroupBy result selector
+    (DryadLinqVertex.cs:510-753, IGrouping to user code).
+    fn(cols, count) -> (out_cols [out_rows, ...], mask [out_rows]); group
+    keys are auto-attached to the output.  None capacities resolve to the
+    input capacity at plan time."""
+
+    parents: Tuple[Node, ...]
+    keys: Tuple[str, ...]
+    fn: Callable
+    group_capacity: int
+    max_groups: Optional[int] = None
+    out_rows: int = 1
+    out_capacity: Optional[int] = None
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", tuple(self.keys))
+
+
+@_node
+class GroupTopK(Node):
+    """Per-group top-k rows by a column (all columns kept)."""
+
+    parents: Tuple[Node, ...]
+    keys: Tuple[str, ...]
+    k: int
+    by: str
+    descending: bool = True
+
+    @property
+    def partitioning(self) -> Partitioning:
+        return Partitioning("hash", tuple(self.keys))
+
+
+@_node
+class GroupRankSelect(Node):
+    """One row per group at a sorted rank of a column (median/min/max)."""
+
+    parents: Tuple[Node, ...]
+    keys: Tuple[str, ...]
+    by: str
+    rank: str = "median"
+    out: Optional[str] = None
 
     @property
     def partitioning(self) -> Partitioning:

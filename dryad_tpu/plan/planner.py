@@ -212,6 +212,24 @@ class Planner:
         # fragments are single-use unless materialized; copy op list
         return Fragment(f.src, list(f.ops), f.capacity, f.partitioning)
 
+    def _colocate_then(self, f: Fragment, keys: Tuple[str, ...],
+                       op: StageOp, label: str,
+                       out_capacity: Optional[int] = None) -> Fragment:
+        """Hash-co-locate rows by ``keys`` then apply ``op`` — the shared
+        lowering of the GroupBy-contents family (group_apply/top-k/rank).
+        Partition elimination applies when the input already hashes on the
+        same keys (AssumeHashPartition parity)."""
+        cap = out_capacity or f.capacity
+        if self.nparts == 1 or (f.partitioning.kind == "hash"
+                                and f.partitioning.keys == keys and keys):
+            f.ops.append(op)
+            f.capacity = cap
+            f.partitioning = E.Partitioning("hash", keys)
+            return f
+        ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
+        st = self._new_stage([Leg(f.src, f.ops, ex)], [op], label)
+        return Fragment(st.id, [], cap, E.Partitioning("hash", keys))
+
     def _lower(self, n: E.Node) -> Fragment:
         if isinstance(n, E.Source):
             cap = getattr(n.data, "capacity", None)
@@ -362,6 +380,32 @@ class Planner:
             st = self._new_stage([Leg(f.src, f.ops, ex)], body, "groupby")
             return Fragment(st.id, [], f.capacity,
                             E.Partitioning("hash", keys))
+
+        if isinstance(n, E.GroupApply):
+            f = self._frag(n.parents[0])
+            keys = tuple(n.keys)
+            mg = n.max_groups or f.capacity
+            oc = n.out_capacity or f.capacity
+            op = StageOp("group_apply", {
+                "keys": keys, "fn": n.fn, "max_groups": mg,
+                "group_capacity": n.group_capacity,
+                "out_rows": n.out_rows, "out_capacity": oc})
+            return self._colocate_then(f, keys, op, "group_apply",
+                                       out_capacity=oc)
+
+        if isinstance(n, E.GroupTopK):
+            f = self._frag(n.parents[0])
+            op = StageOp("group_top_k", {
+                "keys": tuple(n.keys), "k": n.k, "by": n.by,
+                "descending": n.descending})
+            return self._colocate_then(f, tuple(n.keys), op, "group_top_k")
+
+        if isinstance(n, E.GroupRankSelect):
+            f = self._frag(n.parents[0])
+            op = StageOp("group_rank", {
+                "keys": tuple(n.keys), "by": n.by, "rank": n.rank,
+                "out": n.out})
+            return self._colocate_then(f, tuple(n.keys), op, "group_rank")
 
         if isinstance(n, E.Distinct):
             f = self._frag(n.parents[0])

@@ -31,6 +31,18 @@ def make_sum_dec():
     return Decomposable(_topsum_seed, _topsum_merge, None)
 
 
+def second_largest(cols, count):
+    """group_apply fn: per-group 2nd-largest v (largest for singletons)."""
+    import jax.numpy as jnp
+    v = cols["v"]
+    lo = (jnp.finfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.floating)
+          else jnp.iinfo(v.dtype).min)
+    masked = jnp.where(jnp.arange(v.shape[0]) < count, v, lo)
+    s = jnp.sort(masked)[::-1]
+    pick = jnp.where(count >= 2, s[1], s[0])
+    return {"second": pick[None]}, jnp.ones((1,), jnp.bool_)
+
+
 # registered-by-name objects for cluster shipping (shiplan FN_TABLE path)
 SUM_DEC = make_sum_dec()
 FN_TABLE = {"sum_dec": SUM_DEC}

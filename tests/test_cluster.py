@@ -198,6 +198,57 @@ def test_cluster_zip_strings_take(cluster):
     assert [w.decode() for w in top["s"]] == words[:5]
 
 
+def test_cluster_group_contents(cluster):
+    """Group-contents family over the worker gang: structured group_top_k /
+    group_median ship without callables; group_apply ships its per-group
+    fn by module:qualname (DryadLinqVertex.cs:510-753 parity in cluster
+    mode)."""
+    ctx = Context(cluster=cluster)
+    rng = np.random.default_rng(7)
+    k = rng.integers(0, 6, 90).astype(np.int32)
+    v = rng.integers(-50, 50, 90).astype(np.int32)
+    ds = ctx.from_columns({"k": k, "v": v})
+
+    out = ds.group_top_k(["k"], 2, "v").collect()
+    got = {}
+    for kk, vv in zip(np.asarray(out["k"]), np.asarray(out["v"])):
+        got.setdefault(int(kk), []).append(int(vv))
+    exp = {int(kk): sorted(v[k == kk].tolist(), reverse=True)[:2]
+           for kk in np.unique(k)}
+    assert {kk: sorted(g, reverse=True) for kk, g in got.items()} == exp
+
+    med = ds.group_median(["k"], "v").collect()
+    exp_med = {int(kk): int(np.sort(v[k == kk])[(np.sum(k == kk) - 1) // 2])
+               for kk in np.unique(k)}
+    assert dict(zip((int(x) for x in med["k"]),
+                    (int(x) for x in med["v"]))) == exp_med
+
+    out2 = ds.group_apply(["k"], cluster_fns.second_largest,
+                          group_capacity=64).collect()
+    exp2 = {}
+    for kk in np.unique(k):
+        g = np.sort(v[k == kk])[::-1]
+        exp2[int(kk)] = int(g[1] if len(g) >= 2 else g[0])
+    assert dict(zip((int(x) for x in out2["k"]),
+                    (int(x) for x in out2["second"]))) == exp2
+
+
+def test_cluster_outer_joins(cluster):
+    """Right/full outer joins over the worker gang."""
+    ctx = Context(cluster=cluster)
+    l = ctx.from_columns({"k": np.arange(20, dtype=np.int32),
+                          "a": np.arange(20, dtype=np.int32) * 2})
+    r = ctx.from_columns({"k": np.arange(10, 30, dtype=np.int32),
+                          "b": np.arange(20, dtype=np.int32) + 5})
+    out = l.join(r, ["k"], expansion=4.0, how="full").collect()
+    ks = sorted(np.asarray(out["k"]).tolist())
+    assert ks == list(range(30))
+    for kk, a, b in zip(out["k"], out["a"], out["b"]):
+        kk, a, b = int(kk), int(a), int(b)
+        assert a == (kk * 2 if kk < 20 else 0)
+        assert b == ((kk - 10) + 5 if kk >= 10 else 0)
+
+
 def test_cluster_scalar_ships_one_row(cluster):
     ctx = Context(cluster=cluster)
     rng = np.random.default_rng(5)

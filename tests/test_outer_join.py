@@ -88,6 +88,30 @@ def test_right_join_different_key_names(ctx, dbg):
         assert_same_rows(q(ctx, how).collect(), q(dbg, how).collect())
 
 
+def test_right_join_mismatched_string_widths():
+    """Unmatched right keys LONGER than the left key column's max_len must
+    survive intact (code-review r3 finding: the kernel truncated them to
+    the left width)."""
+    from dryad_tpu.data.columnar import Batch, string_column_from_list
+    from dryad_tpu.ops.kernels import hash_join
+    import jax.numpy as jnp
+
+    left = Batch({"k": string_column_from_list([b"ab", b"cd"], 2, 2),
+                  "lv": jnp.asarray(np.arange(2, dtype=np.int32))},
+                 jnp.int32(2))
+    right = Batch({"k": string_column_from_list(
+        [b"ab", b"mangosteen"], 2, 10),
+        "rv": jnp.asarray(np.arange(2, dtype=np.int32) + 7)}, jnp.int32(2))
+    out, need = hash_join(left, right, ["k"], ["k"], out_capacity=8,
+                          how="right")
+    n = int(out.count)
+    ks = []
+    data, lens = np.asarray(out["k"].data), np.asarray(out["k"].lengths)
+    for i in range(n):
+        ks.append(bytes(data[i, :lens[i]]))
+    assert int(need) == 0 and sorted(ks) == [b"ab", b"mangosteen"]
+
+
 def test_full_join_broadcast_request_ignored(ctx, dbg):
     """broadcast=True must not replicate the right side of a full join
     (unmatched right rows would be emitted once per partition)."""
