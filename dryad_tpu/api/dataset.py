@@ -160,6 +160,46 @@ class Context:
                          zip(data, lens)]} if self.local_debug else None
         return self.from_pdata(pdata, host=host)
 
+    # -- streamed (out-of-core) sources ------------------------------------
+
+    def from_stream(self, source) -> "Dataset":
+        """Wrap an exec.ooc.ChunkSource as a streamed Dataset: the query
+        plans with one logical partition and executes over chunk streams
+        (exec/stream_exec.py) — device working set stays O(chunk_rows)
+        no matter the total data size (the reference's transparent
+        bounded-memory channels, channelbufferqueue.cpp:777)."""
+        from dryad_tpu.exec.stream_exec import (StreamExecutionError,
+                                                StreamSource)
+        if self.cluster is not None:
+            raise StreamExecutionError(
+                "streamed sources are not supported on a cluster Context "
+                "yet — stream on a single-process Context, or use the "
+                "cluster path with device-resident data")
+        node = E.Source(parents=(), data=StreamSource(source),
+                        _npartitions=1)
+        return Dataset(self, node)
+
+    def read_store_stream(self, path: str,
+                          chunk_rows: int | None = None) -> "Dataset":
+        """Stream a persisted store through the plain Dataset API —
+        the >HBM path (1 TB TeraSort north star, BASELINE.md config 2)."""
+        from dryad_tpu.exec.ooc import ChunkSource
+        cs = ChunkSource.from_store(
+            path, chunk_rows or self.config.ooc_chunk_rows)
+        return self.from_stream(cs)
+
+    def read_text_stream(self, path, column: str = "line",
+                         chunk_rows: int | None = None,
+                         max_line_len: int | None = None) -> "Dataset":
+        """Stream text files line by line (never holds a file in memory)."""
+        from dryad_tpu.exec.ooc import ChunkSource
+        from dryad_tpu.io.providers import expand_paths
+        cs = ChunkSource.from_text(
+            expand_paths(path),
+            chunk_rows or self.config.ooc_chunk_rows,
+            max_line_len or self.config.text_max_line_len, column)
+        return self.from_stream(cs)
+
     def read(self, uri: str, **kw) -> "Dataset":
         """URI-scheme dispatch (DataProvider.cs / concreterchannel.cpp:44-49):
         ``file://`` text, ``store://`` partitioned store, plus any scheme
@@ -173,6 +213,12 @@ class Context:
         (AssumeHashPartition parity, DryadLinqQueryable.cs:3408)."""
         from dryad_tpu.io.store import read_store, store_meta
         meta = store_meta(path)
+        auto = self.config.ooc_auto_stream_rows
+        if (auto and self.cluster is None
+                and sum(meta.get("counts", [])) >= auto):
+            # size-threshold streaming: a big store never tries to fit in
+            # HBM (VERDICT r2 next-round item 1)
+            return self.read_store_stream(path)
         pmeta = meta.get("partitioning", {"kind": "none"})
         part = E.Partitioning(pmeta.get("kind", "none"),
                               tuple(pmeta.get("keys", ())))
@@ -599,10 +645,34 @@ class Dataset:
             # claims drop — the re-shipped source is block-partitioned)
             t = self.ctx._cluster_run(self.node)
             return self.ctx.from_columns(t)
+        if self._streaming():
+            # materialize once to a temp store, stream reads from there
+            import tempfile
+            d = tempfile.mkdtemp(prefix="dryad-cache-",
+                                 dir=self.ctx.spill_dir)
+            target = d + "/data"
+            self.to_store(target)
+            return self.ctx.read_store_stream(target)
         pd = self._materialize()
         return self.ctx.from_pdata(pd, partitioning=part)
 
     # -- terminals ---------------------------------------------------------
+
+    def _streaming(self) -> bool:
+        from dryad_tpu.exec.stream_exec import StreamSource
+        return any(isinstance(n, E.Source)
+                   and isinstance(n.data, StreamSource)
+                   for n in E.walk(self.node))
+
+    def _stream_run(self):
+        """Plan with ONE logical partition and execute over chunk streams
+        (exec/stream_exec.py); returns the lazy output ChunkSource."""
+        from dryad_tpu.exec.stream_exec import run_stream_graph
+        graph = plan_query(self.node, 1, hosts=1, config=self.ctx.config)
+        return run_stream_graph(graph, self.ctx.config,
+                                spill_dir=self.ctx.spill_dir,
+                                event_log=self.ctx.executor._event
+                                if self.ctx.executor else None)
 
     def _materialize(self) -> PData:
         graph = plan_query(self.node, self.ctx.nparts,
@@ -615,6 +685,9 @@ class Dataset:
             return _oracle.run_oracle(self.node)
         if self.ctx.cluster is not None:
             out = self.ctx._cluster_run(self.node)
+        elif self._streaming():
+            from dryad_tpu.exec.stream_exec import chunks_to_table
+            out = chunks_to_table(self._stream_run())
         else:
             from dryad_tpu.exec.data import maybe_shrink_for_collect
             out = pdata_to_host(
@@ -643,6 +716,14 @@ class Dataset:
                 store_partitioning={"kind": part.kind,
                                     "keys": list(part.keys)})
             return
+        if self._streaming():
+            from dryad_tpu.exec.ooc import write_chunks_to_store
+            cs = self._stream_run()
+            write_chunks_to_store(
+                path, iter(cs), cs.schema,
+                partitioning={"kind": part.kind, "keys": list(part.keys)},
+                compression=compression)
+            return
         pd = self._materialize()
         write_store(path, pd, partitioning={"kind": part.kind,
                                             "keys": list(part.keys)},
@@ -657,6 +738,8 @@ class Dataset:
         if self.ctx.cluster is not None:
             # counts-only reduction: no row data crosses the control plane
             return self.ctx._cluster_run(self.node, collect="count")
+        if self._streaming():
+            return sum(c.n for c in self._stream_run())
         return self._materialize().total_rows()
 
     def _scalar(self, kind: str, column: str):
@@ -679,6 +762,9 @@ class Dataset:
             t = self.ctx._cluster_run(agg_node)
             v = np.asarray(t["out"])
             return v[0] if v.shape and v.shape[0] == 1 else v
+        if self._streaming():
+            from dryad_tpu.exec.stream_exec import stream_scalar
+            return stream_scalar(self._stream_run(), kind, column)
         pd = self._materialize()
         import jax
         import jax.numpy as jnp

@@ -47,7 +47,8 @@ from dryad_tpu.ops.hashing import hash_batch_keys
 
 __all__ = [
     "HChunk", "ChunkSource", "stream_map", "external_sort",
-    "streaming_group_aggregate", "write_chunks_to_store", "OOCError",
+    "streaming_group_aggregate", "streaming_distinct",
+    "write_chunks_to_store", "OOCError",
 ]
 
 
@@ -252,6 +253,51 @@ class ChunkSource:
                 whole = HChunk(hc, cnt)
                 for s in range(0, cnt, chunk_rows):
                     yield _slice_hchunk(whole, s, min(s + chunk_rows, cnt))
+
+        return ChunkSource(it, schema, chunk_rows)
+
+    @staticmethod
+    def from_text(paths, chunk_rows: int, max_line_len: int = 256,
+                  column: str = "line") -> "ChunkSource":
+        """Stream text files line by line, ``chunk_rows`` lines per chunk —
+        the file itself is never held in memory (the streaming counterpart
+        of io.providers.read_text_files; reference line-record channel,
+        DryadLinqTextReader.cs).  A trailing unterminated line counts."""
+        from dryad_tpu import native
+
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        schema = {column: {"kind": "str", "max_len": max_line_len}}
+
+        def pack(lines):
+            data, lens = native.pack_bytes_list(lines, max_line_len,
+                                                len(lines))
+            return HChunk({column: (data[:len(lines)], lens[:len(lines)])},
+                          len(lines))
+
+        def strip_cr(line: bytes) -> bytes:
+            # match the in-memory reader (native pack_lines strips \r)
+            return line[:-1] if line.endswith(b"\r") else line
+
+        def it():
+            buf: List[bytes] = []
+            for path in paths:
+                rem = b""
+                with open(path, "rb") as f:
+                    while True:
+                        blk = f.read(1 << 22)
+                        if not blk:
+                            break
+                        parts = (rem + blk).split(b"\n")
+                        rem = parts.pop()
+                        buf.extend(strip_cr(p) for p in parts)
+                        while len(buf) >= chunk_rows:
+                            yield pack(buf[:chunk_rows])
+                            buf = buf[chunk_rows:]
+                if rem:
+                    buf.append(strip_cr(rem))
+            while buf:
+                yield pack(buf[:chunk_rows])
+                buf = buf[chunk_rows:]
 
         return ChunkSource(it, schema, chunk_rows)
 
@@ -741,6 +787,81 @@ def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
         out = _batch_to_chunk(finalize(_chunk_to_batch(buckets[i][0],
                                                        chunk_rows)))
         yield out
+
+
+# ---------------------------------------------------------------------------
+# streaming distinct
+
+
+@functools.lru_cache(maxsize=256)
+def _make_distinct_fn(keys: Tuple[str, ...] | None):
+    return jax.jit(lambda b: kernels.distinct(
+        b, list(keys) if keys else None))
+
+
+def streaming_distinct(src: ChunkSource, keys: Sequence[str] = (),
+                       n_buckets: int | None = None,
+                       depth: int | None = None) -> Iterator[HChunk]:
+    """Distinct rows over an arbitrarily large chunk stream.
+
+    Per chunk: local dedup on device, hash-scatter survivors into key
+    buckets; buckets accumulate on host and re-dedup on device whenever
+    they exceed chunk capacity (distinct rows per bucket must fit the
+    chunk — raise ``n_buckets`` for higher cardinality).  The streaming
+    form of distinct-before-and-after-exchange (plan/planner.py Distinct
+    lowering)."""
+    if depth is None or n_buckets is None:
+        from dryad_tpu.utils.config import JobConfig
+        _cfg = JobConfig()
+        depth = depth if depth is not None else _cfg.ooc_inflight
+        n_buckets = (n_buckets if n_buckets is not None
+                     else _cfg.ooc_hash_buckets)
+    chunk_rows = src.chunk_rows
+    key_names = tuple(keys) or tuple(sorted(src.schema))
+    dd = _make_distinct_fn(tuple(keys) if keys else None)
+    scatter = _make_hash_scatter_fn(key_names, n_buckets)
+
+    buckets: List[List[HChunk]] = [[] for _ in range(n_buckets)]
+    bucket_rows = [0] * n_buckets
+
+    def compact_bucket(i: int) -> None:
+        merged = _concat_hchunks(src.schema, buckets[i])
+        out = _batch_to_chunk(dd(_chunk_to_batch(merged, chunk_rows)))
+        buckets[i] = [out]
+        bucket_rows[i] = out.n
+
+    def add_rows(ch: HChunk) -> None:
+        grouped, hist = scatter(_chunk_to_batch(ch, chunk_rows))
+        gh = _batch_to_chunk(grouped)
+        h = np.asarray(hist)
+        offs = np.cumsum(np.concatenate([[0], h]))
+        for i in range(n_buckets):
+            frag = _slice_hchunk(gh, int(offs[i]), int(offs[i + 1]))
+            if frag.n == 0:
+                continue
+            if bucket_rows[i] + frag.n > chunk_rows:
+                compact_bucket(i)
+                if bucket_rows[i] + frag.n > chunk_rows:
+                    raise OOCError(
+                        f"distinct bucket {i} holds {bucket_rows[i]} "
+                        f"distinct rows; with {frag.n} incoming it exceeds "
+                        f"chunk capacity {chunk_rows}; raise n_buckets")
+            buckets[i].append(frag)
+            bucket_rows[i] += frag.n
+
+    pending: deque = deque()
+    for chunk in src:
+        pending.append(dd(_chunk_to_batch(chunk, chunk_rows)))
+        if len(pending) >= depth:
+            add_rows(_batch_to_chunk(pending.popleft()))
+    while pending:
+        add_rows(_batch_to_chunk(pending.popleft()))
+
+    for i in range(n_buckets):
+        if bucket_rows[i] == 0:
+            continue
+        compact_bucket(i)
+        yield buckets[i][0]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,579 @@
+"""Streamed (out-of-core) execution of planned StageGraphs.
+
+VERDICT r2's top gap: the OOC engine (exec/ooc.py) and the query layer
+were two separate worlds — a plain Dataset query on >HBM data died with a
+CapacityError while the streaming machinery sat unused behind a side API.
+This module fuses them: a query whose source declares streaming
+(``ctx.read_store_stream`` / ``read_text_stream`` / ``from_stream``, or a
+``JobConfig.ooc_auto_stream_rows`` threshold) is planned with ONE logical
+partition (plan_query(root, 1) — the planner's single-partition lowering
+already removes every exchange) and the resulting stage DAG is executed
+over ChunkSources instead of device-resident PData:
+
+* runs of row-local ops fuse into one jitted chunk program, double-
+  buffered through the device with per-chunk measured-need retries
+  (the transparent bounded-memory channel of the reference:
+  channelbuffernativewriter.cpp / channelbufferqueue.cpp:777 — a query
+  never cares whether its data fits in RAM);
+* ``sort`` lowers to ooc.external_sort, ``group`` to
+  ooc.streaming_group_aggregate, ``distinct`` to ooc.streaming_distinct;
+* a join/cross_apply materializes its RIGHT side (bounded by
+  JobConfig.ooc_join_build_rows) and streams the left side through it;
+* a stage consumed by several downstream legs spills to a temp store
+  once instead of recomputing per consumer (Tee materialization,
+  channel-file role).
+
+Device working set stays O(chunk_rows) regardless of total data size —
+the property that makes the 1 TB TeraSort north star (BASELINE.md
+config 2) a *framework* capability rather than a demo.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.data.columnar import Batch, StringColumn
+from dryad_tpu.exec import ooc
+from dryad_tpu.exec.ooc import (ChunkSource, HChunk, OOCError,
+                                _batch_to_chunk, _chunk_to_batch,
+                                _concat_hchunks, _slice_hchunk, chunk_schema)
+from dryad_tpu.ops import kernels
+from dryad_tpu.ops.text import lower_ascii, split_tokens
+from dryad_tpu.plan.stages import Stage, StageGraph, StageOp
+
+__all__ = ["StreamSource", "StreamExecutionError", "run_stream_graph",
+           "chunks_to_table"]
+
+
+class StreamExecutionError(RuntimeError):
+    pass
+
+
+class StreamSource:
+    """Planner-visible streaming source: wraps a ChunkSource and exposes
+    ``.capacity`` (= chunk rows) the way PData does."""
+
+    def __init__(self, cs: ChunkSource):
+        self.cs = cs
+
+    @property
+    def capacity(self) -> int:
+        return self.cs.chunk_rows
+
+
+# op kinds that are chunk-local (fuse into one jitted chunk program)
+_LOCAL_KINDS = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
+                "apply", "recap"}
+# op kinds with whole-stream semantics, each lowered to an ooc primitive
+_STREAM_KINDS = {"sort", "group", "distinct", "take", "skip", "row_index"}
+
+_UNSUPPORTED_HINTS = {
+    "zip": "zip_with needs global row alignment",
+    "sliding_window": "sliding_window needs cross-chunk halos",
+    "take_while": "take_while/skip_while are not yet streamed",
+    "skip_while": "take_while/skip_while are not yet streamed",
+    "dgroup_local": "user Decomposable aggregates are not yet streamed — "
+                    "use builtin aggregate kinds",
+    "group_apply": "group_apply is not yet streamed — use group_by "
+                   "aggregates or the in-memory path",
+    "group_top_k": "group_top_k is not yet streamed",
+    "group_rank": "group_median/rank is not yet streamed",
+}
+
+
+def _unsupported(kind: str) -> StreamExecutionError:
+    hint = _UNSUPPORTED_HINTS.get(kind, "")
+    return StreamExecutionError(
+        f"op {kind!r} is not supported in streamed (out-of-core) "
+        f"execution{': ' + hint if hint else ''}")
+
+
+# ---------------------------------------------------------------------------
+# fused local chunk programs (with measured-need retry per chunk)
+
+_LOCAL_UNSCALABLE = 1 << 30
+
+
+def _local_op(b: Batch, op: StageOp, scale: int):
+    """One chunk-local op; returns (batch, need_scale) where need_scale is
+    0 (fits), the scale a retry needs, or _LOCAL_UNSCALABLE."""
+    k, p = op.kind, op.params
+    no = jnp.zeros((), jnp.int32)
+    if k == "fn":
+        return Batch(dict(p["fn"](dict(b.columns))), b.count), no
+    if k == "filter":
+        return kernels.compact(b, p["fn"](dict(b.columns))), no
+    if k == "mean_fin":
+        return Batch(kernels.mean_finalize_columns(dict(b.columns),
+                                                   p["cols"]), b.count), no
+    if k == "flat_tokens":
+        out, need_rows = split_tokens(b, p["column"],
+                                      out_capacity=p["out_capacity"] * scale,
+                                      max_token_len=p["max_token_len"],
+                                      delims=p["delims"])
+        if p["lower"]:
+            col = out.columns[p["column"]]
+            out = Batch({p["column"]: lower_ascii(col)}, out.count)
+        need = -(-need_rows // jnp.int32(p["out_capacity"]))
+        return out, need.astype(jnp.int32)
+    if k == "flat_map":
+        out, need_rows = kernels.flat_map_expand(b, p["fn"],
+                                                 p["out_capacity"] * scale)
+        need = -(-need_rows // jnp.int32(p["out_capacity"]))
+        return out, need.astype(jnp.int32)
+    if k == "apply":
+        if p.get("with_index"):
+            raise _unsupported("apply_with_partition_index")
+        # per-CHUNK apply (streamed data has no fixed partition identity)
+        return p["fn"](b), no
+    if k == "recap":
+        cap = p["capacity"]
+        if cap >= b.capacity:
+            return b.pad_to(cap), no
+        trunc = jax.tree.map(lambda x: x[:cap] if x.ndim else x, b)
+        return (trunc.with_count(jnp.minimum(b.count, cap)),
+                jnp.where(b.count > cap, _LOCAL_UNSCALABLE, 0
+                          ).astype(jnp.int32))
+    raise _unsupported(k)
+
+
+def _ops_out_capacity(in_cap: int, ops: List[StageOp]) -> int:
+    cap = in_cap
+    for op in ops:
+        if op.kind in ("flat_tokens", "flat_map"):
+            cap = op.params["out_capacity"]
+        elif op.kind == "recap":
+            cap = op.params["capacity"]
+    return cap
+
+
+def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
+                  extra_right: Optional[Batch] = None,
+                  body_op: Optional[StageOp] = None) -> ChunkSource:
+    """Fuse a run of chunk-local ops (plus an optional binary body op with
+    a materialized right side) into one jitted program and stream chunks
+    through it, double-buffered, with per-chunk right-sized retries."""
+    chunk_rows = cs.chunk_rows
+    depth = config.ooc_inflight
+    fns: Dict[int, Any] = {}
+
+    def build(scale: int):
+        # the (possibly large) build side rides as a jit ARGUMENT — a
+        # closure would embed it into the program as an XLA constant and
+        # re-embed it per retry scale
+        def f(b: Batch, right: Optional[Batch]):
+            need_all = jnp.zeros((), jnp.int32)
+            for op in ops:
+                b, need = _local_op(b, op, scale)
+                need_all = jnp.maximum(need_all, need)
+            if body_op is not None:
+                b, need = _body_binary(b, right, body_op, scale)
+                need_all = jnp.maximum(need_all, need)
+            return b, need_all
+        return jax.jit(f)
+
+    # probe the output schema with one empty chunk (traced eagerly)
+    probe_b, _ = build(1)(_chunk_to_batch(HChunk.empty_like(cs.schema), 1),
+                          extra_right)
+    out_schema = chunk_schema(_batch_to_chunk(probe_b))
+    out_cap = _ops_out_capacity(chunk_rows, ops)
+    if body_op is not None and body_op.kind == "join":
+        out_cap = body_op.params["out_capacity"]
+
+    def run_one(chunk: HChunk) -> Iterator[HChunk]:
+        scale = 1
+        fn = fns.setdefault(1, build(1))
+        out, need = fn(_chunk_to_batch(chunk, chunk_rows), extra_right)
+        need_i = int(need)
+        while need_i > 0:
+            if need_i >= _LOCAL_UNSCALABLE:
+                raise OOCError(
+                    "a fixed-capacity op (with_capacity) overflowed in "
+                    "streamed execution; raise the declared capacity")
+            scale = max(scale + 1, need_i)
+            fn = fns.setdefault(scale, build(scale))
+            out, need = fn(_chunk_to_batch(chunk, chunk_rows), extra_right)
+            need_i = int(need)
+        oc = _batch_to_chunk(out)
+        # slice oversized outputs so downstream chunk programs keep their
+        # static capacity (out_cap is the declared per-chunk bound)
+        for s in range(0, max(oc.n, 1), out_cap):
+            e = min(s + out_cap, oc.n)
+            if e > s or oc.n == 0:
+                yield _slice_hchunk(oc, s, e)
+            if oc.n == 0:
+                return
+
+    def it():
+        pending: deque = deque()
+        for chunk in cs:
+            pending.append(chunk)
+            if len(pending) >= depth:
+                yield from run_one(pending.popleft())
+        while pending:
+            yield from run_one(pending.popleft())
+
+    return ChunkSource(it, out_schema, out_cap)
+
+
+# ---------------------------------------------------------------------------
+# binary body ops (right side materialized)
+
+
+def _body_binary(left: Batch, right: Batch, op: StageOp, scale: int):
+    k, p = op.kind, op.params
+    no = jnp.zeros((), jnp.int32)
+    if k == "join":
+        how = p.get("how", "inner")
+        if how not in ("inner", "left"):
+            raise StreamExecutionError(
+                f"streamed join supports how=inner/left (got {how!r}): "
+                f"right/full must track unmatched right rows across the "
+                f"whole stream")
+        out, need_rows = kernels.hash_join(
+            left, right, list(p["left_keys"]), list(p["right_keys"]),
+            out_capacity=p["out_capacity"] * scale, how=how)
+        need = -(-need_rows // jnp.int32(p["out_capacity"]))
+        return out, need.astype(jnp.int32)
+    if k == "apply2":
+        return p["fn"](left, right), no
+    if k == "semi_anti":
+        return kernels.semi_anti_join(
+            left, right, sorted(left.names), sorted(right.names),
+            anti=p["anti"]), no
+    raise _unsupported(k)
+
+
+def _materialize_small(cs: ChunkSource, config, what: str) -> Batch:
+    """Concatenate a (small) chunk stream into ONE device batch — the
+    build side of streamed joins.  Bounded by ooc_join_build_rows."""
+    frags = [c for c in cs if c.n]
+    total = sum(f.n for f in frags)
+    limit = config.ooc_join_build_rows
+    if total > limit:
+        raise StreamExecutionError(
+            f"the {what} side of a streamed binary op holds {total} rows "
+            f"> JobConfig.ooc_join_build_rows={limit}; streamed joins "
+            f"materialize that side on device — shrink it (pre-aggregate/"
+            f"filter) or raise the knob")
+    merged = _concat_hchunks(cs.schema, frags)
+    return _chunk_to_batch(merged, max(total, 1))
+
+
+# ---------------------------------------------------------------------------
+# whole-stream ops
+
+
+def _stream_global(cs: ChunkSource, op: StageOp, config,
+                   spill_dir: Optional[str]) -> ChunkSource:
+    k, p = op.kind, op.params
+    if k == "sort":
+        keys = tuple(p["keys"])
+
+        def it_sort():
+            return ooc.external_sort(cs, list(keys),
+                                     spill_dir=_fresh_spill(spill_dir),
+                                     depth=config.ooc_inflight)
+
+        return ChunkSource(it_sort, cs.schema, cs.chunk_rows)
+    if k == "group":
+        keys = list(p["keys"])
+        aggs = dict(p["aggs"])
+        for spec in aggs.values():
+            if not (isinstance(spec, tuple) and len(spec) == 2):
+                raise _unsupported("dgroup_local")
+        probe = _batch_to_chunk(jax.jit(
+            lambda b: kernels.group_aggregate(b, keys, aggs))(
+                _chunk_to_batch(HChunk.empty_like(cs.schema), 1)))
+        schema = chunk_schema(probe)
+
+        def it_group():
+            return ooc.streaming_group_aggregate(
+                cs, keys, aggs, n_buckets=config.ooc_hash_buckets,
+                depth=config.ooc_inflight)
+
+        return ChunkSource(it_group, schema, cs.chunk_rows)
+    if k == "distinct":
+        keys = tuple(p["keys"])
+
+        def it_dist():
+            return ooc.streaming_distinct(
+                cs, keys, n_buckets=config.ooc_hash_buckets,
+                depth=config.ooc_inflight)
+
+        return ChunkSource(it_dist, cs.schema, cs.chunk_rows)
+    if k == "take":
+        n = p["n"]
+
+        def it_take():
+            left = n
+            for chunk in cs:
+                if left <= 0:
+                    return
+                if chunk.n <= left:
+                    left -= chunk.n
+                    yield chunk
+                else:
+                    yield _slice_hchunk(chunk, 0, left)
+                    return
+
+        return ChunkSource(it_take, cs.schema, cs.chunk_rows)
+    if k == "skip":
+        n = p["n"]
+
+        def it_skip():
+            left = n
+            for chunk in cs:
+                if left >= chunk.n:
+                    left -= chunk.n
+                    continue
+                if left > 0:
+                    yield _slice_hchunk(chunk, left, chunk.n)
+                    left = 0
+                else:
+                    yield chunk
+
+        return ChunkSource(it_skip, cs.schema, cs.chunk_rows)
+    if k == "row_index":
+        col = p["column"]
+        schema = dict(cs.schema)
+        # int64: the streamed engine targets row counts past 2**31 (the
+        # in-memory path's int32 cannot hold such data in HBM anyway)
+        schema[col] = {"kind": "dense", "dtype": "int64", "shape": []}
+
+        def it_idx():
+            off = 0
+            for chunk in cs:
+                cols = dict(chunk.cols)
+                cols[col] = np.arange(off, off + chunk.n, dtype=np.int64)
+                off += chunk.n
+                yield HChunk(cols, chunk.n)
+
+        return ChunkSource(it_idx, schema, cs.chunk_rows)
+    raise _unsupported(k)
+
+
+# ---------------------------------------------------------------------------
+# graph execution
+
+
+def _fresh_spill(spill_dir: Optional[str]) -> Optional[str]:
+    if spill_dir is None:
+        return None
+    return tempfile.mkdtemp(prefix="sort-", dir=spill_dir)
+
+
+def _concat_sources(a: ChunkSource, b: ChunkSource) -> ChunkSource:
+    # full schema equality (dtypes/str widths, not just names): mixed
+    # widths would crash _concat_hchunks deep inside a downstream sort
+    if a.schema != b.schema:
+        raise StreamExecutionError(
+            f"concat schema mismatch (columns must agree in dtype and "
+            f"string max_len): {a.schema} vs {b.schema}")
+
+    def it():
+        yield from a
+        yield from b
+
+    return ChunkSource(it, a.schema, max(a.chunk_rows, b.chunk_rows))
+
+
+def _spill_stage(cs: ChunkSource, job_root: str, label: str) -> ChunkSource:
+    """Materialize a multi-consumer stage once (Tee; the reference's
+    materialized channel files, DrTeeVertex role).  Lives under the job's
+    temp root, removed when the job's output stream finishes."""
+    path = tempfile.mkdtemp(prefix=f"tee-{label}-", dir=job_root)
+    target = os.path.join(path, "data")
+    ooc.write_chunks_to_store(target, iter(cs), cs.schema)
+    return ChunkSource.from_store(target, cs.chunk_rows)
+
+
+def _resolve_source(data: Any, config) -> ChunkSource:
+    if isinstance(data, StreamSource):
+        return data.cs
+    if isinstance(data, ChunkSource):
+        return data
+    # a device-resident (or deferred host) source mixed into a streamed
+    # query: pull to host and slice into chunks
+    from dryad_tpu.exec.data import PData, pdata_to_host
+    if isinstance(data, PData):
+        return ChunkSource.from_arrays(pdata_to_host(data),
+                                       config.ooc_chunk_rows)
+    raise StreamExecutionError(
+        f"cannot stream source of type {type(data).__name__} (cluster "
+        f"deferred sources stream via the worker path)")
+
+
+def _split_leg_ops(ops: List[StageOp]) -> List[Tuple[str, Any]]:
+    """[(kind, payload)] where kind is "local" (list of ops) or "global"
+    (one op)."""
+    out: List[Tuple[str, Any]] = []
+    run: List[StageOp] = []
+    for op in ops:
+        if op.kind in _LOCAL_KINDS:
+            run.append(op)
+            continue
+        if run:
+            out.append(("local", run))
+            run = []
+        if op.kind in _STREAM_KINDS:
+            out.append(("global", op))
+        else:
+            raise _unsupported(op.kind)
+    if run:
+        out.append(("local", run))
+    return out
+
+
+def run_stream_graph(graph: StageGraph, config,
+                     spill_dir: Optional[str] = None,
+                     event_log=None) -> ChunkSource:
+    """Execute a single-partition StageGraph over chunk streams; returns
+    the output stage's ChunkSource.
+
+    The result is SINGLE-DRAIN: all temp state (Tee spills, sort spill
+    buckets) lives under one job directory that is removed when the
+    returned stream finishes (or is closed early by take()) — a
+    long-running process querying >HBM data must not accumulate
+    dataset-sized directories."""
+    ev = event_log or (lambda e: None)
+    job_root = tempfile.mkdtemp(prefix="dryad-stream-", dir=spill_dir)
+    # sort bucket spill only when the caller opted into disk spill;
+    # otherwise sorts keep buckets in host RAM (faster)
+    sort_spill = job_root if spill_dir is not None else None
+    consumers: Dict[int, int] = {}
+    for st in graph.stages:
+        for sid in st.input_stage_ids():
+            consumers[sid] = consumers.get(sid, 0) + 1
+
+    results: Dict[int, ChunkSource] = {}
+    for st in graph.topo_order():
+        legs_cs: List[ChunkSource] = []
+        for leg in st.legs:
+            if leg.exchange is not None:
+                raise StreamExecutionError(
+                    "streamed plans must be planned with npartitions=1 "
+                    "(found an exchange)")
+            if isinstance(leg.src, int):
+                cs = results[leg.src]
+            elif leg.src[0] == "source":
+                cs = _resolve_source(leg.src[1], config)
+            else:
+                raise StreamExecutionError(
+                    "placeholders (do_while bodies) are not yet streamed")
+            for kind, payload in _split_leg_ops(list(leg.ops)):
+                if kind == "local":
+                    cs = _stream_local(cs, payload, config)
+                else:
+                    cs = _stream_global(cs, payload, config, sort_spill)
+            legs_cs.append(cs)
+
+        cur = legs_cs[0]
+        rest = legs_cs[1:]
+        for op in st.body:
+            if op.kind in ("join", "apply2", "semi_anti"):
+                right = _materialize_small(rest.pop(0), config,
+                                           "right/build")
+                cur = _stream_local(cur, [], config, extra_right=right,
+                                    body_op=op)
+            elif op.kind == "concat":
+                cur = _concat_sources(cur, rest.pop(0))
+            elif op.kind in _STREAM_KINDS:
+                cur = _stream_global(cur, op, config, sort_spill)
+            elif op.kind in _LOCAL_KINDS:
+                cur = _stream_local(cur, [op], config)
+            else:
+                raise _unsupported(op.kind)
+
+        if consumers.get(st.id, 0) > 1:
+            cur = _spill_stage(cur, job_root, st.label or str(st.id))
+            ev({"event": "stream_tee_spill", "stage": st.id,
+                "label": st.label})
+        results[st.id] = cur
+
+    out = results[graph.out_stage]
+
+    def final_it():
+        import shutil
+        try:
+            yield from out
+        finally:
+            shutil.rmtree(job_root, ignore_errors=True)
+
+    return ChunkSource(final_it, out.schema, out.chunk_rows)
+
+
+# ---------------------------------------------------------------------------
+# terminal helpers
+
+
+def chunks_to_table(cs: ChunkSource) -> Dict[str, Any]:
+    """Drain a chunk stream to a host table (collect terminal).  String
+    columns become lists of bytes, matching exec.data.pdata_to_host."""
+    from dryad_tpu import native
+
+    frags = [c for c in cs if c.n]
+    out: Dict[str, Any] = {}
+    for k, spec in cs.schema.items():
+        if spec["kind"] == "str":
+            vals: List[bytes] = []
+            for f in frags:
+                d, l = f.cols[k]
+                vals.extend(native.unpack_rows(np.ascontiguousarray(d),
+                                               np.ascontiguousarray(l)))
+            out[k] = vals
+        else:
+            out[k] = (np.concatenate([f.cols[k] for f in frags])
+                      if frags else
+                      np.zeros((0,) + tuple(spec.get("shape", ())),
+                               np.dtype(spec["dtype"])))
+    return out
+
+
+def stream_scalar(cs: ChunkSource, kind: str, column: str):
+    """Scalar terminal over a chunk stream: per-chunk host reductions
+    combined incrementally (sum/min/max/mean/any/all)."""
+    total = 0
+    acc = None
+    cnt = 0
+    for chunk in cs:
+        if chunk.n == 0:
+            continue
+        v = chunk.cols[column]
+        if isinstance(v, tuple):
+            raise StreamExecutionError(
+                f"scalar aggregate over string column {column!r}")
+        total += chunk.n
+        if kind in ("sum", "mean"):
+            s = v.sum(axis=0)
+            acc = s if acc is None else acc + s
+            cnt += chunk.n
+        elif kind == "min":
+            m = v.min(axis=0)
+            acc = m if acc is None else np.minimum(acc, m)
+        elif kind == "max":
+            m = v.max(axis=0)
+            acc = m if acc is None else np.maximum(acc, m)
+        elif kind == "any":
+            acc = bool(acc) or bool(np.any(v))
+        elif kind == "all":
+            acc = (True if acc is None else bool(acc)) and bool(np.all(v))
+        else:
+            raise ValueError(kind)
+    if kind == "mean":
+        return None if not cnt else acc / cnt
+    if kind == "any":
+        return bool(acc)
+    if kind == "all":
+        return True if acc is None else bool(acc)
+    if kind == "sum" and acc is None:
+        return 0  # in-memory parity: sum over an empty dataset is 0
+    return acc

@@ -62,13 +62,18 @@ class JobConfig:
     # verify fnv64 partition checksums on read (fingerprint.cpp role)
     store_verify_checksums: bool = True
 
-    # -- out-of-core streaming (exec/ooc.py) -------------------------------
+    # -- out-of-core streaming (exec/ooc.py, exec/stream_exec.py) ----------
     # default chunk size for ChunkSource constructors
     ooc_chunk_rows: int = 1 << 16
     # default scatter fan-out for streaming_group_aggregate
     ooc_hash_buckets: int = 64
     # in-flight device batches for the double-buffered stream (depth)
     ooc_inflight: int = 2
+    # from_store switches to streamed execution when the store holds at
+    # least this many rows (0 = off); read_store_stream always streams
+    ooc_auto_stream_rows: int = 0
+    # max rows the materialized build side of a streamed join may hold
+    ooc_join_build_rows: int = 1 << 18
 
     # -- cluster runtime (runtime/cluster.py) ------------------------------
     cluster_processes: int = 2
@@ -121,6 +126,8 @@ class JobConfig:
             (self.ooc_chunk_rows >= 1, "ooc_chunk_rows >= 1"),
             (self.ooc_hash_buckets >= 1, "ooc_hash_buckets >= 1"),
             (self.ooc_inflight >= 1, "ooc_inflight >= 1"),
+            (self.ooc_auto_stream_rows >= 0, "ooc_auto_stream_rows >= 0"),
+            (self.ooc_join_build_rows >= 1, "ooc_join_build_rows >= 1"),
             (self.cluster_processes >= 1, "cluster_processes >= 1"),
             (self.cluster_devices_per_process >= 1,
              "cluster_devices_per_process >= 1"),
