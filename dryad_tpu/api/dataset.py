@@ -180,9 +180,18 @@ class Context:
         return Dataset(self, node)
 
     def read_store_stream(self, path: str,
-                          chunk_rows: int | None = None) -> "Dataset":
+                          chunk_rows: int | None = None):
         """Stream a persisted store through the plain Dataset API —
-        the >HBM path (1 TB TeraSort north star, BASELINE.md config 2)."""
+        the >HBM path (1 TB TeraSort north star, BASELINE.md config 2).
+
+        On a cluster Context this returns a ClusterStream: every worker
+        streams its own store-partition subset and the gang runs chunk-
+        wave exchanges over the mesh (runtime/stream_cluster.py) — a
+        restricted surface (chunk-local ops + sort/group/count)."""
+        if self.cluster is not None:
+            from dryad_tpu.runtime.stream_cluster import ClusterStream
+            return ClusterStream(self, path,
+                                 chunk_rows or self.config.ooc_chunk_rows)
         from dryad_tpu.exec.ooc import ChunkSource
         cs = ChunkSource.from_store(
             path, chunk_rows or self.config.ooc_chunk_rows)
@@ -646,10 +655,17 @@ class Dataset:
             t = self.ctx._cluster_run(self.node)
             return self.ctx.from_columns(t)
         if self._streaming():
-            # materialize once to a temp store, stream reads from there
+            # materialize once to a temp store, stream reads from there;
+            # the dir lives as long as the Context (weakref finalizer
+            # removes it at Context GC / interpreter exit — no unbounded
+            # dataset-sized leak)
+            import shutil
             import tempfile
+            import weakref
             d = tempfile.mkdtemp(prefix="dryad-cache-",
                                  dir=self.ctx.spill_dir)
+            weakref.finalize(self.ctx, shutil.rmtree, d,
+                             ignore_errors=True)
             target = d + "/data"
             self.to_store(target)
             return self.ctx.read_store_stream(target)

@@ -228,19 +228,25 @@ class ChunkSource:
         return ChunkSource(it, schema, chunk_rows)
 
     @staticmethod
-    def from_store(path: str, chunk_rows: int) -> "ChunkSource":
+    def from_store(path: str, chunk_rows: int,
+                   partitions: Optional[Sequence[int]] = None
+                   ) -> "ChunkSource":
         """Stream a persisted store (io/store.py layout) partition by
         partition, slicing each into chunks.  Individual partitions must fit
-        host RAM; the dataset as a whole need not."""
+        host RAM; the dataset as a whole need not.  ``partitions`` restricts
+        to the listed store partitions (the per-worker subset of a cluster
+        streamed job)."""
         from dryad_tpu.io.store import (_alloc_part_views, _part_path,
                                         store_meta, verify_checksums)
         from dryad_tpu import native
 
         meta = store_meta(path)
         schema = meta["schema"]
+        part_ids = (list(range(meta["npartitions"]))
+                    if partitions is None else list(partitions))
 
         def it():
-            for p in range(meta["npartitions"]):
+            for p in part_ids:
                 cnt = meta["counts"][p]
                 segs, cols = _alloc_part_views(schema, cnt)
                 native.read_files(

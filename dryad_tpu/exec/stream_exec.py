@@ -180,18 +180,32 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
             return b, need_all
         return jax.jit(f)
 
-    # probe the output schema with one empty chunk (traced eagerly)
-    probe_b, _ = build(1)(_chunk_to_batch(HChunk.empty_like(cs.schema), 1),
-                          extra_right)
+    # probe the output schema with one empty chunk (the probe program IS
+    # the scale-1 program — cache it)
+    fns[1] = build(1)
+    probe_b, _ = fns[1](_chunk_to_batch(HChunk.empty_like(cs.schema), 1),
+                        extra_right)
     out_schema = chunk_schema(_batch_to_chunk(probe_b))
     out_cap = _ops_out_capacity(chunk_rows, ops)
     if body_op is not None and body_op.kind == "join":
         out_cap = body_op.params["out_capacity"]
 
-    def run_one(chunk: HChunk) -> Iterator[HChunk]:
+    def _fn_for(scale: int):
+        fn = fns.get(scale)
+        if fn is None:
+            fn = fns[scale] = build(scale)
+        return fn
+
+    def launch(chunk: HChunk):
+        # dispatch device work NOW — jax async dispatch overlaps this
+        # chunk's H2D + compute with the previous chunk's host drain (the
+        # double-buffered channel pipeline, channelbufferqueue role)
+        return chunk, _fn_for(1)(_chunk_to_batch(chunk, chunk_rows),
+                                 extra_right)
+
+    def drain(entry) -> Iterator[HChunk]:
+        chunk, (out, need) = entry
         scale = 1
-        fn = fns.setdefault(1, build(1))
-        out, need = fn(_chunk_to_batch(chunk, chunk_rows), extra_right)
         need_i = int(need)
         while need_i > 0:
             if need_i >= _LOCAL_UNSCALABLE:
@@ -199,8 +213,8 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
                     "a fixed-capacity op (with_capacity) overflowed in "
                     "streamed execution; raise the declared capacity")
             scale = max(scale + 1, need_i)
-            fn = fns.setdefault(scale, build(scale))
-            out, need = fn(_chunk_to_batch(chunk, chunk_rows), extra_right)
+            out, need = _fn_for(scale)(
+                _chunk_to_batch(chunk, chunk_rows), extra_right)
             need_i = int(need)
         oc = _batch_to_chunk(out)
         # slice oversized outputs so downstream chunk programs keep their
@@ -215,11 +229,11 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
     def it():
         pending: deque = deque()
         for chunk in cs:
-            pending.append(chunk)
+            pending.append(launch(chunk))
             if len(pending) >= depth:
-                yield from run_one(pending.popleft())
+                yield from drain(pending.popleft())
         while pending:
-            yield from run_one(pending.popleft())
+            yield from drain(pending.popleft())
 
     return ChunkSource(it, out_schema, out_cap)
 
@@ -316,11 +330,11 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
         def it_take():
             left = n
             for chunk in cs:
-                if left <= 0:
-                    return
                 if chunk.n <= left:
                     left -= chunk.n
                     yield chunk
+                    if left == 0:
+                        return  # stop BEFORE pulling another chunk
                 else:
                     yield _slice_hchunk(chunk, 0, left)
                     return

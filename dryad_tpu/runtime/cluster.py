@@ -342,6 +342,19 @@ class LocalCluster:
             protocol.send_msg(s, msg)
             s.setblocking(False)
 
+        replies = self._gather_job_replies(job, timeout, "job")
+
+        if self.event_log is not None and 0 in replies:
+            for e in replies[0].get("events", []):
+                self.event_log(dict(e, worker=0))
+        return replies.get(0, {}).get("table")
+
+    def _gather_job_replies(self, job: int, timeout: float,
+                            what: str) -> Dict[int, dict]:
+        """Collect one reply per worker for ``job`` (shared by execute and
+        execute_stream).  On any error reply, stragglers get a 5s grace
+        drain (so co-errors reach the diagnosis) and the gang is torn
+        down; on success every worker's reply is returned."""
         replies: Dict[int, dict] = {}
         pending = set(self._socks)
         deadline = time.time() + timeout
@@ -349,12 +362,9 @@ class LocalCluster:
             if time.time() > deadline:
                 self._kill_all()
                 raise WorkerFailure(
-                    f"job timed out after {timeout}s; workers "
+                    f"{what} timed out after {timeout}s; workers "
                     f"{sorted(pending)} never replied" + self._log_tails())
-            try:
-                self._check_deaths()
-            except WorkerFailure:
-                raise
+            self._check_deaths()
             socks = {self._socks[pid]: pid for pid in pending}
             ready, _, _ = select.select(list(socks), [], [], 0.25)
             for s in ready:
@@ -364,7 +374,7 @@ class LocalCluster:
                     self._kill_all()
                     raise WorkerFailure(
                         f"worker {pid} closed its control connection "
-                        f"mid-job" + self._log_tails())
+                        f"mid-{what}" + self._log_tails())
                 for reply in frames:
                     replies[pid] = reply
                     pending.discard(pid)
@@ -395,13 +405,29 @@ class LocalCluster:
             self._kill_all()  # gang state is unknown after an error
             first = min(errs)
             raise ClusterJobError(
-                f"job failed on worker(s) {sorted(errs)}; worker {first} "
-                f"error:\n{errs[first]}")
+                f"{what} failed on worker(s) {sorted(errs)}; worker "
+                f"{first} error:\n{errs[first]}")
+        return replies
 
-        if self.event_log is not None and 0 in replies:
-            for e in replies[0].get("events", []):
-                self.event_log(dict(e, worker=0))
-        return replies.get(0, {}).get("table")
+
+    def execute_stream(self, spec_json: str, plan_json: str,
+                       config=None, timeout: float = 600.0
+                       ) -> Dict[int, dict]:
+        """Submit one streamed (out-of-core) SPMD job; returns EVERY
+        worker's result payload keyed by pid (streamed collects return
+        per-worker table parts — the driver concatenates them, instead of
+        funneling all rows through worker 0)."""
+        if not self.alive():
+            self.restart()
+        job = self.next_job_id()
+        msg = {"cmd": "run_stream", "spec": spec_json, "plan": plan_json,
+               "job": job, "config": config}
+        for s in self._socks.values():
+            s.setblocking(True)
+            protocol.send_msg(s, msg)
+            s.setblocking(False)
+        replies = self._gather_job_replies(job, timeout, "stream job")
+        return {pid: r.get("result") for pid, r in replies.items()}
 
 
 def _try_decode(buf: bytearray):

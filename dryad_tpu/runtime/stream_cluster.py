@@ -1,0 +1,738 @@
+"""Streamed (out-of-core) jobs over the multi-process worker gang.
+
+VERDICT r2 item 2: compose the per-host OOC chunk streams with the sharded
+exchanges.  Every worker streams ITS OWN subset of the store's partitions
+in fixed-capacity chunks; the gang advances in lockstep through chunk
+WAVES, each wave running ONE jitted shard_map exchange over the full
+(dcn, dp) mesh (partial-aggregate-then-hash for group-by, sampled range
+scatter for sort); received rows spill into per-device host bucket stores
+between waves; after the last wave each worker finishes its buckets
+locally (recursive external sort / aggregate merge) and writes its own
+output partitions in parallel — process 0 only merges the metadata.
+
+This is the reference's architecture made SPMD: every vertex
+simultaneously streams disk channels AND participates in the cross-machine
+shuffle (SURVEY.md §2.8), with device working set O(chunk_rows) per chip
+regardless of total data size — the 1 TB TeraSort north star shape
+(BASELINE.md config 2) on a real pod.
+
+Mirrored determinism contract (runtime/exec_common.py): all processes
+derive the same wave count, the same range bounds, and the same retry
+decisions (exchange needs are pmax'd across the mesh inside the program),
+so the only cross-process coupling is the collectives themselves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dryad_tpu.plan.stages import StageOp
+
+__all__ = ["build_stream_spec", "execute_stream_job", "StreamJobError"]
+
+_SAMPLES_PER_CHUNK = 512
+_MAX_SAMPLES = 8192
+
+
+class StreamJobError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# spec building (driver side)
+
+
+def build_stream_spec(path: str, chunk_rows: int, ops: List[StageOp],
+                      terminal: Dict[str, Any],
+                      fn_table: Optional[Dict[str, Any]] = None
+                      ) -> Tuple[str, str]:
+    """Serialize a streamed cluster job: (spec_json, fake_plan_json for
+    worker fn-table resolution).  Ops must be chunk-local (the shuffle is
+    the terminal's wave exchange, not a plan exchange)."""
+    from dryad_tpu.plan.serialize import _op_to_json
+    from dryad_tpu.plan.stages import Stage, StageGraph
+    from dryad_tpu.runtime.shiplan import _collect_refs
+
+    graph = StageGraph([Stage(id=0, legs=[], body=list(ops))], 0)
+    user_names = {id(v): k for k, v in (fn_table or {}).items()}
+    fn_names = _collect_refs(graph, user_names)
+    shared: Dict[int, int] = {}
+    ops_json = [_op_to_json(o, fn_names, shared) for o in ops]
+    plan_json = json.dumps({"version": 1, "stages": [
+        {"id": 0, "label": "stream", "legs": [], "body": ops_json}],
+        "out_stage": 0})
+    spec = {"source": {"path": path, "chunk_rows": chunk_rows},
+            "ops": ops_json, "terminal": terminal}
+    return json.dumps(spec), plan_json
+
+
+# ---------------------------------------------------------------------------
+# driver-side lazy wrapper
+
+
+class ClusterStream:
+    """Streamed dataset over a cluster Context — the restricted surface
+    that composes per-worker chunk streams with mesh exchanges.  Chunk-
+    local operators (select/where/split_words/flat_map) accumulate; the
+    terminals (count, order_by().to_store(), group_by().collect()/
+    .to_store()) submit ONE streamed SPMD job to the gang.  UDFs must be
+    importable or fn_table-registered, as with any cluster plan."""
+
+    def __init__(self, ctx, path: str, chunk_rows: int,
+                 ops: Optional[List[StageOp]] = None):
+        self._ctx = ctx
+        self._path = path
+        self._chunk_rows = chunk_rows
+        self._ops = list(ops or [])
+
+    def _with(self, op: StageOp) -> "ClusterStream":
+        return ClusterStream(self._ctx, self._path, self._chunk_rows,
+                             self._ops + [op])
+
+    def select(self, fn, label: str = "select") -> "ClusterStream":
+        return self._with(StageOp("fn", {"fn": fn, "label": label}))
+
+    def where(self, fn, label: str = "where") -> "ClusterStream":
+        return self._with(StageOp("filter", {"fn": fn, "label": label}))
+
+    def split_words(self, column: str, out_capacity: int,
+                    max_token_len: int | None = None,
+                    delims: bytes | None = None,
+                    lower: bool = False) -> "ClusterStream":
+        cfg = self._ctx.config
+        return self._with(StageOp("flat_tokens", {
+            "column": column, "out_capacity": out_capacity,
+            "max_token_len": max_token_len or cfg.token_max_len,
+            "delims": delims or cfg.token_delims, "lower": lower}))
+
+    def flat_map(self, fn, out_capacity: int,
+                 label: str = "flat_map") -> "ClusterStream":
+        return self._with(StageOp("flat_map", {
+            "fn": fn, "out_capacity": out_capacity, "label": label}))
+
+    # -- terminals ---------------------------------------------------------
+
+    def _submit(self, terminal: Dict[str, Any]) -> Dict[int, Any]:
+        spec_json, plan_json = build_stream_spec(
+            self._path, self._chunk_rows, self._ops, terminal,
+            self._ctx.fn_table)
+        return self._ctx.cluster.execute_stream(
+            spec_json, plan_json, config=self._ctx.config,
+            timeout=self._ctx.config.cluster_job_timeout_s)
+
+    def count(self) -> int:
+        parts = self._submit({"kind": "count"})
+        return sum(r["count"] for r in parts.values())
+
+    def order_by(self, keys) -> "_SortedClusterStream":
+        return _SortedClusterStream(self, [(k, bool(d)) for k, d in keys])
+
+    def group_by(self, keys, aggs) -> "_GroupedClusterStream":
+        for name, spec in aggs.items():
+            if not (isinstance(spec, tuple) and len(spec) == 2):
+                raise StreamJobError(
+                    f"streamed cluster group_by supports builtin "
+                    f"(kind, column) aggregates only (agg {name!r})")
+        return _GroupedClusterStream(self, list(keys),
+                                     {k: list(v) for k, v in aggs.items()})
+
+
+class _SortedClusterStream:
+    def __init__(self, base: ClusterStream, keys):
+        self._base = base
+        self._keys = keys
+
+    def to_store(self, path: str) -> None:
+        self._base._submit({"kind": "sort",
+                            "keys": [list(k) for k in self._keys],
+                            "out": path})
+
+
+class _GroupedClusterStream:
+    def __init__(self, base: ClusterStream, keys, aggs):
+        self._base = base
+        self._keys = keys
+        self._aggs = aggs
+
+    def to_store(self, path: str) -> None:
+        self._base._submit({"kind": "group", "keys": self._keys,
+                            "aggs": self._aggs, "out": path})
+
+    def collect(self) -> Dict[str, Any]:
+        parts = self._base._submit({"kind": "group", "keys": self._keys,
+                                    "aggs": self._aggs, "out": None})
+        tables = [parts[pid]["table_part"] for pid in sorted(parts)]
+        tables = [t for t in tables if t is not None]
+        out: Dict[str, Any] = {}
+        for t in tables:
+            for k, v in t.items():
+                if k not in out:
+                    out[k] = v
+                elif isinstance(v, list):
+                    out[k] = list(out[k]) + list(v)
+                else:
+                    out[k] = np.concatenate([out[k], v])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# host <-> mesh plumbing (worker side)
+
+
+def _host_allgather(arr: np.ndarray, mesh) -> np.ndarray:
+    """Per-process host array [k, ...] -> [nprocs, k, ...] everywhere.
+    Single collective over the dcn axis; nprocs=1 short-circuits."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nprocs = jax.process_count()
+    if nprocs == 1:
+        return arr[None]
+    from dryad_tpu.parallel.mesh import HOST_AXIS
+    gshape = (nprocs,) + arr.shape
+    sh = NamedSharding(mesh, P(HOST_AXIS))
+
+    def cb(idx):
+        return arr[None]
+
+    garr = jax.make_array_from_callback(gshape, sh, cb)
+    rep = jax.jit(lambda x: x,
+                  out_shardings=NamedSharding(mesh, P()))(garr)
+    return np.asarray(rep)
+
+
+def _split_local(chunk, schema, dpp: int, chunk_rows: int):
+    """Block-split one host chunk across the process's dpp local devices;
+    returns (cols [dpp, chunk_rows, ...] zero-padded, counts [dpp])."""
+    n = chunk.n if chunk is not None else 0
+    base, rem = divmod(n, dpp)
+    sizes = [base + (1 if d < rem else 0) for d in range(dpp)]
+    offs = np.cumsum([0] + sizes)
+    cols: Dict[str, Any] = {}
+    for k, spec in schema.items():
+        if spec["kind"] == "str":
+            L = spec["max_len"]
+            sd = np.zeros((dpp, chunk_rows, L), np.uint8)
+            sl = np.zeros((dpp, chunk_rows), np.int32)
+            if n:
+                d, l = chunk.cols[k]
+                for p in range(dpp):
+                    sd[p, :sizes[p]] = d[offs[p]:offs[p + 1]]
+                    sl[p, :sizes[p]] = l[offs[p]:offs[p + 1]]
+            cols[k] = (sd, sl)
+        else:
+            dt = np.dtype(spec["dtype"])
+            tail = tuple(spec.get("shape", ()))
+            sa = np.zeros((dpp, chunk_rows) + tail, dt)
+            if n:
+                v = chunk.cols[k]
+                for p in range(dpp):
+                    sa[p, :sizes[p]] = v[offs[p]:offs[p + 1]]
+            cols[k] = sa
+    return cols, np.asarray(sizes, np.int32)
+
+
+def _put_wave(chunk, schema, chunk_rows: int, mesh):
+    """Place one process-local chunk onto the GLOBAL mesh batch
+    [P_total, chunk_rows, ...]: each process fills only its own device
+    rows (make_array_from_callback touches addressable shards only)."""
+    import jax
+    from dryad_tpu.data.columnar import Batch, StringColumn
+    from dryad_tpu.parallel.mesh import batch_sharding
+
+    P_total = mesh.devices.size
+    nprocs = jax.process_count()
+    dpp = P_total // nprocs
+    start = jax.process_index() * dpp
+    local_cols, local_counts = _split_local(chunk, schema, dpp, chunk_rows)
+    sharding = batch_sharding(mesh)
+
+    def put(local):
+        gshape = (P_total,) + local.shape[1:]
+
+        def cb(idx):
+            s = idx[0]
+            return local[s.start - start: s.stop - start]
+
+        return jax.make_array_from_callback(gshape, sharding, cb)
+
+    cols: Dict[str, Any] = {}
+    for k, spec in schema.items():
+        if spec["kind"] == "str":
+            d, l = local_cols[k]
+            cols[k] = StringColumn(put(d), put(l))
+        else:
+            cols[k] = put(local_cols[k])
+    return Batch(cols, put(local_counts))
+
+
+def _read_local_shards(tree, start: int, dpp: int):
+    """Pull a mesh-sharded pytree's LOCAL partitions to host:
+    leaf [P, ...] -> np [dpp, ...] (this process's rows only)."""
+    import jax
+
+    def read(arr):
+        parts: List[Any] = [None] * dpp
+        for sh in arr.addressable_shards:
+            g = sh.index[0].start if isinstance(sh.index[0], slice) else 0
+            if start <= g < start + dpp:
+                parts[g - start] = np.asarray(sh.data)[0]
+        return np.stack(parts)
+
+    return jax.tree.map(read, tree)
+
+
+# ---------------------------------------------------------------------------
+# wave programs
+
+
+def _squeeze(b):
+    import jax
+    return jax.tree.map(lambda x: x[0], b)
+
+
+def _expand(b):
+    import jax
+    return jax.tree.map(lambda x: x[None], b)
+
+
+def _build_wave_fn(mesh, kind: str, params: Dict[str, Any], chunk_rows: int,
+                   scale: int, slack: int):
+    """One jitted shard_map program for a chunk wave: (optional local
+    partial aggregation) + global exchange.  Need channels are pmax'd by
+    the exchange itself, so every process reads identical retry info."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dryad_tpu.ops import kernels
+    from dryad_tpu.parallel import shuffle
+
+    axes = tuple(mesh.axis_names)
+    cap = chunk_rows * scale
+
+    def per_shard(batch, bounds):
+        b = _squeeze(batch)
+        if kind == "range":
+            out, nr, nsl = shuffle.range_exchange(
+                b, params["key"], bounds, cap,
+                descending=params["descending"], send_slack=slack,
+                axes=axes)
+        elif kind == "group":
+            pb = kernels.group_aggregate(b, params["keys"],
+                                         params["partial"])
+            out, nr, nsl = shuffle.hash_exchange(pb, params["keys"], cap,
+                                                 send_slack=slack,
+                                                 axes=axes)
+        else:
+            raise ValueError(kind)
+        need_scale = (-(-nr // jnp.int32(chunk_rows))).astype(jnp.int32)
+        info = jnp.stack([need_scale, jnp.asarray(nsl, jnp.int32),
+                          out.count.astype(jnp.int32)])
+        return _expand(out), info[None]
+
+    in_specs = (P(axes), P())
+    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                       out_specs=(P(axes), P(axes)), check_vma=False)
+    return jax.jit(fn)
+
+
+def _run_waves(cs, schema, mesh, kind: str, params: Dict[str, Any],
+               waves: int, chunk_rows: int, config, bounds_arr):
+    """Advance the gang through ``waves`` lockstep chunk waves; append each
+    wave's received rows to per-local-device bucket stores (compacting
+    group partials whenever a bucket exceeds the chunk capacity — the
+    streaming aggregation-tree role).  Returns (bucket store, its row
+    schema)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.exec import ooc
+    from dryad_tpu.ops import kernels
+
+    nprocs = jax.process_count()
+    dpp = mesh.devices.size // nprocs
+    start = jax.process_index() * dpp
+
+    # bucket store schema = the EXCHANGED row schema (partial rows for
+    # group) — probe with an empty chunk through the local part
+    compact_fn = None
+    if kind == "group":
+        probe = ooc._batch_to_chunk(jax.jit(
+            lambda b: kernels.group_aggregate(
+                b, params["keys"], params["partial"]))(
+            ooc._chunk_to_batch(ooc.HChunk.empty_like(schema), 1)))
+        out_schema = ooc.chunk_schema(probe)
+        # merging partials applies the FINAL (associative) agg kinds;
+        # mean finalization happens only at the end
+        compact_fn = jax.jit(lambda b: kernels.group_aggregate(
+            b, params["keys"], params["final"]))
+    else:
+        out_schema = schema
+
+    # sort buckets hold the worker's ENTIRE received key range across all
+    # waves — they must spill to disk (the host-side bucket spill of the
+    # composition contract), or a 1 TB sort OOMs every worker.  Group
+    # buckets stay in RAM: compaction bounds them at one row per distinct
+    # key (<= chunk_rows).
+    spill = None
+    if kind == "range":
+        import tempfile
+        spill = tempfile.mkdtemp(prefix="wave-buckets-")
+    store = ooc._BucketStore(out_schema, dpp, spill_dir=spill)
+
+    def compact_bucket(d: int) -> None:
+        # merge accumulated partials down to one row per distinct key;
+        # pow2 device capacity bounds the number of retraces.  RAM-only
+        # buckets by construction (spill is never enabled for group).
+        assert store.spill_dir is None
+        merged = ooc._concat_hchunks(out_schema, store.fragments(d))
+        capm = 1
+        while capm < max(merged.n, 1):
+            capm *= 2
+        out = ooc._batch_to_chunk(compact_fn(
+            ooc._chunk_to_batch(merged, capm)))
+        if out.n > chunk_rows:
+            raise StreamJobError(
+                f"device bucket {start + d} holds {out.n} distinct groups "
+                f"> chunk capacity {chunk_rows}; raise chunk_rows")
+        store._ram[d] = [out]
+
+    fns: Dict[Tuple[int, int], Any] = {}
+    slack = config.initial_send_slack
+    scale = 1
+    jbounds = jnp.asarray(bounds_arr)
+
+    it = iter(cs)
+    for w in range(waves):
+        chunk = next(it, None)
+        for attempt in range(config.max_capacity_retries + 1):
+            key = (scale, slack)
+            fn = fns.get(key)
+            if fn is None:
+                fn = fns[key] = _build_wave_fn(mesh, kind, params,
+                                               chunk_rows, scale, slack)
+            garr = _put_wave(chunk, schema, chunk_rows, mesh)
+            out, info = fn(garr, jbounds)
+            local_info = _read_local_shards(info, start, dpp)  # [dpp, 3]
+            need_scale = int(local_info[:, 0].max())
+            need_slack = int(local_info[:, 1].max())
+            if need_scale == 0 and need_slack == 0:
+                break
+            # mirrored right-sizing (info is pmax'd mesh-wide: every
+            # process sees the same values and retries identically)
+            scale = max(scale, need_scale)
+            slack = max(slack, min(need_slack, mesh.devices.size))
+        else:
+            raise StreamJobError(
+                f"wave {w}: exchange still overflowing after "
+                f"{config.max_capacity_retries} retries (scale={scale})")
+        local = _read_local_shards(out, start, dpp)
+        counts = local.count  # np [dpp]
+        for d in range(dpp):
+            n = int(counts[d])
+            if n == 0:
+                continue
+            cols = {}
+            for k, spec in out_schema.items():
+                v = local.columns[k]
+                if spec["kind"] == "str":
+                    cols[k] = (v.data[d][:n], v.lengths[d][:n])
+                else:
+                    cols[k] = v[d][:n]
+            store.append(d, ooc.HChunk(cols, n))
+            if compact_fn is not None and store.rows(d) > chunk_rows:
+                compact_bucket(d)
+    return store, out_schema
+
+
+# ---------------------------------------------------------------------------
+# parallel store output (each worker writes its own partitions)
+
+
+def _write_partitions(out_path: str, schema, part_chunks, part_ids,
+                      mesh, chunk_rows: int,
+                      partitioning: Optional[Dict[str, Any]] = None):
+    """Every process writes its own partition files under out.tmp; counts
+    and checksums are allgathered; process 0 merges meta.json and commits
+    the rename (parallel output — DrOutputVertex per-vertex writers,
+    DrVertex.h:325-351 — instead of funneling through one process)."""
+    import jax
+    from dryad_tpu import native
+    from dryad_tpu.exec import ooc
+
+    tmp = out_path + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    my_counts: List[int] = []
+    my_sums: List[int] = []
+    for g, chunks in zip(part_ids, part_chunks):
+        merged = ooc._concat_hchunks(schema, list(chunks))
+        segs: List[np.ndarray] = []
+        for k in sorted(schema):
+            v = merged.cols[k]
+            if schema[k]["kind"] == "str":
+                segs.append(np.ascontiguousarray(v[0]))
+                segs.append(np.ascontiguousarray(v[1]))
+            else:
+                segs.append(np.ascontiguousarray(v))
+        native.write_files([os.path.join(tmp, f"part-{g:05d}.bin")],
+                           [segs])
+        my_counts.append(merged.n)
+        my_sums.append(native.checksum_segments(segs))
+
+    # allgather (counts, checksums) — doubles as the write barrier.
+    # uint32 lanes only: jax without x64 silently truncates 64-bit arrays,
+    # so the fnv64 checksum rides as (hi, lo) words
+    sums = np.asarray(my_sums, np.uint64)
+    arr = np.stack([np.asarray(my_counts, np.uint32),
+                    (sums >> np.uint64(32)).astype(np.uint32),
+                    sums.astype(np.uint32)], axis=1)
+    allinfo = _host_allgather(arr, mesh)  # [nprocs, dpp, 3]
+    if jax.process_index() == 0:
+        flat = allinfo.reshape(-1, 3).astype(np.uint64)
+        counts = [int(x) for x in flat[:, 0]]
+        checksums = ["%016x" % int((h << np.uint64(32)) | l)
+                     for h, l in zip(flat[:, 1], flat[:, 2])]
+        store_schema = {}
+        for k, spec in schema.items():
+            if spec["kind"] == "str":
+                store_schema[k] = {"kind": "str",
+                                   "max_len": spec["max_len"]}
+            else:
+                store_schema[k] = {"kind": "dense", "dtype": spec["dtype"],
+                                   "shape": list(spec.get("shape", ()))}
+        meta = {
+            "format_version": 3,
+            "npartitions": len(counts),
+            "counts": counts,
+            "capacity": max(counts or [1]),
+            "schema": store_schema,
+            "partitioning": partitioning or {"kind": "none"},
+            "compression": None,
+            "checksum_algo": "fnv64",
+            "checksums": checksums,
+            "native_io": native.available(),
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        if os.path.exists(out_path):
+            import shutil
+            shutil.rmtree(out_path)
+        os.rename(tmp, out_path)
+    # post-commit barrier so no worker reports success (or starts the next
+    # job's waves) before the rename happened
+    _host_allgather(np.zeros((1,), np.int32), mesh)
+
+
+# ---------------------------------------------------------------------------
+# terminals
+
+
+def _sample_pass(cs, key: Optional[str]):
+    """One full pass over the local stream: (lane samples, chunk count,
+    row count).  Samples empty when key is None."""
+    from dryad_tpu.exec import ooc
+
+    samples: List[np.ndarray] = []
+    nchunks = 0
+    rows = 0
+    for chunk in cs:
+        nchunks += 1
+        rows += chunk.n
+        if key is None or chunk.n == 0:
+            continue
+        spec = cs.schema[key]
+        take = min(chunk.n, _SAMPLES_PER_CHUNK)
+        idx = np.linspace(0, chunk.n - 1, take).astype(np.int64)
+        col = chunk.cols[key]
+        if spec["kind"] == "str":
+            lane = ooc._host_sort_lanes(spec, (col[0][idx], col[1][idx]))[0]
+        else:
+            lane = ooc._host_sort_lanes(spec, col[idx])[0]
+        samples.append(lane)
+    s = (np.concatenate(samples) if samples
+         else np.zeros((0,), np.uint32))
+    if len(s) > _MAX_SAMPLES:
+        s = s[np.linspace(0, len(s) - 1, _MAX_SAMPLES).astype(np.int64)]
+    return s, nchunks, rows
+
+
+def _gathered_bounds(samples: np.ndarray, mesh, n_buckets: int
+                     ) -> np.ndarray:
+    """Allgather per-process samples and cut global quantile bounds —
+    the distributed form of the reference's sampling stage
+    (DryadLinqSampler.cs:42 + DrDynamicRangeDistributor.h:23)."""
+    from dryad_tpu.exec import ooc
+
+    padded = np.zeros((_MAX_SAMPLES,), np.uint32)
+    padded[:len(samples)] = samples
+    meta = np.asarray([len(samples)], np.uint32)
+    all_s = _host_allgather(padded, mesh)     # [nprocs, SMAX]
+    all_n = _host_allgather(meta, mesh)       # [nprocs, 1]
+    merged = np.concatenate([all_s[p, :int(all_n[p, 0])]
+                             for p in range(all_s.shape[0])])
+    return ooc._bounds_from_samples(merged, n_buckets)
+
+
+def _finish_sort(store, schema, keys, chunk_rows: int, mesh,
+                 out_path: str, term):
+    """Per-device buckets -> fully sorted partitions, written in parallel.
+    Output partition order equals global sort order (range buckets are
+    laid out in mesh partition order by the exchange)."""
+    import jax
+    from dryad_tpu.exec import ooc
+
+    nprocs = jax.process_count()
+    dpp = mesh.devices.size // nprocs
+    start = jax.process_index() * dpp
+    sort_fn = ooc._make_sort_fn(tuple(tuple(k) for k in keys))
+    part_chunks = []
+    for d in range(dpp):
+        frags = store.fragments(d)
+        part_chunks.append(list(ooc._sorted_bucket_chunks(
+            schema, frags, [tuple(k) for k in keys], chunk_rows, sort_fn)))
+    part_ids = list(range(start, start + dpp))
+    # ascending sorts leave partitions in range order; a descending
+    # primary cannot claim ascending range partitioning (plan/planner.py
+    # OrderBy semantics)
+    part = ({"kind": "range", "keys": [keys[0][0]]}
+            if not keys[0][1] else {"kind": "none"})
+    _write_partitions(out_path, schema, part_chunks, part_ids, mesh,
+                      chunk_rows, partitioning=part)
+
+
+def _finish_group(store, out_schema, keys, final, mean_cols,
+                  chunk_rows: int, mesh, term):
+    """Merge each device bucket's accumulated partials, finalize means,
+    then either write partitions in parallel or return the local host
+    table part (driver concatenates parts in pid order)."""
+    import jax
+
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.exec import ooc
+    from dryad_tpu.ops import kernels
+
+    nprocs = jax.process_count()
+    dpp = mesh.devices.size // nprocs
+    start = jax.process_index() * dpp
+
+    merge = jax.jit(lambda b: kernels.group_aggregate(b, keys, final))
+    fin = jax.jit(lambda b: Batch(
+        kernels.mean_finalize_columns(dict(b.columns), mean_cols), b.count))
+
+    # final output schema, probed on an empty partial batch
+    fin_schema = ooc.chunk_schema(ooc._batch_to_chunk(fin(merge(
+        ooc._chunk_to_batch(ooc.HChunk.empty_like(out_schema), 1)))))
+
+    finals: List[List[Any]] = []
+    for d in range(dpp):
+        frags = store.fragments(d)
+        if not frags:
+            finals.append([])
+            continue
+        merged = ooc._concat_hchunks(out_schema, frags)
+        capm = 1
+        while capm < max(merged.n, 1):
+            capm *= 2
+        out = ooc._batch_to_chunk(fin(merge(
+            ooc._chunk_to_batch(merged, capm))))
+        finals.append([out])
+
+    if term.get("out") is not None:
+        _write_partitions(term["out"], fin_schema, finals,
+                          list(range(start, start + dpp)), mesh,
+                          chunk_rows,
+                          partitioning={"kind": "hash", "keys": list(keys)})
+        return None
+    # collect: return this worker's part as a host table
+    from dryad_tpu.exec.stream_exec import chunks_to_table
+    flat = [c for lst in finals for c in lst]
+    cs = ooc.ChunkSource(lambda: iter(flat), fin_schema, chunk_rows)
+    return chunks_to_table(cs)
+
+
+# ---------------------------------------------------------------------------
+# worker entry
+
+
+def execute_stream_job(spec_json: str, fn_table, mesh, config):
+    """Run one streamed job SPMD on this worker; returns the worker's
+    reply payload (merged by the driver)."""
+    import jax
+
+    from dryad_tpu.exec import ooc
+    from dryad_tpu.exec.stream_exec import (_LOCAL_KINDS, _stream_local)
+    from dryad_tpu.io.store import store_meta
+    from dryad_tpu.plan.serialize import _op_from_json
+
+    spec = json.loads(spec_json)
+    path = spec["source"]["path"]
+    chunk_rows = spec["source"]["chunk_rows"]
+    me, nprocs = jax.process_index(), jax.process_count()
+
+    meta = store_meta(path)
+    parts = [p for p in range(meta["npartitions"]) if p % nprocs == me]
+    cs = ooc.ChunkSource.from_store(path, chunk_rows, partitions=parts)
+
+    shared: Dict[int, dict] = {}
+    ops = [_op_from_json(o, fn_table, shared) for o in spec["ops"]]
+    bad = [o.kind for o in ops if o.kind not in _LOCAL_KINDS]
+    if bad:
+        raise StreamJobError(
+            f"streamed cluster jobs support chunk-local ops only; got "
+            f"{bad}")
+    if ops:
+        cs = _stream_local(cs, ops, config)
+    schema = cs.schema
+    chunk_rows = cs.chunk_rows  # local ops may change the chunk bound
+
+    term = spec["terminal"]
+    kind = term["kind"]
+    if kind == "count":
+        return {"count": sum(c.n for c in cs)}
+
+    if kind == "sort":
+        keys = [(k, bool(d)) for k, d in term["keys"]]
+        key0, desc0 = keys[0]
+        samples, nchunks, rows = _sample_pass(cs, key0)
+        counts = _host_allgather(np.asarray([nchunks], np.int64), mesh)
+        waves = int(counts.max())
+        P_total = mesh.devices.size
+        bounds = _gathered_bounds(samples, mesh, P_total)
+        store, _ = _run_waves(cs, schema, mesh, "range",
+                              {"key": key0, "descending": desc0},
+                              waves, chunk_rows, config, bounds)
+        try:
+            _finish_sort(store, schema, keys, chunk_rows, mesh,
+                         term["out"], term)
+        finally:
+            store.close()
+            if store.spill_dir:
+                import shutil
+                shutil.rmtree(store.spill_dir, ignore_errors=True)
+        return {"stored": term["out"]}
+
+    if kind == "group":
+        from dryad_tpu.plan.planner import _decompose_aggs
+        keys = list(term["keys"])
+        aggs = {k: (v[0], v[1]) for k, v in term["aggs"].items()}
+        partial, final, mean_cols = _decompose_aggs(aggs)
+        _, nchunks, _ = _sample_pass(cs, None)
+        counts = _host_allgather(np.asarray([nchunks], np.int64), mesh)
+        waves = int(counts.max())
+        store, pschema = _run_waves(cs, schema, mesh, "group",
+                                    {"keys": keys, "partial": partial,
+                                     "final": final},
+                                    waves, chunk_rows, config,
+                                    np.zeros((0,), np.uint32))
+        table = _finish_group(store, pschema, keys, final, mean_cols,
+                              chunk_rows, mesh, term)
+        if term.get("out") is not None:
+            return {"stored": term["out"]}
+        return {"table_part": table}
+
+    raise StreamJobError(f"unknown streamed terminal {kind!r}")

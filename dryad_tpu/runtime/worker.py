@@ -138,6 +138,28 @@ def main(argv=None) -> int:
                 lost_control = True
                 break
             continue
+        if cmd == "run_stream":
+            # streamed (out-of-core) SPMD job: chunk waves + sharded
+            # exchanges + host bucket spill (runtime/stream_cluster.py)
+            reply = {"ok": True, "pid": args.process_id,
+                     "job": msg.get("job")}
+            try:
+                from dryad_tpu.runtime.shiplan import resolve_fn_table
+                from dryad_tpu.runtime.stream_cluster import \
+                    execute_stream_job
+                from dryad_tpu.utils.config import JobConfig
+                fn_table = resolve_fn_table(msg["plan"], args.fn_module)
+                cfg = msg.get("config") or JobConfig()
+                reply["result"] = execute_stream_job(
+                    msg["spec"], fn_table, mesh, cfg)
+            except Exception:
+                reply = {"ok": False, "pid": args.process_id,
+                         "job": msg.get("job"),
+                         "error": traceback.format_exc()}
+            if not _send_reply(reply):
+                lost_control = True
+                break
+            continue
         if cmd == "run":
             events: list = []
             reply: dict = {"ok": True, "pid": args.process_id,

@@ -1,0 +1,142 @@
+"""Streamed OOC jobs over the real multi-process worker gang (VERDICT r2
+item 2): every worker streams its own store-partition subset; the gang
+advances through lockstep chunk waves, each wave one sharded exchange over
+the (dcn, dp) mesh with host-side bucket spill between waves; output
+partitions are written in parallel (one writer per worker).  The data is
+many times larger than any single wave's device capacity."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_fns  # noqa: E402
+
+from dryad_tpu.api.dataset import Context  # noqa: E402
+from dryad_tpu.runtime import LocalCluster  # noqa: E402
+from dryad_tpu.utils.config import JobConfig  # noqa: E402
+
+CHUNK = 256
+N = 6000  # ~23x the per-wave device chunk capacity
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    old = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = (os.path.dirname(__file__) + os.pathsep +
+                                (old or ""))
+    cl = LocalCluster(n_processes=2, devices_per_process=2)
+    yield cl
+    cl.shutdown()
+    if old is None:
+        os.environ.pop("PYTHONPATH", None)
+    else:
+        os.environ["PYTHONPATH"] = old
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(17)
+    return {"k": rng.randint(0, 25, N).astype(np.int32),
+            "v": rng.randint(-10**6, 10**6, N).astype(np.int32)}
+
+
+@pytest.fixture(scope="module")
+def store(data, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("scluster") / "src")
+    Context().from_columns(data).to_store(path)
+    return path
+
+
+def _ctx(cluster):
+    return Context(cluster=cluster,
+                   config=JobConfig(ooc_chunk_rows=CHUNK))
+
+
+def test_cluster_stream_sort(cluster, store, data, tmp_path):
+    """Streamed TeraSort over the gang: sampled global bounds, per-wave
+    range exchange, per-worker recursive bucket sort, PARALLEL output
+    (each worker writes its own partitions; process 0 merges meta)."""
+    ctx = _ctx(cluster)
+    out = str(tmp_path / "sorted")
+    ctx.read_store_stream(store, chunk_rows=CHUNK).order_by(
+        [("v", False)]).to_store(out)
+
+    from dryad_tpu.io.store import store_meta
+    meta = store_meta(out)
+    assert meta["npartitions"] == 4  # one per device across the gang
+    assert meta["partitioning"] == {"kind": "range", "keys": ["v"]}
+    back = Context().from_store(out).collect()
+    np.testing.assert_array_equal(np.asarray(back["v"]),
+                                  np.sort(data["v"]))
+
+
+def test_cluster_stream_group_collect(cluster, store, data):
+    ctx = _ctx(cluster)
+    out = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+           .group_by(["k"], {"s": ("sum", "v"), "n": ("count", None),
+                             "m": ("mean", "v")}).collect())
+    k, v = data["k"], data["v"]
+    exp_s = {int(kk): int(v[k == kk].sum()) for kk in np.unique(k)}
+    got_s = dict(zip((int(x) for x in out["k"]),
+                     (int(x) for x in out["s"])))
+    assert got_s == exp_s
+    got_m = dict(zip((int(x) for x in out["k"]),
+                     (float(x) for x in out["m"])))
+    for kk in exp_s:
+        assert abs(got_m[kk] - float(v[k == kk].mean())) < 0.5
+
+
+def test_cluster_stream_ops_and_count(cluster, store, data):
+    """Chunk-local shipped UDFs compose with the streamed terminals."""
+    ctx = _ctx(cluster)
+    s = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+         .select(cluster_fns.double_v)
+         .where(cluster_fns.keep_positive))
+    assert s.count() == int((data["v"] * 2 > 0).sum())
+    out = s.group_by(["k"], {"s": ("sum", "v")}).collect()
+    v2 = data["v"] * 2
+    mask = v2 > 0
+    exp = {int(kk): int(v2[mask][data["k"][mask] == kk].sum())
+           for kk in np.unique(data["k"][mask])}
+    got = dict(zip((int(x) for x in out["k"]),
+                   (int(x) for x in out["s"])))
+    assert got == exp
+
+
+def test_cluster_stream_group_to_store(cluster, store, data, tmp_path):
+    ctx = _ctx(cluster)
+    out = str(tmp_path / "grouped")
+    (ctx.read_store_stream(store, chunk_rows=CHUNK)
+     .group_by(["k"], {"s": ("sum", "v")})).to_store(out)
+    from dryad_tpu.io.store import store_meta
+    meta = store_meta(out)
+    assert meta["partitioning"] == {"kind": "hash", "keys": ["k"]}
+    back = Context().from_store(out).collect()
+    exp = {int(kk): int(data["v"][data["k"] == kk].sum())
+           for kk in np.unique(data["k"])}
+    got = dict(zip((int(x) for x in back["k"]),
+                   (int(x) for x in back["s"])))
+    assert got == exp
+
+
+def test_cluster_stream_wordcount(cluster, tmp_path):
+    """Streamed WordCount over the gang (string keys ride the wave
+    exchange)."""
+    words = ["ant", "bee", "cat", "dog", "elk", "fox"]
+    rng = np.random.RandomState(23)
+    lines = [" ".join(words[i] for i in rng.randint(0, 6, 5))
+             for _ in range(2000)]
+    src = str(tmp_path / "lines")
+    Context().from_columns({"line": [l.encode() for l in lines]},
+                           str_max_len=64).to_store(src)
+    ctx = _ctx(cluster)
+    out = (ctx.read_store_stream(src, chunk_rows=CHUNK)
+           .split_words("line", out_capacity=CHUNK * 8)
+           .group_by(["line"], {"n": ("count", None)})).collect()
+    import collections
+    exp = collections.Counter(w for l in lines for w in l.split())
+    got = {w.decode(): int(n) for w, n in zip(out["line"], out["n"])}
+    assert got == dict(exp)
