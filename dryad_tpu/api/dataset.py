@@ -73,6 +73,7 @@ class Context:
                           if cluster.n_processes > 1 else 1)
             self.executor = None
             self._event_log = event_log
+            self._token_seq = 0
             return
         self.mesh = mesh if mesh is not None else make_mesh()
         self.nparts = self.mesh.devices.size
@@ -86,8 +87,13 @@ class Context:
 
     def _cluster_run(self, node, collect: bool = True,
                      store_path: Optional[str] = None,
-                     store_partitioning: Optional[Dict[str, Any]] = None):
-        """Plan, serialize, and submit one query to the worker gang."""
+                     store_partitioning: Optional[Dict[str, Any]] = None,
+                     keep_token: Optional[str] = None,
+                     want_reply: bool = False):
+        """Plan, serialize, and submit one query to the worker gang.
+        Returns the host table (default) or, with ``want_reply``, worker
+        0's full reply (resident-cache metadata included).  Queued token
+        releases from dropped cached Datasets piggyback on every job."""
         from dryad_tpu.runtime.shiplan import serialize_for_cluster
         graph = plan_query(node, self.nparts, hosts=self.hosts,
                            config=self.config)
@@ -97,12 +103,37 @@ class Context:
         prev_log = self.cluster.event_log
         self.cluster.event_log = self._event_log
         try:
-            return self.cluster.execute(
+            reply = self.cluster.execute(
                 plan_json, specs, collect=collect, store_path=store_path,
                 store_partitioning=store_partitioning, config=self.config,
-                timeout=self.config.cluster_job_timeout_s)
+                timeout=self.config.cluster_job_timeout_s,
+                keep_token=keep_token)
         finally:
             self.cluster.event_log = prev_log
+        return reply if want_reply else reply.get("table")
+
+    # -- cluster-resident intermediates ------------------------------------
+
+    def _fresh_token(self, tag: str) -> str:
+        self._token_seq += 1
+        return f"__{tag}_{id(self):x}_{self._token_seq}"
+
+    def _resident_dataset(self, token: str, capacity: int,
+                          partitioning: E.Partitioning =
+                          E.Partitioning.none()) -> "Dataset":
+        """Dataset over a cluster-resident intermediate: queries ship only
+        the token.  When the Dataset's source node is garbage-collected,
+        the token is queued on the CLUSTER's release list (piggybacked on
+        the next job from ANY Context — the gang holds the device memory,
+        so the queue must outlive this Context)."""
+        import weakref
+
+        from dryad_tpu.runtime.sources import DeferredSource
+        node = E.Source(parents=(), data=DeferredSource(
+            {"kind": "resident", "token": token, "capacity": capacity}),
+            _npartitions=self.nparts, _partitioning=partitioning)
+        weakref.finalize(node, self.cluster.pending_release.append, token)
+        return Dataset(self, node)
 
     # -- dataset constructors ---------------------------------------------
 
@@ -265,44 +296,60 @@ class Context:
                 f"{self.config.max_loop_iterations}; raise the knob "
                 f"explicitly for longer loops")
         if self.cluster is not None:
-            # iterate by re-submitting the planned body, binding the
-            # previous iteration's collected table as the loop source —
-            # the body plan's fingerprints are identical every round, so
-            # workers (persistent executors, runtime/exec_common.py) compile
-            # each stage once.  Reference DoWhile re-runs the loop subgraph
-            # per iteration the same way (DryadLinqQueryGen.cs:3353).
+            # iterate by re-submitting the planned body with the previous
+            # iteration's output held CLUSTER-RESIDENT under a token —
+            # only the plan + token cross the driver socket per iteration,
+            # never the table (the reference keeps loop-carried data as
+            # cluster-resident temp outputs read in place,
+            # GraphManager/vertex/DrVertex.h:325-351; VERDICT r2 item 4).
+            # The body plan's fingerprints are identical every round, so
+            # workers (persistent executors, runtime/exec_common.py)
+            # compile each stage once.  ``cond`` still collects the table
+            # each round — it is a host predicate on the full table.
             import dataclasses as _dc
 
-            from dryad_tpu.runtime.sources import (DeferredSource,
-                                                   columns_spec)
+            from dryad_tpu.runtime import ClusterJobError, WorkerFailure
+            from dryad_tpu.runtime.sources import DeferredSource
+
             ph = E.Placeholder(parents=(), name="__loop",
                                _npartitions=self.nparts)
             body_node = body(Dataset(self, ph)).node
 
-            def subst(node):
+            def subst(node, token, cap):
                 if isinstance(node, E.Placeholder) and node.name == "__loop":
-                    spec = columns_spec(
-                        cur, self.nparts,
-                        str_max_len=self.config.string_max_len)
-                    return E.Source(parents=(),
-                                    data=DeferredSource(spec),
-                                    _npartitions=self.nparts)
-                new_parents = tuple(subst(p) for p in node.parents)
+                    return E.Source(parents=(), data=DeferredSource(
+                        {"kind": "resident", "token": token,
+                         "capacity": cap}), _npartitions=self.nparts)
+                new_parents = tuple(subst(p, token, cap)
+                                    for p in node.parents)
                 if new_parents == node.parents:
                     return node
                 return _dc.replace(node, parents=new_parents)
 
-            cur = init.collect()
-            for _ in range(n_iters):
-                cur = self._cluster_run(subst(body_node))
-                if cond is not None and not cond(cur):
-                    break
-            node = E.Source(parents=(),
-                            data=DeferredSource(columns_spec(
-                                cur, self.nparts,
-                                str_max_len=self.config.string_max_len)),
-                            _npartitions=self.nparts, host=cur)
-            return Dataset(self, node)
+            def run_loop():
+                token = self._fresh_token("loop")
+                reply = self._cluster_run(init.node, collect=False,
+                                          keep_token=token,
+                                          want_reply=True)
+                cap = reply["resident_capacity"]
+                for _ in range(n_iters):
+                    reply = self._cluster_run(
+                        subst(body_node, token, cap),
+                        collect=cond is not None, keep_token=token,
+                        want_reply=True)
+                    cap = reply["resident_capacity"]
+                    if cond is not None and not cond(reply["table"]):
+                        break
+                return token, cap
+
+            try:
+                token, cap = run_loop()
+            except (WorkerFailure, ClusterJobError):
+                # a gang restart loses resident state; the loop is
+                # deterministic from its sources — replay once from init
+                # (lineage replay, SURVEY.md §3.5)
+                token, cap = run_loop()
+            return self._resident_dataset(token, cap)
         if self.local_debug:
             cur_host = _oracle.run_oracle(init.node)
             ph = E.Placeholder(parents=(), name="__loop",
@@ -650,10 +697,15 @@ class Dataset:
             return Dataset(self.ctx, node)
         part = self.node.partitioning
         if self.ctx.cluster is not None:
-            # cluster v1: round-trip through the driver (partitioning
-            # claims drop — the re-shipped source is block-partitioned)
-            t = self.ctx._cluster_run(self.node)
-            return self.ctx.from_columns(t)
+            # materialize cluster-resident: later queries ship only the
+            # token, and the partitioning claim SURVIVES (hash-partitioned
+            # cache feeds shuffle-free joins/groupbys) — VERDICT r2 item 4
+            token = self.ctx._fresh_token("cache")
+            reply = self.ctx._cluster_run(self.node, collect=False,
+                                          keep_token=token,
+                                          want_reply=True)
+            return self.ctx._resident_dataset(
+                token, reply["resident_capacity"], partitioning=part)
         if self._streaming():
             # materialize once to a temp store, stream reads from there;
             # the dir lives as long as the Context (weakref finalizer

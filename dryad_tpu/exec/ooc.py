@@ -910,18 +910,10 @@ def write_chunks_to_store(path: str, chunks: Iterable[HChunk],
         counts.append(chunk.n)
         p += 1
     import json
-    meta = {
-        "format_version": 3,
-        "npartitions": p,
-        "counts": counts,
-        "capacity": max(counts or [1]),
-        "schema": store_schema,
-        "partitioning": partitioning or {"kind": "none"},
-        "compression": compression,
-        "checksum_algo": "fnv64",
-        "checksums": checksums,
-        "native_io": native.available(),
-    }
+
+    from dryad_tpu.io.store import build_meta
+    meta = build_meta(store_schema, counts, checksums,
+                      partitioning=partitioning, compression=compression)
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     if os.path.exists(path):

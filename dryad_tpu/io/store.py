@@ -28,7 +28,7 @@ from dryad_tpu import native
 from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.exec.data import PData
 
-__all__ = ["write_store", "read_store", "store_meta",
+__all__ = ["write_store", "read_store", "store_meta", "build_meta",
            "StoreIntegrityError"]
 
 _FORMAT_VERSION = 3
@@ -43,6 +43,29 @@ class StoreIntegrityError(RuntimeError):
 
 def _part_path(path: str, p: int) -> str:
     return os.path.join(path, f"part-{p:05d}.bin")
+
+
+def build_meta(schema: Dict[str, Any], counts: List[int],
+               checksums: List[str],
+               partitioning: Optional[Dict[str, Any]] = None,
+               compression: Optional[str] = None,
+               capacity: Optional[int] = None) -> Dict[str, Any]:
+    """The ONE meta.json constructor — every writer (in-memory write_store,
+    streamed write_chunks_to_store, cluster parallel partition writers)
+    goes through it, so format_version / field skew cannot happen."""
+    return {
+        "format_version": _FORMAT_VERSION,
+        "npartitions": len(counts),
+        "counts": list(counts),
+        "capacity": capacity if capacity is not None
+        else max(list(counts) or [1]),
+        "schema": schema,
+        "partitioning": partitioning or {"kind": "none"},
+        "compression": compression,
+        "checksum_algo": "fnv64",
+        "checksums": checksums,
+        "native_io": native.available(),
+    }
 
 
 def _col_order(schema: Dict[str, Any]) -> List[str]:
@@ -96,18 +119,9 @@ def write_store(path: str, pd: PData,
                        compress=(compression == "gzip"))
     checksums = ["%016x" % native.checksum_segments(segs)
                  for segs in segments]
-    meta = {
-        "format_version": _FORMAT_VERSION,
-        "npartitions": pd.nparts,
-        "counts": counts.tolist(),
-        "capacity": pd.capacity,
-        "schema": schema,
-        "partitioning": partitioning or {"kind": "none"},
-        "compression": compression,
-        "checksum_algo": "fnv64",
-        "checksums": checksums,
-        "native_io": native.available(),
-    }
+    meta = build_meta(schema, counts.tolist(), checksums,
+                      partitioning=partitioning, compression=compression,
+                      capacity=pd.capacity)
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     if os.path.exists(path):

@@ -83,6 +83,10 @@ class LocalCluster:
         # schedulers discard stale replies (a finished job may leave an
         # ignored-duplicate reply in flight — see runtime/farm.py)
         self._job_seq = 0
+        # resident tokens queued for release (owning Dataset/Context was
+        # dropped); lives on the CLUSTER — Contexts come and go while the
+        # gang holds the device memory — and piggybacks on every job
+        self.pending_release: List[str] = []
         self._start()
 
     def next_job_id(self) -> int:
@@ -199,6 +203,8 @@ class LocalCluster:
 
     def restart(self) -> None:
         self._kill_all()
+        # fresh processes hold no residents; queued releases are moot
+        del self.pending_release[:]
         self._start()
 
     def shutdown(self) -> None:
@@ -325,18 +331,25 @@ class LocalCluster:
                 source_specs: Dict[str, Dict[str, Any]],
                 collect: bool = True, store_path: Optional[str] = None,
                 store_partitioning: Optional[Dict[str, Any]] = None,
-                config=None,
-                timeout: float = 600.0) -> Optional[Dict[str, Any]]:
-        """Submit one job to the gang; returns worker 0's host table.
+                config=None, timeout: float = 600.0,
+                keep_token: Optional[str] = None,
+                release: tuple = ()) -> Dict[str, Any]:
+        """Submit one job to the gang; returns worker 0's full reply (its
+        host table under "table", plus resident-cache metadata).
         ``config`` (a JobConfig) rides the pickle control message so the
-        driver's executor knobs apply on the workers."""
+        driver's executor knobs apply on the workers.  ``keep_token``
+        caches the result cluster-resident; ``release`` piggybacks token
+        drops."""
         if not self.alive():
             self.restart()
         job = self.next_job_id()
+        queued = self.pending_release[:]
+        del self.pending_release[:len(queued)]
         msg = {"cmd": "run", "plan": plan_json, "sources": source_specs,
                "collect": collect, "store_path": store_path,
                "store_partitioning": store_partitioning, "job": job,
-               "config": config}
+               "config": config, "keep_token": keep_token,
+               "release": list(release) + queued}
         for s in self._socks.values():
             s.setblocking(True)
             protocol.send_msg(s, msg)
@@ -347,7 +360,7 @@ class LocalCluster:
         if self.event_log is not None and 0 in replies:
             for e in replies[0].get("events", []):
                 self.event_log(dict(e, worker=0))
-        return replies.get(0, {}).get("table")
+        return replies.get(0, {})
 
     def _gather_job_replies(self, job: int, timeout: float,
                             what: str) -> Dict[int, dict]:
@@ -420,8 +433,10 @@ class LocalCluster:
         if not self.alive():
             self.restart()
         job = self.next_job_id()
+        queued = self.pending_release[:]
+        del self.pending_release[:len(queued)]
         msg = {"cmd": "run_stream", "spec": spec_json, "plan": plan_json,
-               "job": job, "config": config}
+               "job": job, "config": config, "release": queued}
         for s in self._socks.values():
             s.setblocking(True)
             protocol.send_msg(s, msg)

@@ -14,6 +14,10 @@ from typing import Any, Callable, Dict, Optional
 __all__ = ["execute_plan"]
 
 _EXECUTORS: Dict[int, Any] = {}
+# cluster-resident intermediates: token -> PData (loop state, cache());
+# cleared implicitly by gang restart (fresh processes), explicitly by the
+# driver's piggybacked release lists
+_RESIDENT: Dict[str, Any] = {}
 
 
 def _gang_executor(mesh, config=None):
@@ -34,10 +38,17 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
                  event_log: Optional[Callable[[dict], None]] = None,
                  store_path: Optional[str] = None,
                  store_partitioning: Optional[Dict[str, Any]] = None,
-                 collect: Any = True, config=None) -> Any:
+                 collect: Any = True, config=None,
+                 keep_token: Optional[str] = None,
+                 release: tuple = ()) -> Any:
     """Build sources, run the graph, replicate the output, and (on process
     0) return the host table / write the store.  ``collect``: True = full
-    host table, "count" = total row count only, False = nothing."""
+    host table, "count" = total row count only, False = nothing.
+
+    Returns ``(table, extras)``.  ``keep_token`` caches the output PData
+    cluster-resident under that token (readable by later plans via a
+    "resident" source spec — zero table bytes across the driver socket);
+    ``release`` drops tokens no longer referenced."""
     import jax
 
     from dryad_tpu.exec.data import (PData, collect_replicated,
@@ -48,12 +59,19 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
 
     import numpy as np
 
-    sources = {key: build_source(spec, mesh)
+    for tok in release:
+        _RESIDENT.pop(tok, None)
+    sources = {key: build_source(spec, mesh, resident=_RESIDENT)
                for key, spec in source_specs.items()}
     graph = graph_from_json(plan_json, fn_table=fn_table, sources=sources)
     ex = _gang_executor(mesh, config)
     ex._event = event_log or (lambda e: None)
     pd = ex.run(graph)
+
+    extras: Dict[str, Any] = {}
+    if keep_token is not None:
+        _RESIDENT[keep_token] = pd
+        extras["resident_capacity"] = pd.capacity
 
     table = None
     if collect == "count":
@@ -73,4 +91,4 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
             from dryad_tpu.io.store import write_store
             write_store(store_path, rep,
                         partitioning=store_partitioning)
-    return table
+    return table, extras

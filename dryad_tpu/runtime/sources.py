@@ -101,10 +101,23 @@ def store_spec(path: str, nparts: int, meta: Dict[str, Any],
             "partitions": partitions}
 
 
-def build_source(spec: Dict[str, Any], mesh):
+def build_source(spec: Dict[str, Any], mesh, resident=None):
     """Materialize a source spec as sharded PData — runs on EVERY process
-    (array creation fills only local addressable shards; no collective)."""
+    (array creation fills only local addressable shards; no collective).
+
+    ``resident`` is the worker's token -> PData cache: loop-carried /
+    cached intermediates stay CLUSTER-RESIDENT and the plan ships only a
+    token, never the table (the reference's cluster-resident temp outputs
+    read in place, GraphManager/vertex/DrVertex.h:325-351)."""
     kind = spec["kind"]
+    if kind == "resident":
+        tok = spec["token"]
+        if resident is None or tok not in resident:
+            raise KeyError(
+                f"resident token {tok!r} not present on this worker — "
+                f"the gang restarted since it was cached; re-run the "
+                f"producing query")
+        return resident[tok]
     if kind == "columns":
         from dryad_tpu.exec.data import pdata_from_host
         return pdata_from_host(spec["columns"], mesh,

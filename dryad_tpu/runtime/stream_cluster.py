@@ -342,12 +342,14 @@ def _build_wave_fn(mesh, kind: str, params: Dict[str, Any], chunk_rows: int,
 
 
 def _run_waves(cs, schema, mesh, kind: str, params: Dict[str, Any],
-               waves: int, chunk_rows: int, config, bounds_arr):
-    """Advance the gang through ``waves`` lockstep chunk waves; append each
-    wave's received rows to per-local-device bucket stores (compacting
-    group partials whenever a bucket exceeds the chunk capacity — the
-    streaming aggregation-tree role).  Returns (bucket store, its row
-    schema)."""
+               chunk_rows: int, config, bounds_arr):
+    """Advance the gang through lockstep chunk waves until every process's
+    stream is exhausted (a tiny per-wave continuation allgather keeps the
+    SPMD collective counts identical WITHOUT a counting pre-pass over the
+    data); append each wave's received rows to per-local-device bucket
+    stores (compacting group partials whenever a bucket exceeds the chunk
+    capacity — the streaming aggregation-tree role).  Returns (bucket
+    store, its row schema)."""
     import jax
     import jax.numpy as jnp
 
@@ -408,8 +410,14 @@ def _run_waves(cs, schema, mesh, kind: str, params: Dict[str, Any],
     jbounds = jnp.asarray(bounds_arr)
 
     it = iter(cs)
-    for w in range(waves):
+    w = 0
+    while True:
         chunk = next(it, None)
+        live = _host_allgather(
+            np.asarray([1 if chunk is not None else 0], np.int32), mesh)
+        if int(live.sum()) == 0:
+            break
+        w += 1
         for attempt in range(config.max_capacity_retries + 1):
             key = (scale, slack)
             fn = fns.get(key)
@@ -493,6 +501,7 @@ def _write_partitions(out_path: str, schema, part_chunks, part_ids,
                     sums.astype(np.uint32)], axis=1)
     allinfo = _host_allgather(arr, mesh)  # [nprocs, dpp, 3]
     if jax.process_index() == 0:
+        from dryad_tpu.io.store import build_meta
         flat = allinfo.reshape(-1, 3).astype(np.uint64)
         counts = [int(x) for x in flat[:, 0]]
         checksums = ["%016x" % int((h << np.uint64(32)) | l)
@@ -505,18 +514,8 @@ def _write_partitions(out_path: str, schema, part_chunks, part_ids,
             else:
                 store_schema[k] = {"kind": "dense", "dtype": spec["dtype"],
                                    "shape": list(spec.get("shape", ()))}
-        meta = {
-            "format_version": 3,
-            "npartitions": len(counts),
-            "counts": counts,
-            "capacity": max(counts or [1]),
-            "schema": store_schema,
-            "partitioning": partitioning or {"kind": "none"},
-            "compression": None,
-            "checksum_algo": "fnv64",
-            "checksums": checksums,
-            "native_io": native.available(),
-        }
+        meta = build_meta(store_schema, counts, checksums,
+                          partitioning=partitioning)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f, indent=1)
         if os.path.exists(out_path):
@@ -698,14 +697,11 @@ def execute_stream_job(spec_json: str, fn_table, mesh, config):
     if kind == "sort":
         keys = [(k, bool(d)) for k, d in term["keys"]]
         key0, desc0 = keys[0]
-        samples, nchunks, rows = _sample_pass(cs, key0)
-        counts = _host_allgather(np.asarray([nchunks], np.int64), mesh)
-        waves = int(counts.max())
-        P_total = mesh.devices.size
-        bounds = _gathered_bounds(samples, mesh, P_total)
+        samples, _, _ = _sample_pass(cs, key0)
+        bounds = _gathered_bounds(samples, mesh, mesh.devices.size)
         store, _ = _run_waves(cs, schema, mesh, "range",
                               {"key": key0, "descending": desc0},
-                              waves, chunk_rows, config, bounds)
+                              chunk_rows, config, bounds)
         try:
             _finish_sort(store, schema, keys, chunk_rows, mesh,
                          term["out"], term)
@@ -721,13 +717,12 @@ def execute_stream_job(spec_json: str, fn_table, mesh, config):
         keys = list(term["keys"])
         aggs = {k: (v[0], v[1]) for k, v in term["aggs"].items()}
         partial, final, mean_cols = _decompose_aggs(aggs)
-        _, nchunks, _ = _sample_pass(cs, None)
-        counts = _host_allgather(np.asarray([nchunks], np.int64), mesh)
-        waves = int(counts.max())
+        # no pre-pass: the per-wave continuation flag drives the loop, so
+        # group-by reads and computes the data exactly once
         store, pschema = _run_waves(cs, schema, mesh, "group",
                                     {"keys": keys, "partial": partial,
                                      "final": final},
-                                    waves, chunk_rows, config,
+                                    chunk_rows, config,
                                     np.zeros((0,), np.uint32))
         table = _finish_group(store, pschema, keys, final, mean_cols,
                               chunk_rows, mesh, term)

@@ -144,10 +144,13 @@ def main(argv=None) -> int:
             reply = {"ok": True, "pid": args.process_id,
                      "job": msg.get("job")}
             try:
+                from dryad_tpu.runtime import exec_common
                 from dryad_tpu.runtime.shiplan import resolve_fn_table
                 from dryad_tpu.runtime.stream_cluster import \
                     execute_stream_job
                 from dryad_tpu.utils.config import JobConfig
+                for tok in msg.get("release") or ():
+                    exec_common._RESIDENT.pop(tok, None)
                 fn_table = resolve_fn_table(msg["plan"], args.fn_module)
                 cfg = msg.get("config") or JobConfig()
                 reply["result"] = execute_stream_job(
@@ -169,12 +172,15 @@ def main(argv=None) -> int:
                 from dryad_tpu.runtime.shiplan import resolve_fn_table
                 fn_table = resolve_fn_table(msg["plan"], args.fn_module)
                 collect = msg.get("collect", True)
-                table = execute_plan(
+                table, extras = execute_plan(
                     msg["plan"], fn_table, msg["sources"], mesh,
                     event_log=events.append,
                     store_path=msg.get("store_path"),
                     store_partitioning=msg.get("store_partitioning"),
-                    collect=collect, config=msg.get("config"))
+                    collect=collect, config=msg.get("config"),
+                    keep_token=msg.get("keep_token"),
+                    release=tuple(msg.get("release") or ()))
+                reply.update(extras)
                 if args.process_id == 0 and collect:
                     reply["table"] = table
             except Exception:

@@ -198,6 +198,57 @@ def test_cluster_zip_strings_take(cluster):
     assert [w.decode() for w in top["s"]] == words[:5]
 
 
+def test_cluster_do_while_resident_state(cluster, monkeypatch):
+    """Loop-carried state stays CLUSTER-RESIDENT: after the init shipment,
+    each iteration's control message carries only the plan + token — zero
+    table bytes cross the driver socket (VERDICT r2 item 4; reference
+    cluster-resident temp outputs, DrVertex.h:325-351)."""
+    from dryad_tpu.runtime import cluster as cluster_mod
+
+    sizes = []
+    real_send = cluster_mod.protocol.send_msg
+
+    def counting_send(sock, obj):
+        import pickle
+        if isinstance(obj, dict) and obj.get("cmd") == "run":
+            sizes.append(len(pickle.dumps(obj, protocol=4)))
+        return real_send(sock, obj)
+
+    monkeypatch.setattr(cluster_mod.protocol, "send_msg", counting_send)
+
+    ctx = Context(cluster=cluster)
+    n = 50_000  # ~200 KB of table data per column
+    init = ctx.from_columns({"v": np.arange(n, dtype=np.int32)})
+    out = ctx.do_while(init, lambda d: d.select(cluster_fns.inc_v),
+                       n_iters=4)
+    t = out.collect()
+    np.testing.assert_array_equal(np.sort(np.asarray(t["v"])),
+                                  np.arange(n) + 4)
+    per_job = sizes[::cluster.n_processes]  # one entry per job
+    # job 0 ships the init columns (the one legitimate table transfer);
+    # every iteration job and the final collect ship plan+token only
+    assert per_job[0] > n  # init carries the table
+    for s in per_job[1:]:
+        assert s < 20_000, f"iteration message shipped {s} bytes"
+
+
+def test_cluster_cache_keeps_partitioning(cluster):
+    """cache() materializes cluster-resident AND keeps its partitioning
+    claim: a follow-up group_by on the same keys plans no exchange."""
+    ctx = Context(cluster=cluster)
+    k = (np.arange(120, dtype=np.int32) * 7) % 13
+    v = np.arange(120, dtype=np.int32)
+    cached = (ctx.from_columns({"k": k, "v": v})
+              .hash_partition(["k"]).cache())
+    plan = cached.group_by(["k"], {"s": ("sum", "v")}).explain()
+    assert "=>hash" not in plan
+    out = cached.group_by(["k"], {"s": ("sum", "v")}).collect()
+    exp = {int(kk): int(v[k == kk].sum()) for kk in np.unique(k)}
+    got = dict(zip((int(x) for x in out["k"]),
+                   (int(x) for x in out["s"])))
+    assert got == exp
+
+
 def test_cluster_group_contents(cluster):
     """Group-contents family over the worker gang: structured group_top_k /
     group_median ship without callables; group_apply ships its per-group
