@@ -74,6 +74,11 @@ class Context:
             self.executor = None
             self._event_log = event_log
             self._token_seq = 0
+            # token -> producing plan node: a gang restart wipes resident
+            # state, so a query touching a lost token re-materializes it
+            # from lineage and retries (replay-based fault tolerance,
+            # SURVEY.md §3.5)
+            self._resident_producers: Dict[str, Any] = {}
             return
         self.mesh = mesh if mesh is not None else make_mesh()
         self.nparts = self.mesh.devices.size
@@ -89,11 +94,13 @@ class Context:
                      store_path: Optional[str] = None,
                      store_partitioning: Optional[Dict[str, Any]] = None,
                      keep_token: Optional[str] = None,
-                     want_reply: bool = False):
+                     want_reply: bool = False,
+                     store_compression: Optional[str] = None):
         """Plan, serialize, and submit one query to the worker gang.
         Returns the host table (default) or, with ``want_reply``, worker
         0's full reply (resident-cache metadata included).  Queued token
         releases from dropped cached Datasets piggyback on every job."""
+        from dryad_tpu.runtime import ClusterJobError
         from dryad_tpu.runtime.shiplan import serialize_for_cluster
         graph = plan_query(node, self.nparts, hosts=self.hosts,
                            config=self.config)
@@ -103,14 +110,38 @@ class Context:
         prev_log = self.cluster.event_log
         self.cluster.event_log = self._event_log
         try:
-            reply = self.cluster.execute(
-                plan_json, specs, collect=collect, store_path=store_path,
-                store_partitioning=store_partitioning, config=self.config,
-                timeout=self.config.cluster_job_timeout_s,
-                keep_token=keep_token)
+            for heal in range(8):   # bound resident-healing retries
+                try:
+                    reply = self.cluster.execute(
+                        plan_json, specs, collect=collect,
+                        store_path=store_path,
+                        store_partitioning=store_partitioning,
+                        config=self.config,
+                        timeout=self.config.cluster_job_timeout_s,
+                        keep_token=keep_token,
+                        store_compression=store_compression)
+                    break
+                except ClusterJobError as e:
+                    tok = self._lost_resident_token(str(e))
+                    if tok is None or heal == 7:
+                        raise
+                    # a gang restart wiped this resident: re-materialize
+                    # it from its producing plan, then retry the query
+                    # (recursively heals chained residents)
+                    self._cluster_run(self._resident_producers[tok],
+                                      collect=False, keep_token=tok)
         finally:
             self.cluster.event_log = prev_log
         return reply if want_reply else reply.get("table")
+
+    def _lost_resident_token(self, err: str) -> Optional[str]:
+        """Healable token from a 'resident token ... not present' job
+        error, if its producer is registered."""
+        import re
+        m = re.search(r"resident token '([^']+)' not present", err)
+        if m and m.group(1) in self._resident_producers:
+            return m.group(1)
+        return None
 
     # -- cluster-resident intermediates ------------------------------------
 
@@ -120,18 +151,25 @@ class Context:
 
     def _resident_dataset(self, token: str, capacity: int,
                           partitioning: E.Partitioning =
-                          E.Partitioning.none()) -> "Dataset":
+                          E.Partitioning.none(),
+                          producer: Any = None) -> "Dataset":
         """Dataset over a cluster-resident intermediate: queries ship only
         the token.  When the Dataset's source node is garbage-collected,
         the token is queued on the CLUSTER's release list (piggybacked on
         the next job from ANY Context — the gang holds the device memory,
-        so the queue must outlive this Context)."""
+        so the queue must outlive this Context).  ``producer`` (the plan
+        node that computed it) makes the resident survive gang restarts:
+        a token miss re-materializes from lineage."""
         import weakref
 
         from dryad_tpu.runtime.sources import DeferredSource
         node = E.Source(parents=(), data=DeferredSource(
             {"kind": "resident", "token": token, "capacity": capacity}),
             _npartitions=self.nparts, _partitioning=partitioning)
+        if producer is not None:
+            self._resident_producers[token] = producer
+            weakref.finalize(node, self._resident_producers.pop, token,
+                             None)
         weakref.finalize(node, self.cluster.pending_release.append, token)
         return Dataset(self, node)
 
@@ -328,26 +366,38 @@ class Context:
 
             def run_loop():
                 token = self._fresh_token("loop")
-                reply = self._cluster_run(init.node, collect=False,
-                                          keep_token=token,
-                                          want_reply=True)
-                cap = reply["resident_capacity"]
-                for _ in range(n_iters):
-                    reply = self._cluster_run(
-                        subst(body_node, token, cap),
-                        collect=cond is not None, keep_token=token,
-                        want_reply=True)
+                try:
+                    reply = self._cluster_run(init.node, collect=False,
+                                              keep_token=token,
+                                              want_reply=True)
                     cap = reply["resident_capacity"]
-                    if cond is not None and not cond(reply["table"]):
-                        break
-                return token, cap
+                    for _ in range(n_iters):
+                        reply = self._cluster_run(
+                            subst(body_node, token, cap),
+                            collect=cond is not None, keep_token=token,
+                            want_reply=True)
+                        cap = reply["resident_capacity"]
+                        if cond is not None and not cond(reply["table"]):
+                            break
+                    return token, cap
+                except BaseException:
+                    # the abandoned token must not pin a dataset-sized
+                    # PData in surviving workers
+                    self.cluster.pending_release.append(token)
+                    raise
 
             try:
                 token, cap = run_loop()
-            except (WorkerFailure, ClusterJobError):
+            except WorkerFailure:
                 # a gang restart loses resident state; the loop is
                 # deterministic from its sources — replay once from init
-                # (lineage replay, SURVEY.md §3.5)
+                # (lineage replay, SURVEY.md §3.5).  Deterministic job
+                # errors (bad UDF etc.) propagate — re-running cannot fix
+                # them.
+                token, cap = run_loop()
+            except ClusterJobError as e:
+                if "resident token" not in str(e):
+                    raise
                 token, cap = run_loop()
             return self._resident_dataset(token, cap)
         if self.local_debug:
@@ -705,7 +755,8 @@ class Dataset:
                                           keep_token=token,
                                           want_reply=True)
             return self.ctx._resident_dataset(
-                token, reply["resident_capacity"], partitioning=part)
+                token, reply["resident_capacity"], partitioning=part,
+                producer=self.node)
         if self._streaming():
             # materialize once to a temp store, stream reads from there;
             # the dir lives as long as the Context (weakref finalizer
@@ -775,14 +826,16 @@ class Dataset:
         part = self.node.partitioning
         if compression is None:
             compression = self.ctx.config.store_compression
+        if compression not in (None, "gzip"):
+            raise ValueError(f"unknown compression {compression!r}")
         if self.ctx.cluster is not None:
-            if compression is not None:
-                raise NotImplementedError(
-                    "to_store(compression=...) in cluster mode")
+            # parallel output: every worker writes its own partitions
+            # (compression included); process 0 merges meta + commits
             self.ctx._cluster_run(
                 self.node, collect=False, store_path=path,
                 store_partitioning={"kind": part.kind,
-                                    "keys": list(part.keys)})
+                                    "keys": list(part.keys)},
+                store_compression=compression)
             return
         if self._streaming():
             from dryad_tpu.exec.ooc import write_chunks_to_store

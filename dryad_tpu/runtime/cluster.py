@@ -333,7 +333,8 @@ class LocalCluster:
                 store_partitioning: Optional[Dict[str, Any]] = None,
                 config=None, timeout: float = 600.0,
                 keep_token: Optional[str] = None,
-                release: tuple = ()) -> Dict[str, Any]:
+                release: tuple = (),
+                store_compression: Optional[str] = None) -> Dict[str, Any]:
         """Submit one job to the gang; returns worker 0's full reply (its
         host table under "table", plus resident-cache metadata).
         ``config`` (a JobConfig) rides the pickle control message so the
@@ -349,7 +350,8 @@ class LocalCluster:
                "collect": collect, "store_path": store_path,
                "store_partitioning": store_partitioning, "job": job,
                "config": config, "keep_token": keep_token,
-               "release": list(release) + queued}
+               "release": list(release) + queued,
+               "store_compression": store_compression}
         for s in self._socks.values():
             s.setblocking(True)
             protocol.send_msg(s, msg)

@@ -40,7 +40,8 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
                  store_partitioning: Optional[Dict[str, Any]] = None,
                  collect: Any = True, config=None,
                  keep_token: Optional[str] = None,
-                 release: tuple = ()) -> Any:
+                 release: tuple = (),
+                 store_compression: Optional[str] = None) -> Any:
     """Build sources, run the graph, replicate the output, and (on process
     0) return the host table / write the store.  ``collect``: True = full
     host table, "count" = total row count only, False = nothing.
@@ -86,9 +87,22 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
                                    unpack=jax.process_index() == 0,
                                    config=config)
     if store_path is not None:
-        rep = PData(replicate_tree(pd.batch, mesh), pd.nparts)
-        if jax.process_index() == 0:
-            from dryad_tpu.io.store import write_store
-            write_store(store_path, rep,
-                        partitioning=store_partitioning)
+        # PARALLEL output: each process writes ITS OWN partitions from its
+        # addressable shards (no replication collective, no single-writer
+        # funnel); process 0 merges meta and commits — the reference's
+        # per-vertex output writers + job-end commit (DrOutputVertex,
+        # DrVertex.h:325-351)
+        from dryad_tpu.runtime.stream_cluster import (_read_local_shards,
+                                                      _write_partitions,
+                                                      local_batch_chunks)
+        nprocs = jax.process_count()
+        dpp = pd.nparts // nprocs
+        start = jax.process_index() * dpp
+        local = _read_local_shards(pd.batch, start, dpp)
+        schema, chunks = local_batch_chunks(local)
+        _write_partitions(store_path, schema, [[c] for c in chunks],
+                          list(range(start, start + dpp)), mesh,
+                          pd.capacity, partitioning=store_partitioning,
+                          compression=store_compression,
+                          capacity=pd.capacity)
     return table, extras

@@ -270,6 +270,39 @@ def _put_wave(chunk, schema, chunk_rows: int, mesh):
     return Batch(cols, put(local_counts))
 
 
+def local_batch_chunks(local) -> Tuple[Dict[str, Any], List[Any]]:
+    """Split a host-side local Batch [dpp, cap, ...] (from
+    _read_local_shards) into per-device TRIMMED HChunks plus their schema
+    — the one conversion between sharded batches and host chunk rows
+    (used by wave draining and the parallel store writers)."""
+    from dryad_tpu.data.columnar import StringColumn
+    from dryad_tpu.exec.ooc import HChunk
+
+    counts = np.asarray(local.count)
+    dpp = counts.shape[0]
+    schema: Dict[str, Any] = {}
+    for k, v in local.columns.items():
+        if isinstance(v, StringColumn):
+            schema[k] = {"kind": "str",
+                         "max_len": int(np.asarray(v.data).shape[2])}
+        else:
+            a = np.asarray(v)
+            schema[k] = {"kind": "dense", "dtype": a.dtype.name,
+                         "shape": list(a.shape[2:])}
+    chunks: List[Any] = []
+    for d in range(dpp):
+        n = int(counts[d])
+        cols: Dict[str, Any] = {}
+        for k, v in local.columns.items():
+            if isinstance(v, StringColumn):
+                cols[k] = (np.asarray(v.data)[d][:n],
+                           np.asarray(v.lengths)[d][:n])
+            else:
+                cols[k] = np.asarray(v)[d][:n]
+        chunks.append(HChunk(cols, n))
+    return schema, chunks
+
+
 def _read_local_shards(tree, start: int, dpp: int):
     """Pull a mesh-sharded pytree's LOCAL partitions to host:
     leaf [P, ...] -> np [dpp, ...] (this process's rows only)."""
@@ -440,19 +473,11 @@ def _run_waves(cs, schema, mesh, kind: str, params: Dict[str, Any],
                 f"wave {w}: exchange still overflowing after "
                 f"{config.max_capacity_retries} retries (scale={scale})")
         local = _read_local_shards(out, start, dpp)
-        counts = local.count  # np [dpp]
-        for d in range(dpp):
-            n = int(counts[d])
-            if n == 0:
+        _, wave_chunks = local_batch_chunks(local)
+        for d, hc in enumerate(wave_chunks):
+            if hc.n == 0:
                 continue
-            cols = {}
-            for k, spec in out_schema.items():
-                v = local.columns[k]
-                if spec["kind"] == "str":
-                    cols[k] = (v.data[d][:n], v.lengths[d][:n])
-                else:
-                    cols[k] = v[d][:n]
-            store.append(d, ooc.HChunk(cols, n))
+            store.append(d, hc)
             if compact_fn is not None and store.rows(d) > chunk_rows:
                 compact_bucket(d)
     return store, out_schema
@@ -464,15 +489,20 @@ def _run_waves(cs, schema, mesh, kind: str, params: Dict[str, Any],
 
 def _write_partitions(out_path: str, schema, part_chunks, part_ids,
                       mesh, chunk_rows: int,
-                      partitioning: Optional[Dict[str, Any]] = None):
+                      partitioning: Optional[Dict[str, Any]] = None,
+                      compression: Optional[str] = None,
+                      capacity: Optional[int] = None):
     """Every process writes its own partition files under out.tmp; counts
     and checksums are allgathered; process 0 merges meta.json and commits
     the rename (parallel output — DrOutputVertex per-vertex writers,
-    DrVertex.h:325-351 — instead of funneling through one process)."""
+    DrVertex.h:325-351 — instead of funneling through one process).
+    Checksums cover the UNCOMPRESSED segments (store read contract)."""
     import jax
     from dryad_tpu import native
     from dryad_tpu.exec import ooc
 
+    if compression not in (None, "gzip"):
+        raise StreamJobError(f"unknown compression {compression!r}")
     tmp = out_path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     my_counts: List[int] = []
@@ -488,7 +518,7 @@ def _write_partitions(out_path: str, schema, part_chunks, part_ids,
             else:
                 segs.append(np.ascontiguousarray(v))
         native.write_files([os.path.join(tmp, f"part-{g:05d}.bin")],
-                           [segs])
+                           [segs], compress=(compression == "gzip"))
         my_counts.append(merged.n)
         my_sums.append(native.checksum_segments(segs))
 
@@ -515,7 +545,8 @@ def _write_partitions(out_path: str, schema, part_chunks, part_ids,
                 store_schema[k] = {"kind": "dense", "dtype": spec["dtype"],
                                    "shape": list(spec.get("shape", ()))}
         meta = build_meta(store_schema, counts, checksums,
-                          partitioning=partitioning)
+                          partitioning=partitioning,
+                          compression=compression, capacity=capacity)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f, indent=1)
         if os.path.exists(out_path):

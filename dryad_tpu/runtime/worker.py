@@ -179,7 +179,8 @@ def main(argv=None) -> int:
                     store_partitioning=msg.get("store_partitioning"),
                     collect=collect, config=msg.get("config"),
                     keep_token=msg.get("keep_token"),
-                    release=tuple(msg.get("release") or ()))
+                    release=tuple(msg.get("release") or ()),
+                    store_compression=msg.get("store_compression"))
                 reply.update(extras)
                 if args.process_id == 0 and collect:
                     reply["table"] = table

@@ -100,6 +100,31 @@ def test_cluster_store_roundtrip(cluster, tmp_path):
     assert got == exp
 
 
+def test_cluster_parallel_store_output_gzip(cluster, tmp_path):
+    """to_store in cluster mode: each worker writes its own partitions
+    (compression included) from its addressable shards; process 0 merges
+    meta and commits — the per-vertex parallel output of the reference
+    (DrOutputVertex, DrVertex.h:325-351).  The round-2 gzip fence is
+    gone."""
+    ctx = Context(cluster=cluster)
+    path = str(tmp_path / "gz_store")
+    k = np.arange(200, dtype=np.int32) % 9
+    v = np.arange(200, dtype=np.int32)
+    (ctx.from_columns({"k": k, "v": v})
+     .hash_partition(["k"]).to_store(path, compression="gzip"))
+
+    from dryad_tpu.io.store import store_meta
+    meta = store_meta(path)
+    assert meta["compression"] == "gzip"
+    assert meta["npartitions"] == cluster.nparts
+    assert meta["partitioning"] == {"kind": "hash", "keys": ["k"]}
+    # counts reflect the true per-device hash distribution
+    assert sum(meta["counts"]) == 200
+    back = Context().from_store(path).collect()
+    got = {(int(a), int(b)) for a, b in zip(back["k"], back["v"])}
+    assert got == {(int(a), int(b)) for a, b in zip(k, v)}
+
+
 def test_cluster_worker_failure_detection_and_restart(cluster):
     ctx = Context(cluster=cluster)
     v = np.arange(100, dtype=np.int32)
@@ -247,6 +272,23 @@ def test_cluster_cache_keeps_partitioning(cluster):
     got = dict(zip((int(x) for x in out["k"]),
                    (int(x) for x in out["s"])))
     assert got == exp
+
+
+def test_cluster_cache_survives_gang_restart(cluster):
+    """A gang restart wipes resident state; a cached Dataset must HEAL by
+    re-materializing from its producing plan (lineage replay) instead of
+    failing with a lost-token error (code-review r3 finding)."""
+    ctx = Context(cluster=cluster)
+    k = np.arange(90, dtype=np.int32) % 5
+    v = np.arange(90, dtype=np.int32)
+    cached = ctx.from_columns({"k": k, "v": v}).cache()
+    before = cached.group_by(["k"], {"s": ("sum", "v")}).collect()
+    cluster.restart()   # all residents gone
+    after = cached.group_by(["k"], {"s": ("sum", "v")}).collect()
+    assert dict(zip((int(x) for x in after["k"]),
+                    (int(x) for x in after["s"]))) == \
+        dict(zip((int(x) for x in before["k"]),
+                 (int(x) for x in before["s"])))
 
 
 def test_cluster_group_contents(cluster):
