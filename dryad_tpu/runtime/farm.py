@@ -33,7 +33,8 @@ class FarmError(RuntimeError):
 
 
 class _Task:
-    __slots__ = ("idx", "sources", "runs", "delays", "result", "duplicated")
+    __slots__ = ("idx", "sources", "runs", "delays", "result", "duplicated",
+                 "preferred")
 
     def __init__(self, idx: int, sources: Dict[str, Dict[str, Any]]):
         self.idx = idx
@@ -42,6 +43,12 @@ class _Task:
         self.delays: Dict[int, float] = {}  # worker -> commanded test delay
         self.result: Optional[Dict[str, Any]] = None
         self.duplicated = False
+        # soft locality hint from the task's source specs (the worker that
+        # holds the store partitions; Interfaces.cs:98-152 affinity role)
+        self.preferred: Optional[int] = next(
+            (s["preferred_worker"] for s in sources.values()
+             if isinstance(s, dict)
+             and s.get("preferred_worker") is not None), None)
 
 
 class TaskFarm:
@@ -217,8 +224,21 @@ class TaskFarm:
                     worker_lost(pid)
             # fill idle workers: fresh tasks first, then speculate.  A task
             # reassigned by worker-loss/timeout may since have finished via
-            # a surviving duplicate — skip those
+            # a surviving duplicate — skip those.  Locality-aware matching:
+            # an idle worker takes a task that PREFERS it when one exists
+            # (data it already holds), but preference never blocks — an
+            # idle worker with no preferring task takes the queue head
+            # (fall back freely; reference weighted affinity,
+            # Interfaces.cs:98-152)
             while todo and idle:
+                pair = next(((t for t in todo
+                              if t.result is None and t.preferred in idle)),
+                            None)
+                if pair is not None:
+                    todo.remove(pair)
+                    if not dispatch(pair, pair.preferred):
+                        todo.insert(0, pair)
+                    continue
                 t = todo.pop(0)
                 if t.result is not None:
                     continue

@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Any, Dict, Mapping
 
 __all__ = ["DeferredSource", "columns_spec", "text_spec", "store_spec",
-           "build_source", "count_lines"]
+           "preferred_worker_for_partitions", "build_source", "count_lines"]
 
 
 class DeferredSource:
@@ -85,10 +85,14 @@ def text_spec(path, nparts: int, column: str = "line",
 
 def store_spec(path: str, nparts: int, meta: Dict[str, Any],
                capacity: int | None = None,
-               partitions: list | None = None) -> Dict[str, Any]:
+               partitions: list | None = None,
+               preferred_worker: int | None = None) -> Dict[str, Any]:
     """``partitions`` restricts to the listed store partitions — the
     per-task input granularity for farming a big store (one task per
-    partition group, DrPartitionFile.cpp:607 role)."""
+    partition group, DrPartitionFile.cpp:607 role).  ``preferred_worker``
+    is a soft locality hint the task farm honors when that worker is
+    available (the reference's weighted affinity lists from block
+    locations, ClusterInterface/Interfaces.cs:98-152)."""
     counts = meta.get("counts", [])
     if partitions is not None:
         counts = [counts[p] for p in partitions]
@@ -98,7 +102,23 @@ def store_spec(path: str, nparts: int, meta: Dict[str, Any],
     else:
         cap = capacity or _block_capacity(sum(counts), nparts)
     return {"kind": "store", "path": path, "capacity": cap,
-            "partitions": partitions}
+            "partitions": partitions,
+            "preferred_worker": preferred_worker}
+
+
+def preferred_worker_for_partitions(partitions, npartitions: int,
+                                    n_processes: int) -> int | None:
+    """The worker that WROTE (and likely page-caches / locally holds) the
+    given store partitions under the parallel-output layout: worker w
+    writes partitions [w*dpp, (w+1)*dpp).  Returns the majority holder,
+    or None when the layout doesn't divide evenly."""
+    if n_processes <= 1 or npartitions % n_processes:
+        return None
+    dpp = npartitions // n_processes
+    owners = [p // dpp for p in partitions]
+    if not owners:
+        return None
+    return max(set(owners), key=owners.count)
 
 
 def build_source(spec: Dict[str, Any], mesh, resident=None):
