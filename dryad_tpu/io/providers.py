@@ -126,5 +126,11 @@ def _store_provider(ctx, rest: str, **kw):
     return ctx.from_store(rest, **kw)
 
 
+def _http_provider(ctx, rest: str, **kw):
+    from dryad_tpu.io.http_provider import http_provider
+    return http_provider(ctx, rest, **kw)
+
+
 register_provider("file", _file_provider)
 register_provider("store", _store_provider)
+register_provider("http", _http_provider)

@@ -1,0 +1,116 @@
+"""HTTP provider e2e (VERDICT r2 item 9): a REAL second scheme through the
+provider seam — ranged GETs (HttpReader.cs:78-105 role) + partition
+enumeration against a local test server.  Zero external egress: the
+server runs in-process on loopback."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context
+from tests.utils import assert_same_rows
+
+FILES = {
+    "part-0.txt": b"alpha beta\ngamma\nalpha\n",
+    "part-1.txt": b"beta beta\ndelta alpha\n",
+}
+
+
+class _RangeHandler(BaseHTTPRequestHandler):
+    """Static files with Range support + '/' partition listing."""
+
+    requests_log: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def _body_for(self):
+        path = self.path.lstrip("/")
+        if path == "" or path.endswith("/"):
+            return "\n".join(sorted(FILES)).encode(), True
+        if path in FILES:
+            return FILES[path], False
+        return None, False
+
+    def do_HEAD(self):
+        body, _ = self._body_for()
+        if body is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        body, is_listing = self._body_for()
+        if body is None:
+            self.send_error(404)
+            return
+        rng = self.headers.get("Range")
+        type(self).requests_log.append((self.path, rng))
+        if rng and not is_listing:
+            lo, hi = rng.split("=")[1].split("-")
+            lo, hi = int(lo), int(hi)
+            part = body[lo:hi + 1]
+            self.send_response(206)
+            self.send_header("Content-Range",
+                             f"bytes {lo}-{hi}/{len(body)}")
+            self.send_header("Content-Length", str(len(part)))
+            self.end_headers()
+            self.wfile.write(part)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _RangeHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_http_read_single_file(server):
+    ctx = Context()
+    ds = ctx.read(f"http://{server}/part-0.txt")
+    lines = ds.collect()["line"]
+    assert lines == [b"alpha beta", b"gamma", b"alpha"]
+
+
+def test_http_partition_enumeration_wordcount(server):
+    """The e2e pattern: enumerate partitions from a '/' listing, run the
+    WordCount shape, oracle-compare."""
+    ctx = Context()
+    dbg = Context(local_debug=True)
+
+    def q(c):
+        return (c.read(f"http://{server}/")
+                .split_words("line", out_capacity=256)
+                .group_by(["line"], {"n": ("count", None)}))
+
+    assert_same_rows(q(ctx).collect(), q(dbg).collect())
+
+
+def test_http_uses_ranged_gets(server):
+    _RangeHandler.requests_log.clear()
+    ctx = Context()
+    ds = ctx.read(f"http://{server}/part-0.txt", block=8)
+    assert ds.count() == 3
+    ranged = [r for p, r in _RangeHandler.requests_log
+              if p == "/part-0.txt" and r]
+    # 23-byte body at block=8 -> 3 ranged GETs
+    assert len(ranged) == 3
+    assert ranged[0] == "bytes=0-7"
+
+
+def test_http_unknown_scheme_still_errors():
+    from dryad_tpu.io.providers import UnknownSchemeError
+    with pytest.raises(UnknownSchemeError):
+        Context().read("gopher://nowhere/x")
