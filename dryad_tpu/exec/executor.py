@@ -378,22 +378,56 @@ class Executor:
     # -- stage program construction ---------------------------------------
 
     def _build_stage_fn(self, stage: Stage, scale: int, slack: int,
-                        n_legs: int, has_bounds: bool):
+                        n_legs: int, has_bounds: bool,
+                        salted: bool = False):
         def per_shard(*args):
             leg_batches = [
                 _squeeze(b) for b in args[:n_legs]]
             bounds = args[n_legs] if has_bounds else None
             needs = jnp.zeros((2,), jnp.int32)
+            # exchange-attributed capacity need, tracked SEPARATELY so the
+            # salting trigger reacts to exchange skew only — a uniform
+            # flat_map shortfall must scale capacity, not salt the join
+            exch_need = jnp.zeros((), jnp.int32)
             outs = []
-            for leg, b in zip(stage.legs, leg_batches):
-                for op in leg.ops:
-                    b, nd = _apply_op(b, op, scale, [], self.axes, slack)
+            if salted:
+                # hot-key-salted join repartition: both legs' hash
+                # exchanges are rewritten jointly (left spreads hot keys,
+                # right replicates its hot rows) — the runtime skew escape
+                # (DrDynamicDistributor.h:79; see shuffle.skew_join_exchange)
+                lb, rb = leg_batches
+                for op in stage.legs[0].ops:
+                    lb, nd = _apply_op(lb, op, scale, [], self.axes, slack)
                     needs = jnp.maximum(needs, nd)
-                if leg.exchange is not None:
-                    b, nd = _apply_exchange(b, leg.exchange, scale, slack,
-                                            bounds, self.axes)
+                for op in stage.legs[1].ops:
+                    rb, nd = _apply_op(rb, op, scale, [], self.axes, slack)
                     needs = jnp.maximum(needs, nd)
-                outs.append(b)
+                lex, rex = stage.legs[0].exchange, stage.legs[1].exchange
+                lcap = lex.out_capacity * scale
+                rcap = rex.out_capacity * scale
+                lout, rout, lnr, rnr, nsl = shuffle.skew_join_exchange(
+                    lb, rb, lex.keys, rex.keys, lcap, rcap,
+                    hot_factor=self.config.salt_hot_factor,
+                    topk=self.config.salt_topk, send_slack=slack,
+                    axes=self.axes)
+                nd = _needs(jnp.maximum(
+                    _scale_need(lnr, lex.out_capacity),
+                    _scale_need(rnr, rex.out_capacity)), nsl)
+                needs = jnp.maximum(needs, nd)
+                exch_need = jnp.maximum(exch_need, nd[0])
+                outs = [lout, rout]
+            else:
+                for leg, b in zip(stage.legs, leg_batches):
+                    for op in leg.ops:
+                        b, nd = _apply_op(b, op, scale, [], self.axes,
+                                          slack)
+                        needs = jnp.maximum(needs, nd)
+                    if leg.exchange is not None:
+                        b, nd = _apply_exchange(b, leg.exchange, scale,
+                                                slack, bounds, self.axes)
+                        needs = jnp.maximum(needs, nd)
+                        exch_need = jnp.maximum(exch_need, nd[0])
+                    outs.append(b)
             cur = outs[0]
             rest = outs[1:]
             for op in stage.body:
@@ -407,10 +441,11 @@ class Executor:
                                         self.axes, slack)
                 needs = jnp.maximum(needs, nd)
             # ONE small per-shard info vector [need_scale, need_slack,
-            # out_count]: the executor host-fetches exactly one array per
-            # stage — a second fetch per stage costs a full link round
-            # trip, which dominates iterative jobs on high-latency links
-            info = jnp.concatenate([needs,
+            # exchange_need_scale, out_count]: the executor host-fetches
+            # exactly one array per stage — a second fetch per stage costs
+            # a full link round trip, which dominates iterative jobs on
+            # high-latency links
+            info = jnp.concatenate([needs, exch_need[None],
                                     cur.count.astype(jnp.int32)[None]])
             return _expand(cur), info[None]
 
@@ -489,9 +524,10 @@ class Executor:
 
         scale = stage._capacity_scale
         slack = stage._send_slack or self.config.initial_send_slack
+        salted = stage._salted
         max_retries = self.config.max_capacity_retries
         for attempt in range(max_retries + 1):
-            key = (stage.fingerprint(), scale, slack,
+            key = (stage.fingerprint(), scale, slack, salted,
                    tuple(str(jax.tree.map(lambda x: (jnp.shape(x), x.dtype),
                                           i.batch)) for i in inputs))
             args = [i.batch for i in inputs]
@@ -505,7 +541,8 @@ class Executor:
                 # surfaces through Artemis; VERDICT r1 weak item 8)
                 t0 = time.time()
                 fn = self._build_stage_fn(stage, scale, slack, len(inputs),
-                                          bounds is not None
+                                          bounds is not None,
+                                          salted=salted
                                           ).lower(*args).compile()
                 compile_s = time.time() - t0
                 self._compile_cache[key] = fn
@@ -518,12 +555,13 @@ class Executor:
             if self._multiproc:
                 from dryad_tpu.exec.data import replicate_tree
                 info = replicate_tree(info, self.mesh)
-            info = np.asarray(info)  # [P, 3]  (the ONE device sync point)
+            info = np.asarray(info)  # [P, 4]  (the ONE device sync point)
             wall = time.time() - t0
             need_scale = int(info[:, 0].max())
             need_slack = int(info[:, 1].max())
+            need_exch = int(info[:, 2].max())
             of = need_scale > 0 or need_slack > 0
-            rows = info[:, 2].tolist()
+            rows = info[:, 3].tolist()
             out_bytes = int(sum(
                 x.size * x.dtype.itemsize
                 for x in jax.tree.leaves(out_batch)))
@@ -532,12 +570,14 @@ class Executor:
                          "scale": scale, "slack": slack, "overflow": of,
                          "need_scale": need_scale,
                          "need_slack": need_slack,
+                         "need_exchange": need_exch, "salted": salted,
                          "rows": rows, "out_bytes": out_bytes,
                          "compile_s": round(compile_s, 4),
                          "wall_s": round(wall, 4)})
             if not of:
                 stage._capacity_scale = scale
                 stage._send_slack = slack
+                stage._salted = salted
                 return PData(out_batch, self.nparts)
             if need_scale >= _UNSCALABLE or not _stage_overflow_scalable(
                     stage):
@@ -547,6 +587,23 @@ class Executor:
                     f"halo, or a zip alignment shortfall) — retrying at a "
                     f"larger scale cannot succeed; raise the declared "
                     f"capacity instead")
+            if (not salted and stage.salt_ok
+                    and need_exch >= self.config.salt_trigger_factor
+                    and self.nparts > 1):
+                # hot-key EXCHANGE skew (op overflows never trigger this):
+                # one destination needs >= trigger x its capacity —
+                # rewrite the exchanges into the salted form instead of
+                # growing one device's capacity toward N
+                # (DrDynamicDistributor.h:79).  Post-salt the hot rows
+                # spread over all partitions, so the exchange need shrinks
+                # by ~P; non-exchange needs still apply at full measure.
+                salted = True
+                non_exch = max(1, need_scale if need_scale > need_exch
+                               else 1)
+                scale = max(stage._capacity_scale, non_exch,
+                            -(-need_exch * 2 // self.nparts))
+                slack = max(slack, min(need_slack, self.nparts))
+                continue
             # right-size from the measured requirements (the dynamic
             # distribution managers' size feedback, DrDynamicDistributor
             # .cpp:388): ONE retry at the exact need instead of a blind

@@ -132,6 +132,13 @@ class Planner:
         self.stages: List[Stage] = []
         self.frags: Dict[int, Fragment] = {}
         self.consumers: Dict[int, int] = {}
+        # stage ids whose OUTPUT PLACEMENT a later lowering relied on
+        # (partition elimination): those stages must never be salted
+        self.placement_dependent: set = set()
+
+    def _rely_on_placement(self, f: Fragment) -> None:
+        if isinstance(f.src, int):
+            self.placement_dependent.add(f.src)
 
     # -- stage helpers -----------------------------------------------------
 
@@ -162,6 +169,24 @@ class Planner:
                 _, frag = self._materialize(frag, label=f"tee:{type(n).__name__}")
             self.frags[n.id] = frag
         out_id, _ = self._materialize(self.frags[root.id], label="output")
+        # a placement claim flows backward through exchange-less legs
+        # (Tee/materialize pass-throughs), so reliance must disable
+        # salting on the whole ancestor chain that carries the claim —
+        # conservative closure: it only forgoes an optimization
+        dependent = set(self.placement_dependent)
+        changed = True
+        while changed:
+            changed = False
+            for st in self.stages:
+                if st.id not in dependent:
+                    continue
+                for leg in st.legs:
+                    if (leg.exchange is None and isinstance(leg.src, int)
+                            and leg.src not in dependent):
+                        dependent.add(leg.src)
+                        changed = True
+        for sid in dependent:
+            self.stages[sid].salt_ok = False
         return StageGraph(self.stages, out_id)
 
     def _lower_group_decomposable(self, n: "E.GroupByAgg", f: Fragment,
@@ -176,6 +201,8 @@ class Planner:
         box: Dict[str, Any] = {}  # shared mutable plan state (treedefs)
         if self.nparts == 1 or (f.partitioning.kind == "hash"
                                 and f.partitioning.keys == keys):
+            if self.nparts > 1:
+                self._rely_on_placement(f)
             f.ops.append(StageOp("dgroup_local", {"keys": keys,
                                                   "decs": decs, "box": box}))
             f.partitioning = E.Partitioning("hash", keys)
@@ -222,6 +249,8 @@ class Planner:
         cap = out_capacity or f.capacity
         if self.nparts == 1 or (f.partitioning.kind == "hash"
                                 and f.partitioning.keys == keys and keys):
+            if self.nparts > 1:
+                self._rely_on_placement(f)
             f.ops.append(op)
             f.capacity = cap
             f.partitioning = E.Partitioning("hash", keys)
@@ -347,6 +376,7 @@ class Planner:
                 return f
             if f.partitioning.kind == "hash" and f.partitioning.keys == keys:
                 # partition elimination: already co-located by these keys
+                self._rely_on_placement(f)
                 f.ops.append(StageOp("group", {"keys": keys, "aggs": dict(n.aggs)}))
                 return f
             partial, final, mean_cols = _decompose_aggs(n.aggs)
@@ -415,6 +445,7 @@ class Planner:
                 return f
             if f.partitioning.kind == "hash" and f.partitioning.keys == keys \
                     and keys:
+                self._rely_on_placement(f)
                 f.ops.append(StageOp("distinct", {"keys": keys}))
                 return f
             f.ops.append(StageOp("distinct", {"keys": keys}))
@@ -454,11 +485,21 @@ class Planner:
                 rex = None if (rf.partitioning.kind == "hash"
                                and rf.partitioning.keys == rkeys) else \
                     Exchange("hash", keys=rkeys, out_capacity=rf.capacity)
+                if lex is None:
+                    self._rely_on_placement(lf)
+                if rex is None:
+                    self._rely_on_placement(rf)
             st = self._new_stage(
                 [Leg(lf.src, lf.ops, lex), Leg(rf.src, rf.ops, rex)],
                 [StageOp("join", {"left_keys": lkeys, "right_keys": rkeys,
                                   "out_capacity": out_cap,
                                   "how": n.how})], "join")
+            # the executor may salt this stage's exchanges on hot-key skew
+            # — only the 2-hash-exchange inner/left shape, and plan() later
+            # clears it where downstream elimination assumed the placement
+            st.salt_ok = (lex is not None and rex is not None
+                          and n.how in ("inner", "left")
+                          and not broadcast_right)
             # broadcast join keeps the LEFT side's distribution (each
             # partition holds matches for its own left rows only)
             out_part = lf.partitioning if broadcast_right \
@@ -478,6 +519,7 @@ class Planner:
             if (f.partitioning.kind == "range" and all_asc
                     and len(sort_keys) <= len(pkeys)
                     and sort_keys == pkeys[:len(sort_keys)]):
+                self._rely_on_placement(f)
                 # Exchange elimination (AssumeOrderBy,
                 # DryadLinqQueryable.cs:3639): sound only when the requested
                 # ascending sort keys are a PREFIX of the claimed range keys.

@@ -120,6 +120,7 @@ def graph_to_json(graph: StageGraph,
                                  for o in leg.ops],
                          "exchange": ex})
         stages.append({"id": st.id, "label": st.label, "legs": legs,
+                       "salt_ok": st.salt_ok,
                        "body": [_op_to_json(o, fn_names, shared)
                                 for o in st.body]})
     return json.dumps({"version": 1, "stages": stages,
@@ -157,5 +158,6 @@ def graph_from_json(s: str, fn_table: Optional[Dict[str, Callable]] = None,
         stages.append(Stage(id=sd["id"], legs=legs,
                             body=[_op_from_json(o, fn_table, shared)
                                   for o in sd["body"]],
-                            label=sd["label"]))
+                            label=sd["label"],
+                            salt_ok=sd.get("salt_ok", False)))
     return StageGraph(stages, d["out_stage"])

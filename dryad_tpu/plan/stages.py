@@ -80,6 +80,13 @@ class Stage:
     # by the executor from measured skew (dynamic-distribution feedback);
     # None = use JobConfig.initial_send_slack
     _send_slack: Optional[int] = None
+    # True when the executor MAY rewrite this stage's exchanges into the
+    # hot-key-salted form on skew overflow: a 2-leg hash-exchange join
+    # whose output placement NO downstream stage assumed (the planner
+    # clears it wherever partition elimination relied on the claim).
+    # Reference: DrDynamicDistributor.h:79 dynamic redistribution.
+    salt_ok: bool = False
+    _salted: bool = False   # executor runtime state (sticky per stage)
 
     def fingerprint(self) -> str:
         """Structural identity for the executor's compile cache.  Two stages

@@ -34,6 +34,15 @@ class JobConfig:
     range_samples_per_partition: int = 4096
     # compiled-stage LRU entries (per executor)
     compile_cache_size: int = 256
+    # hot-key salting (exec/executor.py + parallel/shuffle.py
+    # skew_join_exchange, DrDynamicDistributor.h:79 role): a saltable join
+    # stage switches to the salted exchange when a retry would need
+    # >= trigger x the current per-destination capacity
+    salt_trigger_factor: int = 4
+    # a key is hot when its global row count exceeds factor x (rows / P)
+    salt_hot_factor: float = 4.0
+    # per-partition heavy-hitter candidates nominated for the hot set
+    salt_topk: int = 8
 
     # -- fault tolerance (exec/recovery.py) --------------------------------
     # replays allowed before FailureBudgetExceeded (DrFailureDictionary,
@@ -110,6 +119,9 @@ class JobConfig:
             (self.range_samples_per_partition >= 2,
              "range_samples_per_partition >= 2"),
             (self.compile_cache_size >= 1, "compile_cache_size >= 1"),
+            (self.salt_trigger_factor >= 2, "salt_trigger_factor >= 2"),
+            (self.salt_hot_factor >= 1.0, "salt_hot_factor >= 1.0"),
+            (self.salt_topk >= 1, "salt_topk >= 1"),
             (self.failure_budget >= 0, "failure_budget >= 0"),
             (self.spill_compression in (None, "gzip"),
              "spill_compression in (None, 'gzip')"),
