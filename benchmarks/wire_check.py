@@ -1,0 +1,104 @@
+"""Bytes-on-wire exchange accounting check on a VIRTUAL multi-device mesh.
+
+The north-star shuffle metric (>= 50% of ICI line rate, BASELINE.md
+config 2) is structurally unmeasurable on a 1-chip environment — but the
+exchange's BOOKKEEPING can still be validated: rows must conserve across
+the all_to_all (nothing lost, nothing duplicated), and the send-slot
+utilization (useful row bytes vs allocated slot bytes on the wire) tells
+how much of the transmitted buffer is payload — the knob send_slack
+trades against retry frequency (VERDICT r2 weak item 4).
+
+Runs standalone under JAX_PLATFORMS=cpu with
+--xla_force_host_platform_device_count=N (bench.py launches it as a
+subprocess so the real-chip backend stays untouched); prints ONE JSON
+line.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def main(n_devices: int = 8, rows_per_part: int = 4096,
+         n_keys: int = 1000) -> dict:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.parallel import shuffle
+    from dryad_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:n_devices])
+    axes = tuple(mesh.axis_names)
+    D = n_devices
+    cap = rows_per_part
+    slack = 2
+
+    rng = np.random.RandomState(0)
+    k = rng.randint(0, n_keys, (D, cap)).astype(np.int32)
+    v = rng.randint(0, 1 << 30, (D, cap)).astype(np.int32)
+    counts = np.full((D,), cap, np.int32)
+
+    def per_shard(batch):
+        b = jax.tree.map(lambda x: x[0], batch)
+        out, nr, nsl = shuffle.hash_exchange(b, ["k"], cap * 2,
+                                             send_slack=slack, axes=axes)
+        return (jax.tree.map(lambda x: x[None], out),
+                jnp.stack([nr, nsl, out.count])[None])
+
+    fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=P(axes),
+                               out_specs=(P(axes), P(axes)),
+                               check_vma=False))
+    batch = Batch({"k": jnp.asarray(k), "v": jnp.asarray(v)},
+                  jnp.asarray(counts))
+    out, info = fn(batch)
+    info = np.asarray(info)
+    assert (info[:, 0] == 0).all() and (info[:, 1] == 0).all(), info
+
+    # conservation: every row arrives exactly once
+    total_in = int(counts.sum())
+    total_out = int(info[:, 2].sum())
+    ok_conserved = total_in == total_out
+    out_k = np.asarray(out.columns["k"])
+    got = np.sort(np.concatenate(
+        [out_k[p, :info[p, 2]] for p in range(D)]))
+    ok_rows = bool((got == np.sort(k.reshape(-1))).all())
+
+    # placement: every row sits on the partition its key hashes to
+    ok_placed = True
+    for p in range(D):
+        kk = out_k[p, :info[p, 2]]
+        if kk.size:
+            import dryad_tpu.ops.hashing as H
+            lo = np.asarray(H.hash_batch_keys(
+                Batch({"k": jnp.asarray(kk)}, jnp.int32(kk.size)),
+                ["k"])[1])
+            ok_placed = ok_placed and bool(((lo % D) == p).all())
+
+    # wire accounting: the all_to_all carries D*C slots per source
+    # partition regardless of fill — utilization is the payload fraction
+    C = max(1, min(cap, -(-slack * cap // D)))
+    slot_rows = D * C * D            # per-axis total slots on the wire
+    useful = total_in
+    util = useful / slot_rows
+    row_bytes = 4 + 4                # k + v (int32 each)
+    result = {
+        "n_devices": D,
+        "rows": total_in,
+        "conserved": ok_conserved and ok_rows,
+        "placement_ok": ok_placed,
+        "send_slack": slack,
+        "slot_rows_on_wire": slot_rows,
+        "useful_rows": useful,
+        "wire_utilization_pct": round(100.0 * util, 1),
+        "useful_bytes": useful * row_bytes,
+        "wire_bytes": slot_rows * row_bytes,
+    }
+    return result
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

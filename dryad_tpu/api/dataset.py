@@ -754,6 +754,8 @@ class Dataset:
             reply = self.ctx._cluster_run(self.node, collect=False,
                                           keep_token=token,
                                           want_reply=True)
+            if reply.get("salted"):
+                part = E.Partitioning.none()
             return self.ctx._resident_dataset(
                 token, reply["resident_capacity"], partitioning=part,
                 producer=self.node)
@@ -773,6 +775,8 @@ class Dataset:
             self.to_store(target)
             return self.ctx.read_store_stream(target)
         pd = self._materialize()
+        if getattr(self, "_last_salted", False):
+            part = E.Partitioning.none()
         return self.ctx.from_pdata(pd, partitioning=part)
 
     # -- terminals ---------------------------------------------------------
@@ -796,7 +800,13 @@ class Dataset:
     def _materialize(self) -> PData:
         graph = plan_query(self.node, self.ctx.nparts,
                            hosts=self.ctx.hosts, config=self.ctx.config)
-        return self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
+        pd = self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
+        # runtime hot-key salting changes the OUTPUT PLACEMENT: any
+        # partitioning claim persisted from this materialization
+        # (cache/to_store) must drop or a later shuffle-elided read
+        # would silently mis-group
+        self._last_salted = any(st._salted for st in graph.stages)
+        return pd
 
     def collect(self) -> Dict[str, Any]:
         """Execute and pull all rows to host (Submit + read output)."""
@@ -846,6 +856,8 @@ class Dataset:
                 compression=compression)
             return
         pd = self._materialize()
+        if getattr(self, "_last_salted", False):
+            part = E.Partitioning.none()
         write_store(path, pd, partitioning={"kind": part.kind,
                                             "keys": list(part.keys)},
                     compression=compression)

@@ -527,7 +527,11 @@ class Executor:
         salted = stage._salted
         max_retries = self.config.max_capacity_retries
         for attempt in range(max_retries + 1):
-            key = (stage.fingerprint(), scale, slack, salted,
+            # salt knobs are baked into compiled salted programs — they
+            # must key the cache or a re-configured job reuses stale code
+            salt_cfg = ((self.config.salt_hot_factor,
+                         self.config.salt_topk) if salted else None)
+            key = (stage.fingerprint(), scale, slack, salted, salt_cfg,
                    tuple(str(jax.tree.map(lambda x: (jnp.shape(x), x.dtype),
                                           i.batch)) for i in inputs))
             args = [i.batch for i in inputs]
@@ -588,20 +592,24 @@ class Executor:
                     f"larger scale cannot succeed; raise the declared "
                     f"capacity instead")
             if (not salted and stage.salt_ok
-                    and need_exch >= self.config.salt_trigger_factor
+                    and need_exch >= self.config.salt_trigger_factor * scale
                     and self.nparts > 1):
                 # hot-key EXCHANGE skew (op overflows never trigger this):
-                # one destination needs >= trigger x its capacity —
-                # rewrite the exchanges into the salted form instead of
-                # growing one device's capacity toward N
-                # (DrDynamicDistributor.h:79).  Post-salt the hot rows
-                # spread over all partitions, so the exchange need shrinks
-                # by ~P; non-exchange needs still apply at full measure.
+                # one destination needs >= trigger x its CURRENT capacity
+                # (need_exch is measured against the base, so compare at
+                # the sticky scale) — rewrite the exchanges into the
+                # salted form instead of growing one device's capacity
+                # toward N (DrDynamicDistributor.h:79).  Post-salt the hot
+                # rows spread over all partitions, so the exchange need
+                # shrinks by ~P; a KNOWN op need (need_scale above the
+                # exchange's) still applies at full measure — the
+                # ambiguous equal case costs at most one extra
+                # right-sized retry.
                 salted = True
-                non_exch = max(1, need_scale if need_scale > need_exch
-                               else 1)
-                scale = max(stage._capacity_scale, non_exch,
+                scale = max(stage._capacity_scale,
                             -(-need_exch * 2 // self.nparts))
+                if need_scale > need_exch:
+                    scale = max(scale, need_scale)
                 slack = max(slack, min(need_slack, self.nparts))
                 continue
             # right-size from the measured requirements (the dynamic

@@ -70,6 +70,14 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
     pd = ex.run(graph)
 
     extras: Dict[str, Any] = {}
+    # runtime salting decisions are mirrored across processes (pmax'd
+    # info), so every worker computes the same flag; placement claims
+    # persisted from a salted run must drop
+    salted = any(st._salted for st in graph.stages)
+    if salted:
+        extras["salted"] = True
+        if store_partitioning:
+            store_partitioning = {"kind": "none"}
     if keep_token is not None:
         _RESIDENT[keep_token] = pd
         extras["resident_capacity"] = pd.capacity

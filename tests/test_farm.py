@@ -143,6 +143,11 @@ def test_farm_locality_preference(cluster, tmp_path):
     assert nparts == cluster.nparts
 
     plan_json, src_key = _farm_plan(cluster)
+    # warm BOTH workers' compile caches and drain stale work first — a
+    # cold worker races behind and its preferred tasks get stolen by the
+    # free fallback, deflating the preference rate below the 80% bar
+    TaskFarm(cluster).run(plan_json, _tasks(cluster, src_key, 4)[1])
+    cluster.wait_quiescent()
     groups = [[p] for p in range(nparts)] * 6     # 24 tasks over 4 parts
     per_task = []
     prefs = []

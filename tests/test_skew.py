@@ -157,6 +157,33 @@ def test_send_slot_skew_scales_slack_not_capacity():
     assert final_slack > 2 or len(attempts) == 1, attempts
 
 
+def test_salted_output_drops_persisted_partitioning_claim(tmp_path):
+    """Runtime salting spreads a key's rows across partitions, so a
+    persisted hash claim (cache()/to_store()) would let a later
+    shuffle-elided group_by silently mis-group (code-review r3 finding).
+    The claim must drop whenever the run salted."""
+    from dryad_tpu.io.store import store_meta
+
+    ctx = Context()
+    k, v = _skewed(n=20_000, hot_frac=0.9, seed=9)
+    right = ctx.from_columns({"k": np.arange(1000, dtype=np.int32),
+                              "w": np.ones(1000, np.int32)})
+    joined = ctx.from_columns({"k": k, "v": v}).join(right, ["k"], ["k"])
+
+    path = str(tmp_path / "salted_store")
+    joined.to_store(path)
+    assert store_meta(path)["partitioning"]["kind"] == "none"
+
+    cached = joined.cache()
+    plan = cached.group_by(["k"], {"s": ("sum", "v")}).explain()
+    assert "=>hash" in plan    # NOT elided: the claim was dropped
+    out = cached.group_by(["k"], {"s": ("sum", "v")}).collect()
+    got = dict(zip((int(x) for x in out["k"]),
+                   (int(x) for x in out["s"])))
+    exp = {int(kk): int(v[k == kk].sum()) for kk in np.unique(k)}
+    assert got == exp
+
+
 def test_unscalable_overflow_fails_fast():
     """A with_capacity truncation overflow must raise immediately (one
     attempt), not burn the retry budget."""
