@@ -72,6 +72,11 @@ class LocalCluster:
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="dryad-cluster-")
         self._procs: List[subprocess.Popen] = []
         self._socks: Dict[int, socket.socket] = {}
+        # elastic (standalone) workers joined mid-life: control-plane
+        # only — they serve farm tasks but never gang SPMD jobs
+        # (reference dynamic registration, LocalScheduler/Queues.cs:104)
+        self._elastic: set = set()
+        self._elastic_procs: Dict[int, subprocess.Popen] = {}
         # per-worker receive buffers persist ACROSS jobs (cleared only on
         # restart): a speculated task's losing duplicate reply may arrive
         # after the farm returns, possibly split across recv() calls — a
@@ -107,34 +112,9 @@ class LocalCluster:
         control_port = self._listener.getsockname()[1]
         coord_port = _free_port()
 
-        env = dict(os.environ)
-        env["XLA_FLAGS"] = " ".join(
-            f for f in env.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f)
-        env["JAX_PLATFORMS"] = "cpu"
-        # workers must import dryad_tpu regardless of their cwd — ship the
-        # package location (and the driver's sys.path additions) explicitly
-        import dryad_tpu
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.abspath(dryad_tpu.__file__)))
-        env["PYTHONPATH"] = os.pathsep.join(
-            [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
-                          else []))
-
         for pid in range(self.n_processes):
-            cmd = [sys.executable, "-m", "dryad_tpu.runtime.worker",
-                   "--coordinator", f"127.0.0.1:{coord_port}",
-                   "--control", f"127.0.0.1:{control_port}",
-                   "--num-processes", str(self.n_processes),
-                   "--process-id", str(pid),
-                   "--devices-per-process", str(self.devices_per_process),
-                   "--platform", "cpu"]
-            for m in self.fn_modules:
-                cmd += ["--fn-module", m]
-            log = open(os.path.join(self.log_dir, f"worker-{pid}.log"), "ab")
-            self._procs.append(subprocess.Popen(
-                cmd, env=env, stdout=log, stderr=subprocess.STDOUT))
-            log.close()
+            self._procs.append(self._spawn_worker(pid, coord_port,
+                                                  control_port))
 
         deadline = time.time() + self.startup_timeout
         self._listener.settimeout(1.0)
@@ -155,6 +135,91 @@ class LocalCluster:
             self._socks[hello["hello"]] = conn
             self._bufs[hello["hello"]] = bytearray()
 
+    def _spawn_worker(self, pid: int, coord_port: int | None,
+                      control_port: int,
+                      standalone: bool = False) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        env["JAX_PLATFORMS"] = "cpu"
+        # workers must import dryad_tpu regardless of their cwd — ship the
+        # package location (and the driver's sys.path additions) explicitly
+        import dryad_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(dryad_tpu.__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
+                          else []))
+        cmd = [sys.executable, "-m", "dryad_tpu.runtime.worker",
+               "--coordinator",
+               f"127.0.0.1:{coord_port if coord_port else 0}",
+               "--control", f"127.0.0.1:{control_port}",
+               "--num-processes", str(self.n_processes),
+               "--process-id", str(pid),
+               "--devices-per-process", str(self.devices_per_process),
+               "--platform", "cpu"]
+        if standalone:
+            cmd.append("--standalone")
+        for m in self.fn_modules:
+            cmd += ["--fn-module", m]
+        log = open(os.path.join(self.log_dir, f"worker-{pid}.log"), "ab")
+        proc = subprocess.Popen(cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+        log.close()
+        return proc
+
+    def add_worker(self, timeout: float = 120.0) -> int:
+        """Register one ELASTIC worker mid-life (the reference's dynamic
+        computer registration, LocalScheduler/Queues.cs:104-137): a
+        standalone process outside the jax.distributed gang that serves
+        independently schedulable farm tasks on its own local devices.
+        Gang SPMD jobs ignore it.  Returns the new worker's pid."""
+        pid = self.n_processes + len(self._elastic_procs)
+        control_port = self._listener.getsockname()[1]
+        proc = self._spawn_worker(pid, None, control_port, standalone=True)
+        deadline = time.time() + timeout
+        self._listener.settimeout(1.0)
+        try:
+            while True:
+                if time.time() > deadline:
+                    raise WorkerFailure(
+                        f"elastic worker {pid} did not connect within "
+                        f"{timeout}s" + self._log_tails())
+                if proc.poll() is not None:
+                    raise WorkerFailure(
+                        f"elastic worker {pid} exited rc={proc.returncode}"
+                        + self._log_tails())
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                hello = protocol.recv_msg(conn)
+                conn.setblocking(False)
+                hp = hello["hello"]
+                self._socks[hp] = conn
+                self._bufs[hp] = bytearray()
+                self._elastic.add(hp)
+                # register the process only once it is CONNECTED: a
+                # failed join must not leave a phantom in worker_procs()
+                # (the farm would count its death toward "all workers
+                # died") or an orphan running process
+                self._elastic_procs[hp] = proc
+                return hp
+        except BaseException:
+            if proc.poll() is None:
+                proc.kill()
+            raise
+
+    def gang_pids(self):
+        return [p for p in self._socks if p not in self._elastic]
+
+    def worker_procs(self) -> Dict[int, subprocess.Popen]:
+        """pid -> process for EVERY task-capable worker (gang + elastic)."""
+        out = {pid: proc for pid, proc in enumerate(self._procs)}
+        out.update(self._elastic_procs)
+        return out
+
     def _check_deaths(self, during_startup: bool = False) -> None:
         for pid, proc in enumerate(self._procs):
             if proc.poll() is not None:
@@ -166,7 +231,8 @@ class LocalCluster:
 
     def _log_tails(self, n: int = 2000) -> str:
         out = []
-        for pid in range(self.n_processes):
+        for pid in (list(range(self.n_processes))
+                    + sorted(self._elastic_procs)):
             p = os.path.join(self.log_dir, f"worker-{pid}.log")
             try:
                 with open(p, "rb") as f:
@@ -179,10 +245,11 @@ class LocalCluster:
         return "".join(out)
 
     def _kill_all(self) -> None:
-        for proc in self._procs:
+        everyone = list(self._procs) + list(self._elastic_procs.values())
+        for proc in everyone:
             if proc.poll() is None:
                 proc.kill()
-        for proc in self._procs:
+        for proc in everyone:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
@@ -193,12 +260,13 @@ class LocalCluster:
             except OSError:
                 pass
         self._procs, self._socks, self._bufs = [], {}, {}
+        self._elastic, self._elastic_procs = set(), {}
         if self._listener is not None:
             self._listener.close()
             self._listener = None
 
     def alive(self) -> bool:
-        return (len(self._socks) == self.n_processes
+        return (len(self.gang_pids()) == self.n_processes
                 and all(p.poll() is None for p in self._procs))
 
     def restart(self) -> None:
@@ -352,7 +420,8 @@ class LocalCluster:
                "config": config, "keep_token": keep_token,
                "release": list(release) + queued,
                "store_compression": store_compression}
-        for s in self._socks.values():
+        for pid in self.gang_pids():
+            s = self._socks[pid]
             s.setblocking(True)
             protocol.send_msg(s, msg)
             s.setblocking(False)
@@ -362,16 +431,36 @@ class LocalCluster:
         if self.event_log is not None and 0 in replies:
             for e in replies[0].get("events", []):
                 self.event_log(dict(e, worker=0))
-        return replies.get(0, {})
+        reply0 = dict(replies.get(0, {}))
+        if collect is True and any("table_part" in r
+                                   for r in replies.values()):
+            # parallel collect: merge per-worker parts in pid order
+            # (= partition order)
+            merged: Dict[str, Any] = {}
+            for pid in sorted(replies):
+                part = replies[pid].get("table_part")
+                if not part:
+                    continue
+                for k, v in part.items():
+                    if k not in merged:
+                        merged[k] = list(v) if isinstance(v, list) else v
+                    elif isinstance(v, list):
+                        merged[k] = list(merged[k]) + v
+                    else:
+                        import numpy as _np
+                        merged[k] = _np.concatenate([merged[k], v])
+            reply0["table"] = merged
+        return reply0
 
     def _gather_job_replies(self, job: int, timeout: float,
                             what: str) -> Dict[int, dict]:
         """Collect one reply per worker for ``job`` (shared by execute and
         execute_stream).  On any error reply, stragglers get a 5s grace
         drain (so co-errors reach the diagnosis) and the gang is torn
-        down; on success every worker's reply is returned."""
+        down; on success every worker's reply is returned.  Elastic
+        workers never receive gang jobs and are not awaited."""
         replies: Dict[int, dict] = {}
-        pending = set(self._socks)
+        pending = set(self.gang_pids())
         deadline = time.time() + timeout
         while pending:
             if time.time() > deadline:
@@ -439,7 +528,8 @@ class LocalCluster:
         del self.pending_release[:len(queued)]
         msg = {"cmd": "run_stream", "spec": spec_json, "plan": plan_json,
                "job": job, "config": config, "release": queued}
-        for s in self._socks.values():
+        for pid in self.gang_pids():
+            s = self._socks[pid]
             s.setblocking(True)
             protocol.send_msg(s, msg)
             s.setblocking(False)

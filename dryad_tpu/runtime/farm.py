@@ -178,6 +178,8 @@ class TaskFarm:
             idle.discard(pid)
             return True
 
+        n_workers_total = len(cl._socks)   # gang + elastic at farm start
+
         def worker_lost(pid: int) -> None:
             dead.add(pid)
             idle.discard(pid)
@@ -188,7 +190,7 @@ class TaskFarm:
                 todo.insert(0, task)
                 self._emit({"event": "task_reassigned", "task": task.idx,
                             "worker": pid})
-            if len(dead) == cl.n_processes:
+            if len(dead) >= n_workers_total:
                 raise WorkerFailure(
                     "all workers died during task farm" + cl._log_tails())
 
@@ -268,8 +270,8 @@ class TaskFarm:
                                             3),
                                         "threshold_s": round(thr, 3)})
 
-            # liveness + replies
-            for pid, proc in enumerate(cl._procs):
+            # liveness + replies (gang AND elastic workers)
+            for pid, proc in cl.worker_procs().items():
                 if pid not in dead and proc.poll() is not None:
                     worker_lost(pid)
             live = {cl._socks[pid]: pid for pid in cl._socks

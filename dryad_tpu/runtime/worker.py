@@ -41,21 +41,29 @@ def main(argv=None) -> int:
                     help="'cpu' forces N virtual CPU devices (local test "
                          "topology); 'default' uses the environment's "
                          "backend (real TPU hosts)")
+    ap.add_argument("--standalone", action="store_true",
+                    help="elastic (control-plane-only) worker: no "
+                         "jax.distributed membership — serves farm tasks "
+                         "on its local devices, refuses gang SPMD jobs "
+                         "(reference dynamic computer registration, "
+                         "LocalScheduler/Queues.cs:104-137)")
     args = ap.parse_args(argv)
 
     _configure_jax(args.platform, args.devices_per_process)
     import jax
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(coordinator_address=args.coordinator,
-                               num_processes=args.num_processes,
-                               process_id=args.process_id)
+    if not args.standalone:
+        jax.distributed.initialize(coordinator_address=args.coordinator,
+                                   num_processes=args.num_processes,
+                                   process_id=args.process_id)
 
     from dryad_tpu.parallel.mesh import make_mesh
     from dryad_tpu.runtime import protocol
     # cross-process boundary = the "dcn" axis; in-process devices = "dp"
     mesh = make_mesh(hosts=args.num_processes
-                     if args.num_processes > 1 else None)
+                     if args.num_processes > 1 and not args.standalone
+                     else None)
 
     # snapshot the spawning driver's pid NOW — by the time a severed socket
     # is observed the kernel may already have reparented us, and a late
@@ -138,6 +146,17 @@ def main(argv=None) -> int:
                 lost_control = True
                 break
             continue
+        if args.standalone and cmd in ("run", "run_stream"):
+            # gang SPMD jobs need jax.distributed membership, which a
+            # mid-life joiner cannot acquire without a gang restart —
+            # elastic workers serve independently schedulable farm tasks
+            if not _send_reply({"ok": False, "pid": args.process_id,
+                                "job": msg.get("job"),
+                                "error": "standalone (elastic) worker "
+                                         "cannot join gang SPMD jobs"}):
+                lost_control = True
+                break
+            continue
         if cmd == "run_stream":
             # streamed (out-of-core) SPMD job: chunk waves + sharded
             # exchanges + host bucket spill (runtime/stream_cluster.py)
@@ -182,8 +201,13 @@ def main(argv=None) -> int:
                     release=tuple(msg.get("release") or ()),
                     store_compression=msg.get("store_compression"))
                 reply.update(extras)
-                if args.process_id == 0 and collect:
-                    reply["table"] = table
+                if collect == "count":
+                    if args.process_id == 0:
+                        reply["table"] = table
+                elif collect:
+                    # every worker ships ITS partitions' rows (parallel
+                    # collect); the driver concatenates parts in pid order
+                    reply["table_part"] = table
             except Exception:
                 reply = {"ok": False, "pid": args.process_id,
                          "job": msg.get("job"),
@@ -208,7 +232,8 @@ def main(argv=None) -> int:
         while os.getppid() == parent_pid:
             _time.sleep(1.0)
         return 0
-    jax.distributed.shutdown()
+    if not args.standalone:
+        jax.distributed.shutdown()
     return 0
 
 

@@ -172,6 +172,39 @@ def test_farm_locality_preference(cluster, tmp_path):
         f"only {on_pref}/{len(groups)} tasks ran on their preferred worker"
 
 
+def test_elastic_worker_joins_farm(cluster):
+    """Elastic membership (reference dynamic computer registration,
+    LocalScheduler/Queues.cs:104-137): a standalone worker registered
+    mid-life serves farm tasks alongside the gang — and gang SPMD jobs
+    keep working, ignoring it."""
+    if not cluster.alive():
+        cluster.restart()
+    plan_json, src_key = _farm_plan(cluster)
+    TaskFarm(cluster).run(plan_json, _tasks(cluster, src_key, 4)[1])  # warm
+    cluster.wait_quiescent()
+
+    new_pid = cluster.add_worker()
+    assert new_pid >= cluster.n_processes
+    try:
+        vals, per_task = _tasks(cluster, src_key, n_tasks=12)
+        # a uniform per-task delay makes participation deterministic:
+        # without it sub-10ms tasks can all finish on the warm gang
+        # before the joiner's first (import-heavy) task completes
+        farm = TaskFarm(cluster, delay_hook=lambda t, p: 0.3)
+        results = farm.run(plan_json, per_task)
+        _check(vals, results)
+        workers_used = {e["worker"] for e in farm.events
+                        if e["event"] == "task_done"}
+        assert new_pid in workers_used, farm.events
+        # gang SPMD jobs ignore the elastic worker and still succeed
+        ctx = Context(cluster=cluster)
+        assert ctx.from_columns(
+            {"v": np.arange(50, dtype=np.int32)}).count() == 50
+    finally:
+        # leave the module-scoped cluster gang-only for later tests
+        cluster.restart()
+
+
 def test_farm_over_store_partitions(cluster, tmp_path):
     """Per-task input = a group of store partitions (the reference's
     one-vertex-per-partition-file model, DrPartitionFile.cpp:607)."""

@@ -1,5 +1,7 @@
 """HTML job viewer tests (JobBrowser role, VERDICT r1 item 10)."""
 
+import json
+
 import numpy as np
 
 from dryad_tpu import Context
@@ -29,6 +31,59 @@ def test_job_report_html(tmp_path):
     assert "<line" in doc.split("Gantt")[0]
     with open(out) as f:
         assert f.read() == doc
+
+
+def test_job_report_multi_attempt_replay_stream():
+    """Viewer correctness on the streams it exists to diagnose (VERDICT
+    r2 weak 8): a hand-built deterministic event stream with a 2-attempt
+    overflow retry, a lineage replay, and a re-run — the DAG badges,
+    Gantt bars, and table aggregates must reflect the real history, not
+    just contain the labels."""
+    plan = json.dumps({"version": 1, "stages": [
+        {"id": 0, "label": "src", "legs": [{"src": {"source": True},
+                                           "ops": [], "exchange": None}],
+         "body": []},
+        {"id": 1, "label": "join",
+         "legs": [{"src": {"stage": 0}, "ops": [], "exchange": None}],
+         "body": []},
+    ], "out_stage": 1})
+    events = [
+        {"event": "plan", "plan": plan, "ts": 100.0},
+        # stage 0: one clean run
+        {"event": "stage_done", "stage": 0, "label": "src", "attempt": 0,
+         "scale": 1, "slack": 2, "overflow": False, "rows": [5, 5],
+         "out_bytes": 80, "compile_s": 1.0, "wall_s": 0.5, "ts": 101.0},
+        # stage 1: overflow attempt then right-sized success
+        {"event": "stage_done", "stage": 1, "label": "join", "attempt": 0,
+         "scale": 1, "slack": 2, "overflow": True, "rows": [9, 1],
+         "out_bytes": 80, "compile_s": 2.0, "wall_s": 0.3, "ts": 102.0},
+        {"event": "stage_done", "stage": 1, "label": "join", "attempt": 1,
+         "scale": 4, "slack": 2, "overflow": False, "rows": [9, 1],
+         "out_bytes": 320, "compile_s": 1.5, "wall_s": 0.4, "ts": 103.0},
+        # stage 1's output lost -> lineage replay re-runs it
+        {"event": "stage_replay", "stage": 1, "label": "join",
+         "failures": 1, "ts": 104.0},
+        {"event": "stage_done", "stage": 1, "label": "join", "attempt": 0,
+         "scale": 4, "slack": 2, "overflow": False, "rows": [9, 1],
+         "out_bytes": 320, "compile_s": 0.0, "wall_s": 0.4, "ts": 105.0},
+    ]
+    doc = job_report_html(events, title="replay stream")
+
+    # DAG: stage 1 carries the replay badge + critical ring; its tooltip
+    # counts 3 runs / 1 retry / 1 replay; the edge 0->1 is drawn
+    assert "replayed" in doc and "var(--critical)" in doc
+    assert "stage 1 join: 3 run(s), 1 retries, 1 replays" in doc
+    assert doc.count("<line") >= 1 + 4   # 1 DAG edge + 4+ Gantt gridlines
+
+    # Gantt: one bar per stage_done (4), the overflow attempt marked
+    gantt = doc.split('aria-label="stage Gantt"')[1]
+    assert gantt.count('class="bar"') == 4
+    assert gantt.count("overflow") == 2   # tooltip note + visible note
+
+    # table: aggregates per stage
+    assert "<td>3</td>" in doc           # stage 1 runs
+    assert ">1.1<" in doc or "1.100" in doc or "1.1s" in doc or \
+        "1.10" in doc  # stage 1 wall 0.3+0.4+0.4
 
 
 def test_job_report_html_marks_retries():
