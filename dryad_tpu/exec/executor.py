@@ -496,8 +496,27 @@ class Executor:
             bindings: Optional[Dict[str, PData]] = None,
             spill_dir: Optional[str] = None) -> PData:
         """Execute a graph with lineage-tracked recovery (exec.recovery.Run).
-        With spill_dir, stage outputs are durably materialized."""
+        With spill_dir, stage outputs are durably materialized.  With
+        JobConfig.profile_dir, the whole run is captured in a
+        jax.profiler device-time trace (xprof/TensorBoard viewable —
+        the Artemis device-timeline role)."""
         from dryad_tpu.exec.recovery import Run
+        prof = getattr(self.config, "profile_dir", None)
+        if prof:
+            import os
+
+            import jax
+            sub = prof
+            if jax.process_count() > 1:
+                sub = os.path.join(prof, f"worker-{jax.process_index()}")
+            elif os.environ.get("DRYAD_WORKER_ID"):
+                # standalone (elastic) workers run outside jax.distributed
+                # but still need per-worker trace attribution
+                sub = os.path.join(
+                    prof, f"worker-{os.environ['DRYAD_WORKER_ID']}")
+            with jax.profiler.trace(sub):
+                return Run(self, graph, bindings,
+                           spill_dir=spill_dir).output()
         return Run(self, graph, bindings, spill_dir=spill_dir).output()
 
     def _leg_input(self, leg, results, bindings) -> PData:

@@ -13,7 +13,13 @@ with its TCP channel fabric.
 
 from dryad_tpu.runtime.cluster import (ClusterJobError, LocalCluster,
                                        WorkerFailure)
+from dryad_tpu.runtime.interfaces import (ClusterBackend, cluster_backends,
+                                          make_cluster, register_cluster)
 from dryad_tpu.runtime.sources import DeferredSource
 
+# the built-in backend registers under "local" (Interfaces.cs:545 role)
+register_cluster("local", LocalCluster)
+
 __all__ = ["LocalCluster", "WorkerFailure", "ClusterJobError",
-           "DeferredSource"]
+           "DeferredSource", "ClusterBackend", "register_cluster",
+           "make_cluster", "cluster_backends"]

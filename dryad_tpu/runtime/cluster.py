@@ -42,8 +42,12 @@ def _free_port() -> int:
     return port
 
 
-class LocalCluster:
-    """N worker processes × D virtual devices each, on this machine.
+from dryad_tpu.runtime.interfaces import ClusterBackend
+
+
+class LocalCluster(ClusterBackend):
+    """N worker processes × D virtual devices each, on this machine — the
+    built-in "local" ClusterBackend (runtime/interfaces.py seam).
 
     The same control plane works for real multi-host TPU: workers would run
     one per host with real local chips (jax.distributed over the pod), the
@@ -175,6 +179,8 @@ class LocalCluster:
         standalone process outside the jax.distributed gang that serves
         independently schedulable farm tasks on its own local devices.
         Gang SPMD jobs ignore it.  Returns the new worker's pid."""
+        if not self.alive():
+            self.restart()   # also recreates the listener after teardown
         pid = self.n_processes + len(self._elastic_procs)
         control_port = self._listener.getsockname()[1]
         proc = self._spawn_worker(pid, None, control_port, standalone=True)
@@ -213,6 +219,32 @@ class LocalCluster:
 
     def gang_pids(self):
         return [p for p in self._socks if p not in self._elastic]
+
+    # public ClusterBackend aliases of the farm-facing surface
+    @property
+    def sockets(self) -> Dict[int, socket.socket]:
+        return self._socks
+
+    def recv_frames(self, pid: int, job: int):
+        return self._recv_frames(pid, job)
+
+    def log_tails(self) -> str:
+        return self._log_tails()
+
+    def _drop_elastic(self, pid: int) -> None:
+        """Remove one dead/unresponsive ELASTIC worker — optional members
+        never take the gang down with them."""
+        s = self._socks.pop(pid, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._bufs.pop(pid, None)
+        self._elastic.discard(pid)
+        proc = self._elastic_procs.pop(pid, None)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
 
     def worker_procs(self) -> Dict[int, subprocess.Popen]:
         """pid -> process for EVERY task-capable worker (gang + elastic)."""
@@ -343,12 +375,16 @@ class LocalCluster:
         prior farm run, for example).  Useful before timing-sensitive
         submissions."""
         job = self.next_job_id()
-        for pid, s in self._socks.items():
+        for pid, s in list(self._socks.items()):
             try:
                 s.setblocking(True)
                 protocol.send_msg(s, {"cmd": "ping", "job": job})
                 s.setblocking(False)
             except OSError:
+                if pid in self._elastic:
+                    # a dead OPTIONAL member never takes the gang down
+                    self._drop_elastic(pid)
+                    continue
                 self._kill_all()
                 raise WorkerFailure(
                     f"worker {pid} unreachable during quiescence ping"
@@ -357,6 +393,11 @@ class LocalCluster:
         deadline = time.time() + timeout
         while pending:
             if time.time() > deadline:
+                if pending <= self._elastic:
+                    # only optional members are silent: drop them
+                    for pid in list(pending):
+                        self._drop_elastic(pid)
+                    return
                 raise WorkerFailure(
                     f"workers {sorted(pending)} not quiescent after "
                     f"{timeout}s" + self._log_tails())
@@ -366,6 +407,10 @@ class LocalCluster:
                 pid = socks[s]
                 frames, ok = self._recv_frames(pid, job)
                 if not ok:
+                    if pid in self._elastic:
+                        self._drop_elastic(pid)
+                        pending.discard(pid)
+                        continue
                     self._kill_all()
                     raise WorkerFailure(
                         f"worker {pid} closed its control connection"
@@ -432,23 +477,28 @@ class LocalCluster:
             for e in replies[0].get("events", []):
                 self.event_log(dict(e, worker=0))
         reply0 = dict(replies.get(0, {}))
-        if collect is True and any("table_part" in r
-                                   for r in replies.values()):
+        # same gate as the workers (any truthy non-"count" collect ships
+        # table parts) — an identity check would silently discard them
+        if collect and collect != "count" and any(
+                "table_part" in r for r in replies.values()):
             # parallel collect: merge per-worker parts in pid order
-            # (= partition order)
-            merged: Dict[str, Any] = {}
+            # (= partition order); gather all parts per column first so
+            # each column is ONE extend/concatenate, not W re-copies
+            import numpy as _np
+            parts_by_col: Dict[str, list] = {}
             for pid in sorted(replies):
                 part = replies[pid].get("table_part")
                 if not part:
                     continue
                 for k, v in part.items():
-                    if k not in merged:
-                        merged[k] = list(v) if isinstance(v, list) else v
-                    elif isinstance(v, list):
-                        merged[k] = list(merged[k]) + v
-                    else:
-                        import numpy as _np
-                        merged[k] = _np.concatenate([merged[k], v])
+                    parts_by_col.setdefault(k, []).append(v)
+            merged: Dict[str, Any] = {}
+            for k, parts in parts_by_col.items():
+                if isinstance(parts[0], list):
+                    merged[k] = [x for p in parts for x in p]
+                else:
+                    merged[k] = (parts[0] if len(parts) == 1
+                                 else _np.concatenate(parts))
             reply0["table"] = merged
         return reply0
 

@@ -138,8 +138,8 @@ class TaskFarm:
         # time (which would falsely retire a healthy worker)
         idle: set = set()
         ping_t: Dict[int, float] = {}
-        for pid in list(cl._socks):
-            sock = cl._socks[pid]
+        for pid in list(cl.sockets):
+            sock = cl.sockets[pid]
             try:
                 sock.setblocking(True)
                 protocol.send_msg(sock, {"cmd": "ping", "job": job})
@@ -159,7 +159,7 @@ class TaskFarm:
         def dispatch(task: _Task, pid: int) -> bool:
             delay = (self.delay_hook(task.idx, pid)
                      if self.delay_hook else 0.0)
-            sock = cl._socks[pid]
+            sock = cl.sockets[pid]
             try:
                 sock.setblocking(True)
                 protocol.send_msg(sock, {"cmd": "run_task",
@@ -178,7 +178,7 @@ class TaskFarm:
             idle.discard(pid)
             return True
 
-        n_workers_total = len(cl._socks)   # gang + elastic at farm start
+        n_workers_total = len(cl.sockets)   # gang + elastic at farm start
 
         def worker_lost(pid: int) -> None:
             dead.add(pid)
@@ -192,7 +192,7 @@ class TaskFarm:
                             "worker": pid})
             if len(dead) >= n_workers_total:
                 raise WorkerFailure(
-                    "all workers died during task farm" + cl._log_tails())
+                    "all workers died during task farm" + cl.log_tails())
 
         while n_done < len(tasks):
             if deadline is not None and time.time() > deadline:
@@ -274,14 +274,14 @@ class TaskFarm:
             for pid, proc in cl.worker_procs().items():
                 if pid not in dead and proc.poll() is not None:
                     worker_lost(pid)
-            live = {cl._socks[pid]: pid for pid in cl._socks
+            live = {cl.sockets[pid]: pid for pid in cl.sockets
                     if pid not in dead}
             if not live:
-                raise WorkerFailure("no live workers" + cl._log_tails())
+                raise WorkerFailure("no live workers" + cl.log_tails())
             ready, _, _ = select.select(list(live), [], [], 0.1)
             for sock in ready:
                 pid = live[sock]
-                frames, ok = cl._recv_frames(pid, job)
+                frames, ok = cl.recv_frames(pid, job)
                 if not ok:
                     worker_lost(pid)
                     continue

@@ -50,6 +50,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     _configure_jax(args.platform, args.devices_per_process)
+    # worker identity for subsystems outside jax.distributed (standalone
+    # elastic workers have process_count==1 — profiler traces etc. still
+    # need per-worker attribution)
+    os.environ["DRYAD_WORKER_ID"] = str(args.process_id)
     import jax
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")

@@ -34,6 +34,12 @@ class JobConfig:
     range_samples_per_partition: int = 4096
     # compiled-stage LRU entries (per executor)
     compile_cache_size: int = 256
+    # device-time profiling: when set, every executor run is wrapped in a
+    # jax.profiler trace written under this directory (open with
+    # TensorBoard / xprof — the device-timeline view the reference
+    # surfaces through Artemis; SURVEY.md §5 tracing).  Workers profile
+    # into per-process subdirectories.
+    profile_dir: Optional[str] = None
     # hot-key salting (exec/executor.py + parallel/shuffle.py
     # skew_join_exchange, DrDynamicDistributor.h:79 role): a saltable join
     # stage switches to the salted exchange when a retry would need

@@ -102,3 +102,91 @@ def test_text_defaults_from_config(tmp_path):
     ctx = Context(config=JobConfig(text_max_line_len=4))
     out = ctx.read_text(p).collect()
     assert out["line"] == [b"abcd", b"klm"]   # truncation knob applied
+
+
+def test_profile_dir_writes_device_trace(tmp_path):
+    """JobConfig.profile_dir wraps executor runs in a jax.profiler trace
+    (the Artemis device-timeline role, SURVEY.md §5) — real xplane/trace
+    artifacts must land under the directory."""
+    import glob
+
+    import numpy as np
+    d = str(tmp_path / "prof")
+    ctx = Context(config=JobConfig(profile_dir=d))
+    out = ctx.from_columns({"k": np.arange(500, dtype=np.int32) % 5,
+                            "v": np.arange(500, dtype=np.int32)}).group_by(
+        ["k"], {"s": ("sum", "v")}).collect()
+    assert len(out["k"]) == 5
+    hits = (glob.glob(d + "/**/*.xplane.pb", recursive=True)
+            + glob.glob(d + "/**/*.trace.json.gz", recursive=True))
+    assert hits, "no profiler artifacts written"
+
+
+def test_cluster_backend_factory_registry():
+    """ICluster/IScheduler factory seam (Interfaces.cs:324,491,545): the
+    built-in backend registers as "local"; new deployment targets plug in
+    by name without touching the core."""
+    import pytest
+
+    from dryad_tpu.runtime import (ClusterBackend, LocalCluster,
+                                   cluster_backends, make_cluster,
+                                   register_cluster)
+    from dryad_tpu.runtime.interfaces import _FACTORIES
+
+    assert "local" in cluster_backends()
+    assert _FACTORIES["local"] is LocalCluster
+    assert issubclass(LocalCluster, ClusterBackend)
+
+    class Dummy(ClusterBackend):
+        n_processes = 1
+        event_log = None
+
+        def __init__(self, tag="x"):
+            self.tag = tag
+
+        @property
+        def nparts(self):
+            return 1
+
+        def alive(self):
+            return True
+
+        def restart(self):
+            pass
+
+        def shutdown(self):
+            pass
+
+        def next_job_id(self):
+            return 1
+
+        def execute(self, plan_json, source_specs, **kw):
+            return {}
+
+        def execute_stream(self, spec_json, plan_json, **kw):
+            return {}
+
+        @property
+        def sockets(self):
+            return {}
+
+        def worker_procs(self):
+            return {}
+
+        def recv_frames(self, pid, job):
+            return [], True
+
+        def retire_worker(self, pid):
+            pass
+
+        def log_tails(self):
+            return ""
+
+    register_cluster("dummy", Dummy)
+    try:
+        cl = make_cluster("dummy", tag="hello")
+        assert isinstance(cl, Dummy) and cl.tag == "hello"
+        with pytest.raises(KeyError, match="no cluster backend"):
+            make_cluster("nope")
+    finally:
+        _FACTORIES.pop("dummy", None)
