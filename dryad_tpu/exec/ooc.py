@@ -47,7 +47,8 @@ from dryad_tpu.ops.hashing import hash_batch_keys
 
 __all__ = [
     "HChunk", "ChunkSource", "stream_map", "external_sort",
-    "streaming_group_aggregate", "streaming_distinct",
+    "streaming_group_aggregate", "streaming_group_decomposable",
+    "streaming_group_topk", "streaming_distinct",
     "write_chunks_to_store", "OOCError",
 ]
 
@@ -723,44 +724,72 @@ def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
     yielded.  Distinct keys per bucket must fit chunk capacity; raise
     ``n_buckets`` for higher-cardinality keys.
     """
+    n_buckets, depth = _resolve_bucket_knobs(n_buckets, depth)
+    from dryad_tpu.plan.planner import _decompose_aggs
+
+    partial, final, mean_cols = _decompose_aggs(dict(aggs))
+    pagg = jax.jit(lambda b: kernels.group_aggregate(b, list(keys), partial))
+    merge = jax.jit(lambda b: kernels.group_aggregate(b, list(keys), final))
+
+    def final_fn(b):
+        m = kernels.group_aggregate(b, list(keys), final)
+        return Batch(kernels.mean_finalize_columns(dict(m.columns),
+                                                   mean_cols), m.count)
+
+    probe = _batch_to_chunk(pagg(_chunk_to_batch(
+        HChunk.empty_like(src.schema), 1)))
+    yield from _hash_bucketed_reduce(src, keys, pagg, merge,
+                                     jax.jit(final_fn),
+                                     chunk_schema(probe), n_buckets,
+                                     depth, "group")
+
+
+# ---------------------------------------------------------------------------
+# shared hash-bucketed streaming reduction machinery
+#
+# ONE implementation of the scatter/accumulate/compact/finalize pipeline
+# that streaming_group_aggregate, streaming_group_decomposable, and
+# streaming_distinct all ride (the streaming form of the reference's
+# dynamic aggregation trees, DrDynamicAggregateManager.cpp): per chunk a
+# LOCAL device reduction, hash-scatter of its rows into key buckets,
+# host-side accumulation with device-side COMPACTION whenever a bucket
+# would exceed the chunk capacity, then a per-bucket FINALIZE.
+
+
+def _resolve_bucket_knobs(n_buckets, depth):
     if depth is None or n_buckets is None:
         from dryad_tpu.utils.config import JobConfig
         _cfg = JobConfig()
         depth = depth if depth is not None else _cfg.ooc_inflight
         n_buckets = (n_buckets if n_buckets is not None
                      else _cfg.ooc_hash_buckets)
-    from dryad_tpu.plan.planner import _decompose_aggs
+    return n_buckets, depth
 
-    partial, final, mean_cols = _decompose_aggs(dict(aggs))
+
+def _hash_bucketed_reduce(src: ChunkSource, keys: Sequence[str],
+                          local_fn, compact_fn, final_fn,
+                          row_schema, n_buckets: int, depth: int,
+                          what: str) -> Iterator[HChunk]:
+    """local_fn: per-chunk device reduction (jitted Batch -> Batch);
+    compact_fn: associative device re-reduction of accumulated bucket
+    rows; final_fn: per-bucket finishing pass.  ``row_schema`` is the
+    schema of local_fn's output rows.  Distinct reduced rows per bucket
+    must fit the chunk capacity — raise n_buckets otherwise."""
     chunk_rows = src.chunk_rows
-
-    pagg = jax.jit(lambda b: kernels.group_aggregate(b, list(keys), partial))
-    merge = jax.jit(lambda b: kernels.group_aggregate(b, list(keys), final))
-    finalize = jax.jit(
-        lambda b: Batch(kernels.mean_finalize_columns(dict(b.columns),
-                                                      mean_cols), b.count))
-
-    # schema of partial outputs (probe with an empty chunk)
-    probe = _batch_to_chunk(pagg(_chunk_to_batch(
-        HChunk.empty_like(src.schema), 1)))
-    pschema = chunk_schema(probe)
     scatter = _make_hash_scatter_fn(tuple(keys), n_buckets)
 
     buckets: List[List[HChunk]] = [[] for _ in range(n_buckets)]
     bucket_rows = [0] * n_buckets
 
     def compact_bucket(i: int) -> None:
-        # invariant: accumulated fragments total <= chunk_rows, so the
-        # concat fits the device chunk; merging shrinks it to the bucket's
-        # distinct keys
-        merged = _concat_hchunks(pschema, buckets[i])
-        out = _batch_to_chunk(merge(_chunk_to_batch(merged, chunk_rows)))
+        merged = _concat_hchunks(row_schema, buckets[i])
+        out = _batch_to_chunk(compact_fn(
+            _chunk_to_batch(merged, chunk_rows)))
         buckets[i] = [out]
         bucket_rows[i] = out.n
 
-    def add_partials(ph: HChunk) -> None:
-        b = _chunk_to_batch(ph, chunk_rows)
-        grouped, hist = scatter(b)
+    def add_rows(ph: HChunk) -> None:
+        grouped, hist = scatter(_chunk_to_batch(ph, chunk_rows))
         gh = _batch_to_chunk(grouped)
         h = np.asarray(hist)
         offs = np.cumsum(np.concatenate([[0], h]))
@@ -769,30 +798,132 @@ def streaming_group_aggregate(src: ChunkSource, keys: Sequence[str],
             if frag.n == 0:
                 continue
             if bucket_rows[i] + frag.n > chunk_rows:
-                compact_bucket(i)  # merge down to distinct keys first
+                compact_bucket(i)
                 if bucket_rows[i] + frag.n > chunk_rows:
                     raise OOCError(
-                        f"bucket {i} holds {bucket_rows[i]} distinct "
-                        f"groups; with {frag.n} incoming it exceeds chunk "
-                        f"capacity {chunk_rows}; raise n_buckets")
+                        f"{what} bucket {i} holds {bucket_rows[i]} "
+                        f"reduced rows; with {frag.n} incoming it exceeds "
+                        f"chunk capacity {chunk_rows}; raise n_buckets")
             buckets[i].append(frag)
             bucket_rows[i] += frag.n
 
     pending: deque = deque()
     for chunk in src:
-        pending.append(pagg(_chunk_to_batch(chunk, chunk_rows)))
+        pending.append(local_fn(_chunk_to_batch(chunk, chunk_rows)))
         if len(pending) >= depth:
-            add_partials(_batch_to_chunk(pending.popleft()))
+            add_rows(_batch_to_chunk(pending.popleft()))
     while pending:
-        add_partials(_batch_to_chunk(pending.popleft()))
+        add_rows(_batch_to_chunk(pending.popleft()))
+
+    for i in range(n_buckets):
+        if bucket_rows[i] == 0:
+            continue
+        merged = _concat_hchunks(row_schema, buckets[i])
+        yield _batch_to_chunk(final_fn(
+            _chunk_to_batch(merged, chunk_rows)))
+
+
+# ---------------------------------------------------------------------------
+# streaming user-decomposable aggregation (IDecomposable over streams)
+
+
+def streaming_group_decomposable(src: ChunkSource, keys: Sequence[str],
+                                 decs: Dict[str, Any],
+                                 n_buckets: int | None = None,
+                                 depth: int | None = None
+                                 ) -> Iterator[HChunk]:
+    """GroupBy with USER-DEFINED Decomposable aggregates over an
+    arbitrarily large chunk stream: per-chunk seed+merge (map-side
+    combine) -> hash-scatter of flattened states into key buckets ->
+    periodic device-side merge compaction -> FinalReduce per bucket.
+    The streamed form of the dgroup partial/merge lowering
+    (plan/planner._lower_group_decomposable; IDecomposable.cs:34)."""
+    n_buckets, depth = _resolve_bucket_knobs(n_buckets, depth)
+    keys_l = list(keys)
+    box: Dict[str, Any] = {}
+    pagg = jax.jit(lambda b: kernels.group_decompose_partial(
+        b, keys_l, decs, box))
+    merge = jax.jit(lambda b: kernels.group_decompose_merge(
+        b, keys_l, decs, box, False))
+    fin = jax.jit(lambda b: kernels.group_decompose_merge(
+        b, keys_l, decs, box, True))
+    # partial-state schema probe (also fills the treedef box before any
+    # merge traces — partials always trace first)
+    probe = _batch_to_chunk(pagg(_chunk_to_batch(
+        HChunk.empty_like(src.schema), 1)))
+    yield from _hash_bucketed_reduce(src, keys, pagg, merge, fin,
+                                     chunk_schema(probe), n_buckets,
+                                     depth, "decomposable-group")
+
+
+# ---------------------------------------------------------------------------
+# streaming per-group top-k (group contents over streams)
+
+
+def streaming_group_topk(src: ChunkSource, keys: Sequence[str], k: int,
+                         by: str, descending: bool = True,
+                         n_buckets: int | None = None,
+                         depth: int | None = None) -> Iterator[HChunk]:
+    """Per-group top-k rows over an arbitrarily large stream.  Top-k is
+    idempotent under composition (top-k of accumulated top-ks = global
+    top-k), so buckets accumulate candidate rows and re-compact with the
+    group_top_k kernel whenever they exceed the chunk capacity; bucket
+    residency is bounded by k x (distinct keys in the bucket).  (Not a
+    _hash_bucketed_reduce client: top-k buckets may legitimately exceed
+    the chunk capacity pre-compaction, so it compacts at pow2 device
+    sizes instead of the fixed chunk bound.)"""
+    n_buckets, depth = _resolve_bucket_knobs(n_buckets, depth)
+    chunk_rows = src.chunk_rows
+    keys_l = list(keys)
+    topk = jax.jit(lambda b: kernels.group_top_k(b, keys_l, k, by,
+                                                 descending))
+    scatter = _make_hash_scatter_fn(tuple(keys), n_buckets)
+
+    buckets: List[List[HChunk]] = [[] for _ in range(n_buckets)]
+    bucket_rows = [0] * n_buckets
+
+    def compact_bucket(i: int) -> None:
+        merged = _concat_hchunks(src.schema, buckets[i])
+        capm = 1
+        while capm < max(merged.n, 1):
+            capm *= 2
+        out = _batch_to_chunk(topk(_chunk_to_batch(merged, capm)))
+        if out.n > chunk_rows:
+            raise OOCError(
+                f"top-{k} bucket {i} holds {out.n} rows (> chunk capacity "
+                f"{chunk_rows}) even after compaction; raise n_buckets")
+        buckets[i] = [out]
+        bucket_rows[i] = out.n
+
+    def add_rows(ch: HChunk) -> None:
+        grouped, hist = scatter(_chunk_to_batch(ch, chunk_rows))
+        gh = _batch_to_chunk(grouped)
+        h = np.asarray(hist)
+        offs = np.cumsum(np.concatenate([[0], h]))
+        for i in range(n_buckets):
+            frag = _slice_hchunk(gh, int(offs[i]), int(offs[i + 1]))
+            if frag.n == 0:
+                continue
+            if bucket_rows[i] + frag.n > chunk_rows:
+                compact_bucket(i)
+            buckets[i].append(frag)
+            bucket_rows[i] += frag.n
+
+    pending: deque = deque()
+    for chunk in src:
+        # local pre-trim: a chunk never contributes more than top-k per
+        # group it holds
+        pending.append(topk(_chunk_to_batch(chunk, chunk_rows)))
+        if len(pending) >= depth:
+            add_rows(_batch_to_chunk(pending.popleft()))
+    while pending:
+        add_rows(_batch_to_chunk(pending.popleft()))
 
     for i in range(n_buckets):
         if bucket_rows[i] == 0:
             continue
         compact_bucket(i)
-        out = _batch_to_chunk(finalize(_chunk_to_batch(buckets[i][0],
-                                                       chunk_rows)))
-        yield out
+        yield buckets[i][0]
 
 
 # ---------------------------------------------------------------------------
@@ -816,58 +947,12 @@ def streaming_distinct(src: ChunkSource, keys: Sequence[str] = (),
     chunk — raise ``n_buckets`` for higher cardinality).  The streaming
     form of distinct-before-and-after-exchange (plan/planner.py Distinct
     lowering)."""
-    if depth is None or n_buckets is None:
-        from dryad_tpu.utils.config import JobConfig
-        _cfg = JobConfig()
-        depth = depth if depth is not None else _cfg.ooc_inflight
-        n_buckets = (n_buckets if n_buckets is not None
-                     else _cfg.ooc_hash_buckets)
-    chunk_rows = src.chunk_rows
+    n_buckets, depth = _resolve_bucket_knobs(n_buckets, depth)
     key_names = tuple(keys) or tuple(sorted(src.schema))
     dd = _make_distinct_fn(tuple(keys) if keys else None)
-    scatter = _make_hash_scatter_fn(key_names, n_buckets)
-
-    buckets: List[List[HChunk]] = [[] for _ in range(n_buckets)]
-    bucket_rows = [0] * n_buckets
-
-    def compact_bucket(i: int) -> None:
-        merged = _concat_hchunks(src.schema, buckets[i])
-        out = _batch_to_chunk(dd(_chunk_to_batch(merged, chunk_rows)))
-        buckets[i] = [out]
-        bucket_rows[i] = out.n
-
-    def add_rows(ch: HChunk) -> None:
-        grouped, hist = scatter(_chunk_to_batch(ch, chunk_rows))
-        gh = _batch_to_chunk(grouped)
-        h = np.asarray(hist)
-        offs = np.cumsum(np.concatenate([[0], h]))
-        for i in range(n_buckets):
-            frag = _slice_hchunk(gh, int(offs[i]), int(offs[i + 1]))
-            if frag.n == 0:
-                continue
-            if bucket_rows[i] + frag.n > chunk_rows:
-                compact_bucket(i)
-                if bucket_rows[i] + frag.n > chunk_rows:
-                    raise OOCError(
-                        f"distinct bucket {i} holds {bucket_rows[i]} "
-                        f"distinct rows; with {frag.n} incoming it exceeds "
-                        f"chunk capacity {chunk_rows}; raise n_buckets")
-            buckets[i].append(frag)
-            bucket_rows[i] += frag.n
-
-    pending: deque = deque()
-    for chunk in src:
-        pending.append(dd(_chunk_to_batch(chunk, chunk_rows)))
-        if len(pending) >= depth:
-            add_rows(_batch_to_chunk(pending.popleft()))
-    while pending:
-        add_rows(_batch_to_chunk(pending.popleft()))
-
-    for i in range(n_buckets):
-        if bucket_rows[i] == 0:
-            continue
-        compact_bucket(i)
-        yield buckets[i][0]
+    yield from _hash_bucketed_reduce(src, key_names, dd, dd, dd,
+                                     src.schema, n_buckets, depth,
+                                     "distinct")
 
 
 # ---------------------------------------------------------------------------

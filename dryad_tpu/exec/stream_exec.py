@@ -73,19 +73,18 @@ class StreamSource:
 _LOCAL_KINDS = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
                 "apply", "recap"}
 # op kinds with whole-stream semantics, each lowered to an ooc primitive
-_STREAM_KINDS = {"sort", "group", "distinct", "take", "skip", "row_index"}
+_STREAM_KINDS = {"sort", "group", "dgroup_local", "distinct",
+                 "group_top_k", "take", "skip", "row_index"}
 
 _UNSUPPORTED_HINTS = {
     "zip": "zip_with needs global row alignment",
     "sliding_window": "sliding_window needs cross-chunk halos",
     "take_while": "take_while/skip_while are not yet streamed",
     "skip_while": "take_while/skip_while are not yet streamed",
-    "dgroup_local": "user Decomposable aggregates are not yet streamed — "
-                    "use builtin aggregate kinds",
     "group_apply": "group_apply is not yet streamed — use group_by "
-                   "aggregates or the in-memory path",
-    "group_top_k": "group_top_k is not yet streamed",
-    "group_rank": "group_median/rank is not yet streamed",
+                   "aggregates, group_top_k, or the in-memory path",
+    "group_rank": "group_median/rank needs whole groups materialized "
+                  "(medians do not compose) — not yet streamed",
 }
 
 
@@ -157,13 +156,32 @@ def _ops_out_capacity(in_cap: int, ops: List[StageOp]) -> int:
 
 def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
                   extra_right: Optional[Batch] = None,
+                  right_chunk: Optional[HChunk] = None,
                   body_op: Optional[StageOp] = None) -> ChunkSource:
     """Fuse a run of chunk-local ops (plus an optional binary body op with
     a materialized right side) into one jitted program and stream chunks
-    through it, double-buffered, with per-chunk right-sized retries."""
+    through it, double-buffered, with per-chunk right-sized retries.
+
+    Right/full outer joins track which right rows matched ANY chunk
+    (kernels.right_match_mask accumulated host-side) and append the
+    unmatched right rows as a final synthetic chunk — the cross-chunk
+    form of hash_join's in-batch synthesis."""
     chunk_rows = cs.chunk_rows
     depth = config.ooc_inflight
     fns: Dict[int, Any] = {}
+
+    join_how = (body_op.params.get("how", "inner")
+                if body_op is not None and body_op.kind == "join" else None)
+    track_right = join_how in ("right", "full")
+    if track_right:
+        # run the per-chunk joins as inner/left; unmatched right rows are
+        # synthesized once at end-of-stream
+        body_exec = StageOp("join", dict(
+            body_op.params, how="left" if join_how == "full" else "inner"))
+        lkeys = list(body_op.params["left_keys"])
+        rkeys = list(body_op.params["right_keys"])
+    else:
+        body_exec = body_op
 
     def build(scale: int):
         # the (possibly large) build side rides as a jit ARGUMENT — a
@@ -174,18 +192,28 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
             for op in ops:
                 b, need = _local_op(b, op, scale)
                 need_all = jnp.maximum(need_all, need)
-            if body_op is not None:
-                b, need = _body_binary(b, right, body_op, scale)
+            matched = jnp.zeros((), jnp.int32)
+            if track_right:
+                matched = kernels.right_match_mask(b, right, lkeys, rkeys)
+            if body_exec is not None:
+                b, need = _body_binary(b, right, body_exec, scale)
                 need_all = jnp.maximum(need_all, need)
-            return b, need_all
+            return b, need_all, matched
         return jax.jit(f)
 
     # probe the output schema with one empty chunk (the probe program IS
-    # the scale-1 program — cache it)
+    # the scale-1 program — cache it).  For right-tracking joins, also
+    # probe the LEFT-side column names (post leg ops) for synth naming.
     fns[1] = build(1)
-    probe_b, _ = fns[1](_chunk_to_batch(HChunk.empty_like(cs.schema), 1),
-                        extra_right)
+    probe_b, _, _ = fns[1](_chunk_to_batch(HChunk.empty_like(cs.schema), 1),
+                           extra_right)
     out_schema = chunk_schema(_batch_to_chunk(probe_b))
+    left_names: List[str] = []
+    if track_right:
+        lp = _chunk_to_batch(HChunk.empty_like(cs.schema), 1)
+        for op in ops:
+            lp, _ = _local_op(lp, op, 1)
+        left_names = list(lp.columns.keys())
     out_cap = _ops_out_capacity(chunk_rows, ops)
     if body_op is not None and body_op.kind == "join":
         out_cap = body_op.params["out_capacity"]
@@ -203,20 +231,7 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
         return chunk, _fn_for(1)(_chunk_to_batch(chunk, chunk_rows),
                                  extra_right)
 
-    def drain(entry) -> Iterator[HChunk]:
-        chunk, (out, need) = entry
-        scale = 1
-        need_i = int(need)
-        while need_i > 0:
-            if need_i >= _LOCAL_UNSCALABLE:
-                raise OOCError(
-                    "a fixed-capacity op (with_capacity) overflowed in "
-                    "streamed execution; raise the declared capacity")
-            scale = max(scale + 1, need_i)
-            out, need = _fn_for(scale)(
-                _chunk_to_batch(chunk, chunk_rows), extra_right)
-            need_i = int(need)
-        oc = _batch_to_chunk(out)
+    def _slices(oc: HChunk) -> Iterator[HChunk]:
         # slice oversized outputs so downstream chunk programs keep their
         # static capacity (out_cap is the declared per-chunk bound)
         for s in range(0, max(oc.n, 1), out_cap):
@@ -227,15 +242,93 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
                 return
 
     def it():
+        matched_acc = (np.zeros((extra_right.capacity,), bool)
+                       if track_right else None)
         pending: deque = deque()
+
+        def drain(entry) -> Iterator[HChunk]:
+            nonlocal matched_acc
+            chunk, (out, need, matched) = entry
+            scale = 1
+            need_i = int(need)
+            while need_i > 0:
+                if need_i >= _LOCAL_UNSCALABLE:
+                    raise OOCError(
+                        "a fixed-capacity op (with_capacity) overflowed "
+                        "in streamed execution; raise the declared "
+                        "capacity")
+                scale = max(scale + 1, need_i)
+                out, need, matched = _fn_for(scale)(
+                    _chunk_to_batch(chunk, chunk_rows), extra_right)
+                need_i = int(need)
+            if matched_acc is not None:
+                matched_acc |= np.asarray(matched)
+            yield from _slices(_batch_to_chunk(out))
+
         for chunk in cs:
             pending.append(launch(chunk))
             if len(pending) >= depth:
                 yield from drain(pending.popleft())
         while pending:
             yield from drain(pending.popleft())
+        if track_right:
+            synth = _synth_unmatched_right(
+                right_chunk, matched_acc, out_schema, left_names,
+                lkeys, rkeys)
+            if synth.n:
+                yield from _slices(synth)
 
     return ChunkSource(it, out_schema, out_cap)
+
+
+def _synth_unmatched_right(right_chunk: HChunk, matched: "np.ndarray",
+                           out_schema, left_names, lkeys, rkeys) -> HChunk:
+    """Host-side synthesis of the unmatched right rows of a streamed
+    right/full join: left key columns carry the right key values, other
+    left columns zero-fill, right non-key columns pass through (same
+    naming/widths as hash_join's output)."""
+    n = right_chunk.n
+    idx = np.nonzero(~matched[:n])[0]
+    u = len(idx)
+    key_map = dict(zip(lkeys, rkeys))
+    rkeyset = set(rkeys)
+    cols: Dict[str, Any] = {}
+    # naming mirror of hash_join: right non-key columns keep their name
+    # unless it collides with a left column (then + "_r")
+    rnames = {}
+    for k in right_chunk.cols:
+        if k in rkeyset:
+            continue
+        rnames[k] = k if k not in left_names else k + "_r"
+
+    def fit_str(data, lens, spec):
+        L = spec["max_len"]
+        outd = np.zeros((u, L), np.uint8)
+        w = min(L, data.shape[1])
+        outd[:, :w] = data[idx][:, :w]
+        return outd, np.minimum(lens[idx], L).astype(np.int32)
+
+    for name, spec in out_schema.items():
+        src = None
+        if name in key_map:
+            src = right_chunk.cols[key_map[name]]
+        else:
+            for k, nm in rnames.items():
+                if nm == name:
+                    src = right_chunk.cols[k]
+                    break
+        if src is not None:
+            if spec["kind"] == "str":
+                cols[name] = fit_str(src[0], src[1], spec)
+            else:
+                cols[name] = src[idx].astype(np.dtype(spec["dtype"]))
+        elif spec["kind"] == "str":
+            cols[name] = (np.zeros((u, spec["max_len"]), np.uint8),
+                          np.zeros((u,), np.int32))
+        else:
+            cols[name] = np.zeros((u,) + tuple(spec.get("shape", ())),
+                                  np.dtype(spec["dtype"]))
+    return HChunk(cols, u)
 
 
 # ---------------------------------------------------------------------------
@@ -246,15 +339,10 @@ def _body_binary(left: Batch, right: Batch, op: StageOp, scale: int):
     k, p = op.kind, op.params
     no = jnp.zeros((), jnp.int32)
     if k == "join":
-        how = p.get("how", "inner")
-        if how not in ("inner", "left"):
-            raise StreamExecutionError(
-                f"streamed join supports how=inner/left (got {how!r}): "
-                f"right/full must track unmatched right rows across the "
-                f"whole stream")
         out, need_rows = kernels.hash_join(
             left, right, list(p["left_keys"]), list(p["right_keys"]),
-            out_capacity=p["out_capacity"] * scale, how=how)
+            out_capacity=p["out_capacity"] * scale,
+            how=p.get("how", "inner"))
         need = -(-need_rows // jnp.int32(p["out_capacity"]))
         return out, need.astype(jnp.int32)
     if k == "apply2":
@@ -266,9 +354,12 @@ def _body_binary(left: Batch, right: Batch, op: StageOp, scale: int):
     raise _unsupported(k)
 
 
-def _materialize_small(cs: ChunkSource, config, what: str) -> Batch:
+def _materialize_small(cs: ChunkSource, config, what: str
+                       ) -> Tuple[Batch, HChunk]:
     """Concatenate a (small) chunk stream into ONE device batch — the
-    build side of streamed joins.  Bounded by ooc_join_build_rows."""
+    build side of streamed joins.  Bounded by ooc_join_build_rows.
+    Returns (device batch, the merged host chunk) — right/full joins
+    synthesize unmatched rows from the host copy."""
     frags = [c for c in cs if c.n]
     total = sum(f.n for f in frags)
     limit = config.ooc_join_build_rows
@@ -279,7 +370,7 @@ def _materialize_small(cs: ChunkSource, config, what: str) -> Batch:
             f"materialize that side on device — shrink it (pre-aggregate/"
             f"filter) or raise the knob")
     merged = _concat_hchunks(cs.schema, frags)
-    return _chunk_to_batch(merged, max(total, 1))
+    return _chunk_to_batch(merged, max(total, 1)), merged
 
 
 # ---------------------------------------------------------------------------
@@ -301,9 +392,6 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
     if k == "group":
         keys = list(p["keys"])
         aggs = dict(p["aggs"])
-        for spec in aggs.values():
-            if not (isinstance(spec, tuple) and len(spec) == 2):
-                raise _unsupported("dgroup_local")
         probe = _batch_to_chunk(jax.jit(
             lambda b: kernels.group_aggregate(b, keys, aggs))(
                 _chunk_to_batch(HChunk.empty_like(cs.schema), 1)))
@@ -315,6 +403,31 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
                 depth=config.ooc_inflight)
 
         return ChunkSource(it_group, schema, cs.chunk_rows)
+    if k == "dgroup_local":
+        # user Decomposable aggregates (IDecomposable.cs:34) over streams
+        keys = list(p["keys"])
+        decs = dict(p["decs"])
+        probe = _batch_to_chunk(jax.jit(
+            lambda b: kernels.group_decompose_local(b, keys, decs, {}))(
+                _chunk_to_batch(HChunk.empty_like(cs.schema), 1)))
+        schema = chunk_schema(probe)
+
+        def it_dgroup():
+            return ooc.streaming_group_decomposable(
+                cs, keys, decs, n_buckets=config.ooc_hash_buckets,
+                depth=config.ooc_inflight)
+
+        return ChunkSource(it_dgroup, schema, cs.chunk_rows)
+    if k == "group_top_k":
+        keys = list(p["keys"])
+
+        def it_topk():
+            return ooc.streaming_group_topk(
+                cs, keys, p["k"], p["by"], p["descending"],
+                n_buckets=config.ooc_hash_buckets,
+                depth=config.ooc_inflight)
+
+        return ChunkSource(it_topk, cs.schema, cs.chunk_rows)
     if k == "distinct":
         keys = tuple(p["keys"])
 
@@ -494,10 +607,10 @@ def run_stream_graph(graph: StageGraph, config,
         rest = legs_cs[1:]
         for op in st.body:
             if op.kind in ("join", "apply2", "semi_anti"):
-                right = _materialize_small(rest.pop(0), config,
-                                           "right/build")
-                cur = _stream_local(cur, [], config, extra_right=right,
-                                    body_op=op)
+                right_b, right_h = _materialize_small(rest.pop(0), config,
+                                                      "right/build")
+                cur = _stream_local(cur, [], config, extra_right=right_b,
+                                    right_chunk=right_h, body_op=op)
             elif op.kind == "concat":
                 cur = _concat_sources(cur, rest.pop(0))
             elif op.kind in _STREAM_KINDS:

@@ -918,6 +918,30 @@ def zip2(a: Batch, b: Batch, suffix: str = "_r") -> Batch:
     return Batch(cols, jnp.minimum(a.count, b.count))
 
 
+def right_match_mask(left: Batch, right: Batch, left_keys: Sequence[str],
+                     right_keys: Sequence[str]) -> jax.Array:
+    """bool [right.capacity]: right rows whose 64-bit key hash appears
+    among left's VALID rows (the cross-chunk matched-right tracking that
+    streamed right/full outer joins need; same hash-membership collision
+    budget as semi_anti_join)."""
+    lhi, llo = hash_batch_keys(left, left_keys)
+    rhi, rlo = hash_batch_keys(right, right_keys)
+    lvalid = left.valid_mask()
+    rvalid = right.valid_mask()
+    hi = jnp.concatenate([rhi, lhi])
+    lo = jnp.concatenate([rlo, llo])
+    is_left = jnp.concatenate([jnp.zeros(right.capacity, jnp.int32),
+                               lvalid.astype(jnp.int32)])
+    valid = jnp.concatenate([rvalid, lvalid])
+    n = hi.shape[0]
+    order, seg, _, _ = _hash_sort_segments(hi, lo, valid)
+    has_left = jax.ops.segment_max(jnp.take(is_left, order), seg,
+                                   num_segments=n)
+    row_has = jnp.take(has_left, jnp.clip(seg, 0, n - 1)) > 0
+    member = jnp.zeros((n,), jnp.bool_).at[order].set(row_has)
+    return member[:right.capacity] & rvalid
+
+
 def semi_anti_join(left: Batch, right: Batch, left_keys: Sequence[str],
                    right_keys: Sequence[str], anti: bool = False) -> Batch:
     """Keep left rows whose key does (semi) / does not (anti) appear in right.

@@ -22,6 +22,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from dryad_tpu.runtime import protocol
+from dryad_tpu.runtime.interfaces import ClusterBackend
 
 __all__ = ["LocalCluster", "WorkerFailure", "ClusterJobError"]
 
@@ -40,9 +41,6 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
-
-
-from dryad_tpu.runtime.interfaces import ClusterBackend
 
 
 class LocalCluster(ClusterBackend):
@@ -81,6 +79,9 @@ class LocalCluster(ClusterBackend):
         # (reference dynamic registration, LocalScheduler/Queues.cs:104)
         self._elastic: set = set()
         self._elastic_procs: Dict[int, subprocess.Popen] = {}
+        # monotonic: a dropped member's pid is never reused (reuse would
+        # overwrite a LIVE worker's socket/process entries)
+        self._elastic_seq = 0
         # per-worker receive buffers persist ACROSS jobs (cleared only on
         # restart): a speculated task's losing duplicate reply may arrive
         # after the farm returns, possibly split across recv() calls — a
@@ -181,7 +182,8 @@ class LocalCluster(ClusterBackend):
         Gang SPMD jobs ignore it.  Returns the new worker's pid."""
         if not self.alive():
             self.restart()   # also recreates the listener after teardown
-        pid = self.n_processes + len(self._elastic_procs)
+        pid = self.n_processes + self._elastic_seq
+        self._elastic_seq += 1
         control_port = self._listener.getsockname()[1]
         proc = self._spawn_worker(pid, None, control_port, standalone=True)
         deadline = time.time() + timeout

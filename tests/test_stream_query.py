@@ -215,12 +215,55 @@ def test_stream_spill_cleanup(store, data, tmp_path):
     assert os.listdir(spill) == []  # job root removed after drain
 
 
+def test_stream_user_decomposable(store, data, dbg):
+    """User Decomposable aggregates (IDecomposable parity) over a stream
+    many times the chunk budget."""
+    from dryad_tpu import Decomposable
+    import jax.numpy as jnp
+    dec = Decomposable(lambda c: c["v"], jnp.maximum, None)
+
+    def q(d):
+        return d.group_by(["k"], {"hi": dec})
+
+    got = q(_sctx().read_store_stream(store, chunk_rows=CHUNK)).collect()
+    exp = {int(kk): int(data["v"][data["k"] == kk].max())
+           for kk in np.unique(data["k"])}
+    assert dict(zip((int(x) for x in got["k"]),
+                    (int(x) for x in got["hi"]))) == exp
+
+
+def test_stream_group_top_k(store, data, dbg):
+    ctx = _sctx()
+    got = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+           .group_top_k(["k"], 3, "v").collect())
+    exp = (dbg.from_columns(data).group_top_k(["k"], 3, "v").collect())
+    assert_same_rows(got, exp)
+
+
+def test_stream_right_full_join(store, data, dbg):
+    """Streamed right/full outer joins: matched-right tracking across
+    every chunk, unmatched rows synthesized once at end-of-stream."""
+    dim = {"k": np.arange(30, 55, dtype=np.int32),
+           "w": np.arange(25, dtype=np.int32) * 9}
+
+    def q(c, dimds, how):
+        return (c.where(lambda x: x["v"] > 800)
+                .join(dimds, ["k"], expansion=2.0, how=how))
+
+    ctx = _sctx()
+    for how in ("right", "full"):
+        got = q(ctx.read_store_stream(store, chunk_rows=CHUNK),
+                ctx.from_columns(dim), how).collect()
+        exp = q(dbg.from_columns(data), dbg.from_columns(dim),
+                how).collect()
+        assert_same_rows(got, exp)
+
+
 def test_stream_unsupported_ops_fail_clearly(store):
     from dryad_tpu.exec.stream_exec import StreamExecutionError
     ctx = _sctx()
     ds = ctx.read_store_stream(store, chunk_rows=CHUNK)
     with pytest.raises(StreamExecutionError, match="sliding_window"):
         ds.sliding_window(3).collect()
-    with pytest.raises(StreamExecutionError, match="right/full"):
-        other = ctx.from_columns({"k": np.arange(5, dtype=np.int32)})
-        ds.join(other, ["k"], how="full").collect()
+    with pytest.raises(StreamExecutionError, match="group_median"):
+        ds.group_median(["k"], "v").collect()
