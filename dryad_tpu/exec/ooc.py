@@ -385,13 +385,22 @@ def _host_sort_lanes(spec, col: HostCol, descending: bool = False
         mask = np.arange(L)[None, :] < lens[:, None]
         b = np.where(mask, data, 0).astype(np.uint32)
         pad = (-L) % 4
-        if pad:
+        lens_u = lens.astype(np.uint32)
+        fold_len = pad >= 2 and L <= 0xFFFF
+        if fold_len:
+            # exact mirror of kernels._string_sort_lanes length folding
+            cols = [b, (lens_u >> 8)[:, None], (lens_u & 0xFF)[:, None]]
+            if pad == 3:
+                cols.append(np.zeros((b.shape[0], 1), np.uint32))
+            b = np.concatenate(cols, axis=1)
+        elif pad:
             b = np.pad(b, ((0, 0), (0, pad)))
         b4 = b.reshape(b.shape[0], -1, 4)
         lanes = list(np.moveaxis(
             (b4[..., 0] << 24) | (b4[..., 1] << 16) |
             (b4[..., 2] << 8) | b4[..., 3], -1, 0))
-        lanes.append(lens.astype(np.uint32))
+        if not fold_len:
+            lanes.append(lens_u)
     else:
         arr = col
         if np.issubdtype(arr.dtype, np.floating):

@@ -97,20 +97,32 @@ def _dense_sort_lanes(col: jax.Array, descending: bool) -> List[jax.Array]:
 def _string_sort_lanes(col: StringColumn, descending: bool) -> List[jax.Array]:
     """Lexicographic byte order as packed uint32 lanes (4 bytes per lane).
 
-    Shorter strings sort first among equal prefixes because padding packs as
-    0x00 bytes and a length lane is appended as tiebreak.
+    Shorter strings sort first among equal prefixes because padding packs
+    as 0x00 bytes with the length as tiebreak.  When the last lane has at
+    least two spare pad bytes, the length (u16) FOLDS into them — one
+    fewer lexsort pass (every lexsort lane is a full stable device sort,
+    so a 10-byte TeraSort key drops from 4 sort passes to 3).  Mirrored
+    EXACTLY by exec/ooc._host_sort_lanes.
     """
     L = col.max_len
     mask = (jnp.arange(L, dtype=jnp.int32)[None, :] < col.lengths[:, None])
     b = jnp.where(mask, col.data, 0).astype(jnp.uint32)
     pad = (-L) % 4
-    if pad:
+    lens = col.lengths.astype(jnp.uint32)
+    fold_len = pad >= 2 and L <= 0xFFFF
+    if fold_len:
+        cols = [b, (lens >> 8)[:, None], (lens & 0xFF)[:, None]]
+        if pad == 3:
+            cols.append(jnp.zeros((b.shape[0], 1), jnp.uint32))
+        b = jnp.concatenate(cols, axis=1)
+    elif pad:
         b = jnp.pad(b, ((0, 0), (0, pad)))
     b4 = b.reshape(b.shape[0], -1, 4)
     lanes = list(jnp.moveaxis(
         (b4[..., 0] << 24) | (b4[..., 1] << 16) | (b4[..., 2] << 8) | b4[..., 3],
         -1, 0))
-    lanes.append(col.lengths.astype(jnp.uint32))
+    if not fold_len:
+        lanes.append(lens)
     if descending:
         lanes = [~l for l in lanes]
     return lanes
@@ -128,9 +140,24 @@ def sort_by_columns(batch: Batch, keys: Sequence[Tuple[str, bool]]) -> Batch:
     lanes: List[jax.Array] = []
     for name, desc in keys:
         lanes.extend(sort_lanes_for(batch.columns[name], desc))
-    # lexsort: last key is primary => reverse, with invalid-flag most significant
-    invalid = (~batch.valid_mask()).astype(jnp.uint32)
-    order = jnp.lexsort(tuple(reversed(lanes)) + (invalid,))
+    invalid = ~batch.valid_mask()
+    col0 = batch.columns[keys[0][0]]
+    if (len(keys) == 1 and not keys[0][1]
+            and isinstance(col0, StringColumn)
+            and (-col0.max_len) % 4 >= 2 and col0.max_len <= 0xFFFF):
+        # single ascending folded-length string key: a VALID row's last
+        # lane is strictly below 0xFFFFFFFF (its length bytes are
+        # <= max_len < 0xFFFF), so setting every lane to all-ones for
+        # invalid rows sorts them last EXACTLY — one fewer lexsort pass
+        # (each pass is a full stable device sort; this is the TeraSort
+        # shape)
+        big = jnp.uint32(0xFFFFFFFF)
+        lanes = [jnp.where(invalid, big, l) for l in lanes]
+        order = jnp.lexsort(tuple(reversed(lanes)))
+    else:
+        # general case: explicit invalid flag as the most significant key
+        order = jnp.lexsort(tuple(reversed(lanes))
+                            + (invalid.astype(jnp.uint32),))
     return batch.gather(order)
 
 
@@ -152,10 +179,19 @@ def _hash_sort_segments(hi: jax.Array, lo: jax.Array, valid: jax.Array,
     true-key verification: two distinct keys colliding in all 64 bits would
     be merged.  P(any collision) ~ n^2/2^64 per partition — negligible at
     per-partition sizes (1e-9 even for 100M-row partitions).
+
+    Invalid rows sort last by FOLDING the all-ones sentinel into the hash
+    lanes instead of adding an invalid lane — one fewer lexsort pass on
+    every group/distinct/semi-join (each pass is a full stable device
+    sort).  A valid row whose 64-bit hash is exactly all-ones would sort
+    among the padding and drop — P ~ n/2^64, strictly smaller than the
+    collision-merge budget above.
     """
     n = hi.shape[0]
-    order = jnp.lexsort(tuple(extra_lanes) +
-                        (lo, hi, (~valid).astype(jnp.uint32)))
+    big = jnp.uint32(0xFFFFFFFF)
+    lo = jnp.where(valid, lo, big)
+    hi = jnp.where(valid, hi, big)
+    order = jnp.lexsort(tuple(extra_lanes) + (lo, hi))
     shi, slo = jnp.take(hi, order), jnp.take(lo, order)
     svalid = jnp.take(valid, order)
     differs = jnp.concatenate([
