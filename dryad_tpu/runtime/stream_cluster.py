@@ -52,18 +52,30 @@ def build_stream_spec(path: str, chunk_rows: int, ops: List[StageOp],
                       ) -> Tuple[str, str]:
     """Serialize a streamed cluster job: (spec_json, fake_plan_json for
     worker fn-table resolution).  Ops must be chunk-local (the shuffle is
-    the terminal's wave exchange, not a plan exchange)."""
+    the terminal's wave exchange, not a plan exchange).  A group
+    terminal's aggregates (builtin tags AND user Decomposables) ride as
+    an op-encoded param so callable refs ship like any UDF."""
     from dryad_tpu.plan.serialize import _op_to_json
     from dryad_tpu.plan.stages import Stage, StageGraph
     from dryad_tpu.runtime.shiplan import _collect_refs
 
-    graph = StageGraph([Stage(id=0, legs=[], body=list(ops))], 0)
+    terminal = dict(terminal)
+    ship_ops = list(ops)
+    if terminal.get("kind") == "group":
+        agg_op = StageOp("__terminal_aggs__",
+                         {"aggs": dict(terminal.pop("aggs"))})
+        ship_ops.append(agg_op)
+    graph = StageGraph([Stage(id=0, legs=[], body=ship_ops)], 0)
     user_names = {id(v): k for k, v in (fn_table or {}).items()}
     fn_names = _collect_refs(graph, user_names)
     shared: Dict[int, int] = {}
     ops_json = [_op_to_json(o, fn_names, shared) for o in ops]
+    body_json = list(ops_json)
+    if terminal.get("kind") == "group":
+        terminal["aggs_op"] = _op_to_json(agg_op, fn_names, shared)
+        body_json.append(terminal["aggs_op"])
     plan_json = json.dumps({"version": 1, "stages": [
-        {"id": 0, "label": "stream", "legs": [], "body": ops_json}],
+        {"id": 0, "label": "stream", "legs": [], "body": body_json}],
         "out_stage": 0})
     spec = {"source": {"path": path, "chunk_rows": chunk_rows},
             "ops": ops_json, "terminal": terminal}
@@ -132,13 +144,21 @@ class ClusterStream:
         return _SortedClusterStream(self, [(k, bool(d)) for k, d in keys])
 
     def group_by(self, keys, aggs) -> "_GroupedClusterStream":
+        """Builtin (kind, column) aggregates AND user Decomposables (the
+        latter must be fn_table-registered or importable, like any
+        shipped UDF).  Malformed specs fail HERE, before submission."""
+        from dryad_tpu.ops.kernels import AGG_KINDS
+        from dryad_tpu.plan.expr import Decomposable
         for name, spec in aggs.items():
-            if not (isinstance(spec, tuple) and len(spec) == 2):
-                raise StreamJobError(
-                    f"streamed cluster group_by supports builtin "
-                    f"(kind, column) aggregates only (agg {name!r})")
-        return _GroupedClusterStream(self, list(keys),
-                                     {k: list(v) for k, v in aggs.items()})
+            if isinstance(spec, Decomposable):
+                continue
+            if (isinstance(spec, tuple) and len(spec) == 2
+                    and spec[0] in AGG_KINDS):
+                continue
+            raise StreamJobError(
+                f"agg {name!r}: expected a (kind, column) tuple with kind "
+                f"in {AGG_KINDS} or a Decomposable, got {spec!r}")
+        return _GroupedClusterStream(self, list(keys), dict(aggs))
 
 
 class _SortedClusterStream:
@@ -356,8 +376,12 @@ def _build_wave_fn(mesh, kind: str, params: Dict[str, Any], chunk_rows: int,
                 descending=params["descending"], send_slack=slack,
                 axes=axes)
         elif kind == "group":
-            pb = kernels.group_aggregate(b, params["keys"],
-                                         params["partial"])
+            if "decs" in params:
+                pb = kernels.group_decompose_partial(
+                    b, params["keys"], params["decs"], params["box"])
+            else:
+                pb = kernels.group_aggregate(b, params["keys"],
+                                             params["partial"])
             out, nr, nsl = shuffle.hash_exchange(pb, params["keys"], cap,
                                                  send_slack=slack,
                                                  axes=axes)
@@ -394,18 +418,23 @@ def _run_waves(cs, schema, mesh, kind: str, params: Dict[str, Any],
     start = jax.process_index() * dpp
 
     # bucket store schema = the EXCHANGED row schema (partial rows for
-    # group) — probe with an empty chunk through the local part
+    # group) — probe with an empty chunk through the local part (for
+    # user decomposables this also fills the treedef box before any
+    # merge traces)
     compact_fn = None
     if kind == "group":
-        probe = ooc._batch_to_chunk(jax.jit(
-            lambda b: kernels.group_aggregate(
-                b, params["keys"], params["partial"]))(
+        if "decs" in params:
+            pfn = (lambda b: kernels.group_decompose_partial(
+                b, params["keys"], params["decs"], params["box"]))
+        else:
+            pfn = (lambda b: kernels.group_aggregate(
+                b, params["keys"], params["partial"]))
+        probe = ooc._batch_to_chunk(jax.jit(pfn)(
             ooc._chunk_to_batch(ooc.HChunk.empty_like(schema), 1)))
         out_schema = ooc.chunk_schema(probe)
-        # merging partials applies the FINAL (associative) agg kinds;
-        # mean finalization happens only at the end
-        compact_fn = jax.jit(lambda b: kernels.group_aggregate(
-            b, params["keys"], params["final"]))
+        # merging partials is the associative combine; finalization
+        # (mean quotient / FinalReduce) happens only at the end
+        compact_fn = jax.jit(params["merge_fn"])
     else:
         out_schema = schema
 
@@ -635,28 +664,24 @@ def _finish_sort(store, schema, keys, chunk_rows: int, mesh,
                       chunk_rows, partitioning=part)
 
 
-def _finish_group(store, out_schema, keys, final, mean_cols,
-                  chunk_rows: int, mesh, term):
-    """Merge each device bucket's accumulated partials, finalize means,
-    then either write partitions in parallel or return the local host
-    table part (driver concatenates parts in pid order)."""
+def _finish_group(store, pschema, chunk_rows: int, mesh, term, final_fn):
+    """Finalize each device bucket's accumulated partials (associative
+    merge + FinalReduce / mean quotient via ``final_fn``), then either
+    write partitions in parallel or return the local host table part
+    (driver concatenates parts in pid order)."""
     import jax
 
-    from dryad_tpu.data.columnar import Batch
     from dryad_tpu.exec import ooc
-    from dryad_tpu.ops import kernels
 
     nprocs = jax.process_count()
     dpp = mesh.devices.size // nprocs
     start = jax.process_index() * dpp
-
-    merge = jax.jit(lambda b: kernels.group_aggregate(b, keys, final))
-    fin = jax.jit(lambda b: Batch(
-        kernels.mean_finalize_columns(dict(b.columns), mean_cols), b.count))
+    keys = list(term["keys"])
+    fin = jax.jit(final_fn)
 
     # final output schema, probed on an empty partial batch
-    fin_schema = ooc.chunk_schema(ooc._batch_to_chunk(fin(merge(
-        ooc._chunk_to_batch(ooc.HChunk.empty_like(out_schema), 1)))))
+    fin_schema = ooc.chunk_schema(ooc._batch_to_chunk(fin(
+        ooc._chunk_to_batch(ooc.HChunk.empty_like(pschema), 1))))
 
     finals: List[List[Any]] = []
     for d in range(dpp):
@@ -664,19 +689,18 @@ def _finish_group(store, out_schema, keys, final, mean_cols,
         if not frags:
             finals.append([])
             continue
-        merged = ooc._concat_hchunks(out_schema, frags)
+        merged = ooc._concat_hchunks(pschema, frags)
         capm = 1
         while capm < max(merged.n, 1):
             capm *= 2
-        out = ooc._batch_to_chunk(fin(merge(
-            ooc._chunk_to_batch(merged, capm))))
-        finals.append([out])
+        finals.append([ooc._batch_to_chunk(fin(
+            ooc._chunk_to_batch(merged, capm)))])
 
     if term.get("out") is not None:
         _write_partitions(term["out"], fin_schema, finals,
                           list(range(start, start + dpp)), mesh,
                           chunk_rows,
-                          partitioning={"kind": "hash", "keys": list(keys)})
+                          partitioning={"kind": "hash", "keys": keys})
         return None
     # collect: return this worker's part as a host table
     from dryad_tpu.exec.stream_exec import chunks_to_table
@@ -744,19 +768,51 @@ def execute_stream_job(spec_json: str, fn_table, mesh, config):
         return {"stored": term["out"]}
 
     if kind == "group":
-        from dryad_tpu.plan.planner import _decompose_aggs
+        from dryad_tpu.plan.planner import (_decompose_aggs,
+                                            _has_user_decs,
+                                            _normalize_decs)
         keys = list(term["keys"])
-        aggs = {k: (v[0], v[1]) for k, v in term["aggs"].items()}
-        partial, final, mean_cols = _decompose_aggs(aggs)
+        aggs = _op_from_json(term["aggs_op"], fn_table,
+                             shared).params["aggs"]
+        if _has_user_decs(aggs):
+            # user Decomposables ride the waves as flattened partial
+            # states (seed+merge in the wave program, merge compaction
+            # between waves, FinalReduce per bucket —
+            # IDecomposable.cs:34 over the cluster)
+            decs = _normalize_decs(aggs)
+            box: Dict[str, Any] = {}
+            from dryad_tpu.ops import kernels as K
+            merge_fn = (lambda b: K.group_decompose_merge(
+                b, keys, decs, box, False))
+            final_fn = (lambda b: K.group_decompose_merge(
+                b, keys, decs, box, True))
+            params = {"keys": keys, "decs": decs, "box": box,
+                      "merge_fn": merge_fn}
+        else:
+            aggs_t = {k: (v[0], v[1]) if not isinstance(v, tuple) else v
+                      for k, v in aggs.items()}
+            partial, final, mean_cols = _decompose_aggs(aggs_t)
+
+            from dryad_tpu.data.columnar import Batch as _B
+            from dryad_tpu.ops import kernels as K
+
+            def merge_fn(b):
+                return K.group_aggregate(b, keys, final)
+
+            def final_fn(b):
+                m = K.group_aggregate(b, keys, final)
+                return _B(K.mean_finalize_columns(dict(m.columns),
+                                                  mean_cols), m.count)
+
+            params = {"keys": keys, "partial": partial, "final": final,
+                      "merge_fn": merge_fn}
         # no pre-pass: the per-wave continuation flag drives the loop, so
         # group-by reads and computes the data exactly once
-        store, pschema = _run_waves(cs, schema, mesh, "group",
-                                    {"keys": keys, "partial": partial,
-                                     "final": final},
+        store, pschema = _run_waves(cs, schema, mesh, "group", params,
                                     chunk_rows, config,
                                     np.zeros((0,), np.uint32))
-        table = _finish_group(store, pschema, keys, final, mean_cols,
-                              chunk_rows, mesh, term)
+        table = _finish_group(store, pschema, chunk_rows, mesh, term,
+                              final_fn)
         if term.get("out") is not None:
             return {"stored": term["out"]}
         return {"table_part": table}

@@ -122,6 +122,32 @@ def test_cluster_stream_group_to_store(cluster, store, data, tmp_path):
     assert got == exp
 
 
+def test_cluster_stream_user_decomposable(store, data, monkeypatch):
+    """User Decomposable aggregates ride the chunk waves: seed+merge in
+    the wave program, merge compaction between waves, FinalReduce per
+    bucket (IDecomposable.cs:34 over the cluster, streamed)."""
+    # self-sufficient: workers must import cluster_fns regardless of
+    # which tests ran before (no reliance on the module fixture's env)
+    monkeypatch.setenv(
+        "PYTHONPATH", os.path.dirname(__file__) + os.pathsep +
+        os.environ.get("PYTHONPATH", ""))
+    cl2 = LocalCluster(n_processes=2, devices_per_process=2,
+                       fn_modules=("cluster_fns",))
+    try:
+        ctx = Context(cluster=cl2,
+                      config=JobConfig(ooc_chunk_rows=CHUNK),
+                      fn_table={"sum_dec": cluster_fns.SUM_DEC})
+        out = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+               .group_by(["k"], {"s": cluster_fns.SUM_DEC}).collect())
+        k, v = data["k"], data["v"]
+        exp = {int(kk): int(v[k == kk].sum()) for kk in np.unique(k)}
+        got = dict(zip((int(x) for x in out["k"]),
+                       (int(x) for x in out["s"])))
+        assert got == exp
+    finally:
+        cl2.shutdown()
+
+
 def test_cluster_stream_wordcount(cluster, tmp_path):
     """Streamed WordCount over the gang (string keys ride the wave
     exchange)."""
