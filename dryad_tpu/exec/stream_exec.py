@@ -74,13 +74,12 @@ _LOCAL_KINDS = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
                 "apply", "recap"}
 # op kinds with whole-stream semantics, each lowered to an ooc primitive
 _STREAM_KINDS = {"sort", "group", "dgroup_local", "distinct",
-                 "group_top_k", "take", "skip", "row_index"}
+                 "group_top_k", "take", "skip", "row_index",
+                 "take_while", "skip_while"}
 
 _UNSUPPORTED_HINTS = {
     "zip": "zip_with needs global row alignment",
     "sliding_window": "sliding_window needs cross-chunk halos",
-    "take_while": "take_while/skip_while are not yet streamed",
-    "skip_while": "take_while/skip_while are not yet streamed",
     "group_apply": "group_apply is not yet streamed — use group_by "
                    "aggregates, group_top_k, or the in-memory path",
     "group_rank": "group_median/rank needs whole groups materialized "
@@ -469,6 +468,34 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
                     yield chunk
 
         return ChunkSource(it_skip, cs.schema, cs.chunk_rows)
+    if k in ("take_while", "skip_while"):
+        fn = p["fn"]
+        pred = jax.jit(lambda b: fn(dict(b.columns)))
+        taking = k == "take_while"
+
+        def it_while():
+            skipping = not taking
+            for chunk in cs:
+                if chunk.n == 0:
+                    continue
+                if not taking and not skipping:
+                    yield chunk
+                    continue
+                mask = np.asarray(pred(_chunk_to_batch(
+                    chunk, cs.chunk_rows)))[:chunk.n].astype(bool)
+                fails = np.nonzero(~mask)[0]
+                cut = int(fails[0]) if fails.size else chunk.n
+                if taking:
+                    if cut:
+                        yield _slice_hchunk(chunk, 0, cut)
+                    if cut < chunk.n:
+                        return  # first failing row ends the stream
+                else:
+                    if cut < chunk.n:
+                        skipping = False
+                        yield _slice_hchunk(chunk, cut, chunk.n)
+
+        return ChunkSource(it_while, cs.schema, cs.chunk_rows)
     if k == "row_index":
         col = p["column"]
         schema = dict(cs.schema)

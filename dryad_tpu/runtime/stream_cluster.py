@@ -144,9 +144,11 @@ class ClusterStream:
         return _SortedClusterStream(self, [(k, bool(d)) for k, d in keys])
 
     def group_by(self, keys, aggs) -> "_GroupedClusterStream":
-        """Builtin (kind, column) aggregates AND user Decomposables (the
-        latter must be fn_table-registered or importable, like any
-        shipped UDF).  Malformed specs fail HERE, before submission."""
+        """Builtin (kind, column) aggregates AND user Decomposables.  A
+        Decomposable must be REGISTERED by name (Context(fn_table=...) on
+        the driver + --fn-module FN_TABLE on the workers) — instances
+        carry no importable qualname, same constraint as the in-memory
+        cluster path.  Malformed specs fail HERE, before submission."""
         from dryad_tpu.ops.kernels import AGG_KINDS
         from dryad_tpu.plan.expr import Decomposable
         for name, spec in aggs.items():
@@ -789,9 +791,7 @@ def execute_stream_job(spec_json: str, fn_table, mesh, config):
             params = {"keys": keys, "decs": decs, "box": box,
                       "merge_fn": merge_fn}
         else:
-            aggs_t = {k: (v[0], v[1]) if not isinstance(v, tuple) else v
-                      for k, v in aggs.items()}
-            partial, final, mean_cols = _decompose_aggs(aggs_t)
+            partial, final, mean_cols = _decompose_aggs(dict(aggs))
 
             from dryad_tpu.data.columnar import Batch as _B
             from dryad_tpu.ops import kernels as K
@@ -804,7 +804,7 @@ def execute_stream_job(spec_json: str, fn_table, mesh, config):
                 return _B(K.mean_finalize_columns(dict(m.columns),
                                                   mean_cols), m.count)
 
-            params = {"keys": keys, "partial": partial, "final": final,
+            params = {"keys": keys, "partial": partial,
                       "merge_fn": merge_fn}
         # no pre-pass: the per-wave continuation flag drives the loop, so
         # group-by reads and computes the data exactly once

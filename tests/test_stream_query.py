@@ -259,6 +259,18 @@ def test_stream_right_full_join(store, data, dbg):
         assert_same_rows(got, exp)
 
 
+def test_stream_take_while_skip_while(store, data, dbg):
+    """Streamed prefix predicates: the stream stops at (or resumes after)
+    the FIRST failing row, matching the global in-memory semantics."""
+    ctx = _sctx()
+    for op in ("take_while", "skip_while"):
+        def q(d, op=op):
+            return getattr(d, op)(lambda c: c["v"] > -920)
+        got = q(ctx.read_store_stream(store, chunk_rows=CHUNK)).collect()
+        exp = q(dbg.from_columns(data)).collect()
+        assert_same_rows(got, exp, ordered=True)
+
+
 def test_stream_unsupported_ops_fail_clearly(store):
     from dryad_tpu.exec.stream_exec import StreamExecutionError
     ctx = _sctx()
