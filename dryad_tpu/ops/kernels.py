@@ -794,9 +794,10 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     rvalid = right.valid_mask()
     lvalid = left.valid_mask()
 
-    # sort right by hash, invalid last
+    # sort right by hash, invalid last.  The sorted batch is never
+    # materialized: every sorted-row access composes the permutation
+    # (order) with its index — one full-batch gather saved per join.
     order = jnp.lexsort((rh, (~rvalid).astype(jnp.uint32)))
-    rs = right.gather(order)
     rkey = jnp.take(rh, order)
     # mark invalid rows with sentinel max keys so searchsorted excludes them;
     # valid rows hashing to the sentinel just become extra candidates.
@@ -828,7 +829,8 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     # verify true key equality (hash collisions) then compact; also exclude
     # candidates that landed in the right-side padding region, whose contents
     # are unspecified and may hold stale real keys
-    eq = _keys_equal(left, lid_c, left_keys, rs, rid, right_keys)
+    rid_abs = jnp.take(order, rid)   # sorted position -> original row
+    eq = _keys_equal(left, lid_c, left_keys, right, rid_abs, right_keys)
     keep_match = slot_valid & eq & (rid < right.count)
     keep = keep_match
     if left_synth:
@@ -840,12 +842,12 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
         out_cols[k] = v.gather(lid_c) if isinstance(v, StringColumn) \
             else jnp.take(v, lid_c, axis=0)
     rkeyset = set(right_keys)
-    for k, v in rs.columns.items():
+    for k, v in right.columns.items():
         if k in rkeyset:
             continue
         name = k if k not in out_cols else k + suffix
         if isinstance(v, StringColumn):
-            g = v.gather(rid)
+            g = v.gather(rid_abs)
             if left_synth:
                 z = synth_slot
                 g = StringColumn(
@@ -853,7 +855,7 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
                     jnp.where(z, 0, g.lengths))
             out_cols[name] = g
         else:
-            g = jnp.take(v, rid, axis=0)
+            g = jnp.take(v, rid_abs, axis=0)
             if left_synth:
                 z = synth_slot.reshape(
                     synth_slot.shape + (1,) * (g.ndim - 1))
@@ -872,10 +874,10 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
         # match dropped only by capacity overflow marks its right row
         # matched=False, inflating u — harmless: need already forces a
         # right-sized retry in that case.
-        matched = jnp.zeros((right.capacity,), jnp.int32).at[rid].max(
+        matched = jnp.zeros((right.capacity,), jnp.int32).at[rid_abs].max(
             keep_match.astype(jnp.int32))
-        unmatched = rs.valid_mask() & (matched == 0)
-        ru = compact(rs, unmatched)
+        unmatched = right.valid_mask() & (matched == 0)
+        ru = compact(right, unmatched)
         u = ru.count
         key_map = dict(zip(left_keys, right_keys))
         synth_cols: Dict[str, Any] = {}

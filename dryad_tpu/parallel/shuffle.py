@@ -65,14 +65,16 @@ def _exchange_one_axis(batch: Batch, dest: jax.Array, axis: str,
 
     order = jnp.argsort(dest, stable=True)
     sdest = jnp.take(dest, order)
-    sb = batch.gather(order)
     counts = jnp.bincount(jnp.minimum(sdest, D), length=D + 1)[:D]
     offsets = jnp.cumsum(counts) - counts  # exclusive prefix
 
     d_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
     j_idx = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
     src = jnp.clip(jnp.take(offsets, d_idx) + j_idx, 0, cap - 1)
-    send = sb.gather(src)  # [D*C] rows, garbage where slot not filled
+    # ONE gather: compose the dest-sort permutation with the slot
+    # selection instead of materializing the sorted batch first (a full
+    # extra all-columns gather per exchange hop)
+    send = batch.gather(jnp.take(order, src))
     send_counts = jnp.minimum(counts, C)
 
     def a2a(x):
