@@ -1,0 +1,21 @@
+"""Exchange bytes-on-wire accounting (benchmarks/wire_check.py) as a
+regression test: row conservation, hash placement, and slot utilization
+on the virtual 8-device mesh — the bookkeeping the bench validates where
+real ICI is unavailable (VERDICT r2 weak 4)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_wire_accounting():
+    from benchmarks.wire_check import main
+
+    r = main(n_devices=8, rows_per_part=2048, n_keys=500)
+    assert r["conserved"] and r["placement_ok"]
+    assert r["rows"] == 8 * 2048
+    # send_slack=2 allocates exactly 2x the rows in wire slots
+    assert r["wire_utilization_pct"] == 50.0
+    assert r["wire_bytes"] == 2 * r["useful_bytes"]
