@@ -75,11 +75,10 @@ _LOCAL_KINDS = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
 # op kinds with whole-stream semantics, each lowered to an ooc primitive
 _STREAM_KINDS = {"sort", "group", "dgroup_local", "distinct",
                  "group_top_k", "take", "skip", "row_index",
-                 "take_while", "skip_while"}
+                 "take_while", "skip_while", "sliding_window"}
 
 _UNSUPPORTED_HINTS = {
     "zip": "zip_with needs global row alignment",
-    "sliding_window": "sliding_window needs cross-chunk halos",
     "group_apply": "group_apply is not yet streamed — use group_by "
                    "aggregates, group_top_k, or the in-memory path",
     "group_rank": "group_median/rank needs whole groups materialized "
@@ -468,6 +467,46 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
                     yield chunk
 
         return ChunkSource(it_skip, cs.schema, cs.chunk_rows)
+    if k == "sliding_window":
+        # cross-chunk halo via a rolling carry of the last w-1 rows: each
+        # emitted block's windows start at every position that has w rows
+        # available; consecutive blocks overlap by exactly w-1 rows, so
+        # window starts are continuous with no duplicates (the streamed
+        # form of the in-memory ppermute halo)
+        w = p["w"]
+        for name, spec in cs.schema.items():
+            if spec["kind"] == "str":
+                raise StreamExecutionError(
+                    f"streamed sliding_window over string column "
+                    f"{name!r} is not supported (windowed strings have "
+                    f"no chunk representation); project to dense "
+                    f"columns first")
+        schema = {name: {"kind": "dense", "dtype": spec["dtype"],
+                         "shape": [w] + list(spec.get("shape", ()))}
+                  for name, spec in cs.schema.items()}
+
+        def windows(block: HChunk) -> HChunk:
+            n_out = block.n - w + 1
+            idx = np.arange(n_out)[:, None] + np.arange(w)[None, :]
+            cols = {name: v[idx] for name, v in block.cols.items()}
+            return HChunk(cols, n_out)
+
+        def it_sw():
+            carry: Optional[HChunk] = None
+            for chunk in cs:
+                if chunk.n == 0:
+                    continue
+                block = (chunk if carry is None
+                         else _concat_hchunks(cs.schema, [carry, chunk]))
+                if block.n >= w:
+                    yield windows(block)
+                    carry = _slice_hchunk(block, block.n - (w - 1),
+                                          block.n)
+                else:
+                    carry = block
+            # windows crossing the dataset end drop (in-memory semantics)
+
+        return ChunkSource(it_sw, schema, cs.chunk_rows)
     if k in ("take_while", "skip_while"):
         fn = p["fn"]
         pred = jax.jit(lambda b: fn(dict(b.columns)))

@@ -271,11 +271,34 @@ def test_stream_take_while_skip_while(store, data, dbg):
         assert_same_rows(got, exp, ordered=True)
 
 
+def test_stream_sliding_window(store, data, dbg):
+    """Cross-chunk halo carry: windows spanning chunk boundaries appear
+    exactly once, matching the in-memory global semantics."""
+    ctx = _sctx()
+    # include w > chunk size (tiny chunks force the carry-ACCUMULATION
+    # branch: several chunks buffer before the first window emits)
+    for chunk_rows, w in ((CHUNK, 1), (CHUNK, 4), (CHUNK, 7), (3, 8)):
+        got = (ctx.read_store_stream(store, chunk_rows=chunk_rows)
+               .take(40).select(lambda c: {"v": c["v"]})
+               .sliding_window(w).collect())
+        exp = (dbg.from_columns(data)
+               .take(40).select(lambda c: {"v": c["v"]})
+               .sliding_window(w).collect())
+        gv, ev = np.asarray(got["v"]), np.asarray(exp["v"])
+        assert gv.shape == ev.shape, (w, gv.shape, ev.shape)
+        np.testing.assert_array_equal(gv, ev)
+    # window wider than the whole dataset -> empty result
+    empty = (ctx.read_store_stream(store, chunk_rows=CHUNK).take(5)
+             .select(lambda c: {"v": c["v"]}).sliding_window(9).collect())
+    assert len(empty["v"]) == 0
+
+
 def test_stream_unsupported_ops_fail_clearly(store):
     from dryad_tpu.exec.stream_exec import StreamExecutionError
     ctx = _sctx()
     ds = ctx.read_store_stream(store, chunk_rows=CHUNK)
-    with pytest.raises(StreamExecutionError, match="sliding_window"):
-        ds.sliding_window(3).collect()
+    with pytest.raises(StreamExecutionError, match="zip"):
+        other = ctx.from_columns({"x": np.arange(5, dtype=np.int32)})
+        ds.zip_with(other).collect()
     with pytest.raises(StreamExecutionError, match="group_median"):
         ds.group_median(["k"], "v").collect()
