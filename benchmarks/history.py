@@ -1,0 +1,163 @@
+"""Bench-over-bench history: extract key metrics from every recorded
+round capture (BENCH_r*.json) and flag regressions.
+
+VERDICT r3 weak 3: TeraSort slid −19% between rounds 2 and 3 and nothing
+in the repo tracked it.  This module is the tracker: ``collect()`` parses
+the driver's round captures (whose ``tail`` field holds the bench's JSON
+line, possibly truncated at the front), ``table()`` renders the history,
+and ``flag_regressions()`` returns every metric that moved more than
+``threshold`` against its previous round.  bench.py embeds the comparison
+of the CURRENT run against the last recorded round in its output, so a
+slide is visible in the bench line itself.
+
+Run as a script to print the history table:
+    python -m benchmarks.history
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+# metric name -> (regex over the raw capture text, higher_is_better)
+_PATTERNS: Dict[str, Tuple[str, bool]] = {
+    "wordcount_rows_s_chip": (
+        r'"metric": "WordCount rows/sec/chip", "value": ([0-9.]+)', True),
+    "terasort_rows_s_chip": (
+        r'"terasort": \{[^{}]*?"rows_per_sec_chip": ([0-9.]+)', True),
+    "terasort_ooc_rows_s_chip": (
+        r'"terasort_ooc[^"]*": \{[^{}]*?"rows_per_sec_chip": ([0-9.]+)',
+        True),
+    "sort_roofline_pct": (r'"sort_roofline_pct": ([0-9.]+)', True),
+    "group_roofline_pct": (
+        r'"groupbyreduce": \{[^{}]*?"group_roofline_pct": ([0-9.]+)', True),
+    "groupby_rows_s_chip": (
+        r'"groupbyreduce": \{[^{}]*?"rows_per_sec_chip_run": ([0-9.]+)',
+        True),
+    "pagerank_compile_s": (
+        r'"pagerank_10iter": \{[^{}]*?"compile_s": ([0-9.]+)', False),
+    "kmeans_compile_s": (
+        r'"kmeans_5iter": \{[^{}]*?"compile_s": ([0-9.]+)', False),
+    "wire_utilization_pct": (r'"wire_utilization_pct": ([0-9.]+)', True),
+}
+
+
+def _extract(text: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for name, (pat, _) in _PATTERNS.items():
+        m = re.search(pat, text, re.S)
+        if m:
+            out[name] = float(m.group(1))
+    return out
+
+
+def collect(repo_dir: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+    """round tag (e.g. 'r03') -> {metric: value} from BENCH_r*.json."""
+    repo_dir = repo_dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    rounds: Dict[str, Dict[str, float]] = {}
+    for path in sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))):
+        tag = re.search(r"BENCH_(r\d+)\.json", path).group(1)
+        try:
+            cap = json.load(open(path))
+            text = cap.get("tail", "") if isinstance(cap, dict) else ""
+        except Exception:
+            text = open(path).read()
+        vals = _extract(text)
+        if vals:
+            rounds[tag] = vals
+    return rounds
+
+
+def _last_recorded(rounds: Dict[str, Dict[str, float]], tags: List[str],
+                   name: str) -> Optional[Tuple[str, float]]:
+    """Most recent round among ``tags`` that recorded ``name`` (captures
+    are truncated tails — a metric can skip rounds; comparing only
+    adjacent rounds would silently drop it)."""
+    for t in reversed(tags):
+        if name in rounds[t]:
+            return t, rounds[t][name]
+    return None
+
+
+def flag_regressions(rounds: Dict[str, Dict[str, float]],
+                     threshold: float = 0.10) -> List[str]:
+    """Human-readable flags for metrics that moved against their
+    direction by more than ``threshold`` vs the MOST RECENT round that
+    recorded them (not just the adjacent one)."""
+    tags = sorted(rounds)
+    flags: List[str] = []
+    for i, cur in enumerate(tags[1:], start=1):
+        for name, (_, hib) in _PATTERNS.items():
+            b = rounds[cur].get(name)
+            base = _last_recorded(rounds, tags[:i], name)
+            if b is None or base is None or base[1] == 0:
+                continue
+            prev, a = base
+            rel = (b - a) / abs(a)
+            bad = rel < -threshold if hib else rel > threshold
+            if bad:
+                flags.append(
+                    f"{cur} vs {prev}: {name} "
+                    f"{a:g} -> {b:g} ({rel:+.0%})")
+    return flags
+
+
+def compare_current(current: Dict[str, float],
+                    rounds: Optional[Dict[str, Dict[str, float]]] = None,
+                    threshold: float = 0.10) -> Dict[str, object]:
+    """Compare a fresh bench run against, per metric, the MOST RECENT
+    round that recorded it; returns {baseline_round, deltas:
+    {metric: rel}, baselines: {metric: round}, regressions: [...]}."""
+    rounds = rounds if rounds is not None else collect()
+    if not rounds:
+        return {"baseline_round": None, "deltas": {}, "regressions": []}
+    tags = sorted(rounds)
+    deltas: Dict[str, float] = {}
+    baselines: Dict[str, str] = {}
+    regressions: List[str] = []
+    for name, (_, hib) in _PATTERNS.items():
+        b = current.get(name)
+        base = _last_recorded(rounds, tags, name)
+        if b is None or base is None or base[1] == 0:
+            continue
+        last, a = base
+        rel = (b - a) / abs(a)
+        deltas[name] = round(rel, 3)
+        baselines[name] = last
+        if (rel < -threshold) if hib else (rel > threshold):
+            regressions.append(f"vs {last}: {name} {a:g} -> {b:g} "
+                               f"({rel:+.0%})")
+    return {"baseline_round": tags[-1], "deltas": deltas,
+            "baselines": baselines, "regressions": regressions}
+
+
+def table(rounds: Optional[Dict[str, Dict[str, float]]] = None) -> str:
+    rounds = rounds if rounds is not None else collect()
+    tags = sorted(rounds)
+    names = [n for n in _PATTERNS if any(n in rounds[t] for t in tags)]
+    w = max((len(n) for n in names), default=10)
+    lines = ["| " + "metric".ljust(w) + " | "
+             + " | ".join(t.ljust(10) for t in tags) + " |",
+             "|-" + "-" * w + "-|" + "|".join("-" * 12 for _ in tags) + "|"]
+    for n in names:
+        row = [("%g" % rounds[t][n]) if n in rounds[t] else "—"
+               for t in tags]
+        lines.append("| " + n.ljust(w) + " | "
+                     + " | ".join(v.ljust(10) for v in row) + " |")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    r = collect()
+    print(table(r))
+    flags = flag_regressions(r)
+    if flags:
+        print("\nREGRESSIONS (>10%):")
+        for f in flags:
+            print("  " + f)
+    else:
+        print("\nno >10% regressions between recorded rounds")

@@ -62,6 +62,8 @@ class Context:
         self.local_debug = local_debug
         self.spill_dir = spill_dir
         self.config = config or JobConfig()
+        from dryad_tpu.utils.compile_cache import enable_persistent_cache
+        enable_persistent_cache(self.config.compilation_cache_dir)
         if cluster is not None:
             # multi-process mode (runtime.LocalCluster): the driver owns no
             # devices; plans + deferred sources ship to the worker gang
@@ -122,7 +124,7 @@ class Context:
                         store_compression=store_compression)
                     break
                 except ClusterJobError as e:
-                    tok = self._lost_resident_token(str(e))
+                    tok = self._lost_resident_token(e)
                     if tok is None or heal == 7:
                         raise
                     # a gang restart wiped this resident: re-materialize
@@ -134,13 +136,15 @@ class Context:
             self.cluster.event_log = prev_log
         return reply if want_reply else reply.get("table")
 
-    def _lost_resident_token(self, err: str) -> Optional[str]:
-        """Healable token from a 'resident token ... not present' job
-        error, if its producer is registered."""
-        import re
-        m = re.search(r"resident token '([^']+)' not present", err)
-        if m and m.group(1) in self._resident_producers:
-            return m.group(1)
+    def _lost_resident_token(self, err) -> Optional[str]:
+        """Healable token from a lost-resident job error, if its producer
+        is registered.  The token arrives as STRUCTURED data on the
+        exception (ClusterJobError.missing_token, set from the worker
+        reply's ``missing_token`` field — runtime/worker.py
+        _tag_missing_token), never parsed out of traceback text."""
+        tok = getattr(err, "missing_token", None)
+        if tok is not None and tok in self._resident_producers:
+            return tok
         return None
 
     # -- cluster-resident intermediates ------------------------------------
@@ -396,7 +400,8 @@ class Context:
                 # them.
                 token, cap = run_loop()
             except ClusterJobError as e:
-                if "resident token" not in str(e):
+                # structured lost-resident tag (never message text)
+                if e.missing_token is None:
                     raise
                 token, cap = run_loop()
             return self._resident_dataset(token, cap)

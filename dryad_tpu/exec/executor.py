@@ -352,6 +352,8 @@ class Executor:
         self.axes = tuple(mesh.axis_names)
         self.nparts = mesh.devices.size
         self.config = config or JobConfig()
+        from dryad_tpu.utils.compile_cache import enable_persistent_cache
+        enable_persistent_cache(self.config.compilation_cache_dir)
         self._event = event_log or (lambda e: None)
         # Multi-process (runtime-cluster) mode: host-side reads of sharded
         # values (overflow flags, sample lanes, counts) must first replicate
@@ -371,6 +373,8 @@ class Executor:
         processes keep one executor per mesh across submitted jobs)."""
         from dryad_tpu.utils.config import JobConfig
         self.config = config or JobConfig()
+        from dryad_tpu.utils.compile_cache import enable_persistent_cache
+        enable_persistent_cache(self.config.compilation_cache_dir)
         self._compile_cache_max = self.config.compile_cache_size
         while len(self._compile_cache) > self._compile_cache_max:
             self._compile_cache.popitem(last=False)

@@ -206,6 +206,18 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
     probe_b, _, _ = fns[1](_chunk_to_batch(HChunk.empty_like(cs.schema), 1),
                            extra_right)
     out_schema = chunk_schema(_batch_to_chunk(probe_b))
+    if track_right:
+        # unmatched right rows carry RIGHT key bytes in the left key
+        # column; the probe schema has the LEFT column's width, so widen
+        # to max(left, right) — the in-memory hash_join keeps the full
+        # width for the same reason (ops/kernels.py: truncating would
+        # corrupt unmatched right keys wider than the left column)
+        for lk, rk in zip(lkeys, rkeys):
+            rc = extra_right.columns.get(rk)
+            spec = out_schema.get(lk)
+            if (spec is not None and spec["kind"] == "str"
+                    and hasattr(rc, "max_len")):
+                spec["max_len"] = max(spec["max_len"], int(rc.max_len))
     left_names: List[str] = []
     if track_right:
         lp = _chunk_to_batch(HChunk.empty_like(cs.schema), 1)
@@ -261,7 +273,8 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
                 need_i = int(need)
             if matched_acc is not None:
                 matched_acc |= np.asarray(matched)
-            yield from _slices(_batch_to_chunk(out))
+            yield from _slices(
+                _widen_strs(_batch_to_chunk(out), out_schema))
 
         for chunk in cs:
             pending.append(launch(chunk))
@@ -277,6 +290,24 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
                 yield from _slices(synth)
 
     return ChunkSource(it, out_schema, out_cap)
+
+
+def _widen_strs(oc: HChunk, schema) -> HChunk:
+    """Zero-pad string columns up to the schema's max_len (per-chunk join
+    outputs carry the left key width; the declared schema may be wider to
+    hold unmatched right keys)."""
+    cols = dict(oc.cols)
+    changed = False
+    for k, spec in schema.items():
+        if spec["kind"] != "str" or k not in cols:
+            continue
+        d, l = cols[k]
+        if d.shape[1] < spec["max_len"]:
+            nd = np.zeros((d.shape[0], spec["max_len"]), np.uint8)
+            nd[:, : d.shape[1]] = d
+            cols[k] = (nd, l)
+            changed = True
+    return HChunk(cols, oc.n) if changed else oc
 
 
 def _synth_unmatched_right(right_chunk: HChunk, matched: "np.ndarray",

@@ -18,22 +18,50 @@ returns an ordinary text Dataset.
 
 from __future__ import annotations
 
+import socket
+import urllib.error
 import urllib.request
 from typing import List, Optional, Tuple
 
-__all__ = ["read_url_bytes", "enumerate_http", "http_provider"]
+__all__ = ["read_url_bytes", "enumerate_http", "http_provider",
+           "HTTP_TIMEOUT_S"]
 
 _DEFAULT_BLOCK = 2 << 20   # the reference FileServer's 2 MB block size
+# every request carries a timeout so a stalled server fails the job with a
+# named error instead of hanging the driver forever (ADVICE r3)
+HTTP_TIMEOUT_S = 60.0
 
 
-def _head(url: str) -> Tuple[int, bool]:
+import contextlib
+
+
+@contextlib.contextmanager
+def _open(req, timeout: float):
+    """urlopen with a mandatory timeout covering BOTH connect and body
+    read; any socket timeout surfaces as an IOError naming the URL (a
+    server that sends headers then stalls mid-body times out in
+    ``r.read()``, outside urlopen itself)."""
+    url = req.full_url if hasattr(req, "full_url") else req
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            yield r
+    except (socket.timeout, TimeoutError) as e:
+        raise IOError(f"HTTP request timed out after {timeout}s: {url}") \
+            from e
+    except urllib.error.URLError as e:
+        if isinstance(getattr(e, "reason", None),
+                      (socket.timeout, TimeoutError)):
+            raise IOError(
+                f"HTTP request timed out after {timeout}s: {url}") from e
+        raise
+
+
+def _head(url: str, timeout: float = HTTP_TIMEOUT_S) -> Tuple[int, bool]:
     """(content length, range support); servers that reject HEAD (405/501)
     simply get the whole-body-GET fallback."""
-    import urllib.error
-
     req = urllib.request.Request(url, method="HEAD")
     try:
-        with urllib.request.urlopen(req) as r:
+        with _open(req, timeout) as r:
             size = int(r.headers.get("Content-Length", -1))
             ranges = r.headers.get("Accept-Ranges", "") == "bytes"
     except (urllib.error.HTTPError, urllib.error.URLError):
@@ -41,12 +69,13 @@ def _head(url: str) -> Tuple[int, bool]:
     return size, ranges
 
 
-def read_url_bytes(url: str, block: int = _DEFAULT_BLOCK) -> bytes:
+def read_url_bytes(url: str, block: int = _DEFAULT_BLOCK,
+                   timeout: float = HTTP_TIMEOUT_S) -> bytes:
     """Fetch a URL's body with block-ranged GETs (HttpReader.cs:78-105);
     servers without range support get one whole-body GET."""
-    size, ranges = _head(url)
+    size, ranges = _head(url, timeout)
     if not ranges or size < 0:
-        with urllib.request.urlopen(url) as r:
+        with _open(urllib.request.Request(url), timeout) as r:
             return r.read()
     chunks: List[bytes] = []
     off = 0
@@ -54,7 +83,7 @@ def read_url_bytes(url: str, block: int = _DEFAULT_BLOCK) -> bytes:
         end = min(off + block, size) - 1
         req = urllib.request.Request(
             url, headers={"Range": f"bytes={off}-{end}"})
-        with urllib.request.urlopen(req) as r:
+        with _open(req, timeout) as r:
             body = r.read()
             if r.status != 206:
                 # advertised ranges but served the full body — trusting
@@ -70,12 +99,13 @@ def read_url_bytes(url: str, block: int = _DEFAULT_BLOCK) -> bytes:
     return b"".join(chunks)
 
 
-def enumerate_http(url: str) -> List[str]:
+def enumerate_http(url: str,
+                   timeout: float = HTTP_TIMEOUT_S) -> List[str]:
     """Partition enumeration: a URL ending in ``/`` returns its partition
     file list (newline-separated relative names); else the URL itself."""
     if not url.endswith("/"):
         return [url]
-    with urllib.request.urlopen(url) as r:
+    with _open(urllib.request.Request(url), timeout) as r:
         body = r.read().decode()
     names = [ln.strip() for ln in body.splitlines() if ln.strip()]
     if not names:

@@ -32,7 +32,14 @@ class WorkerFailure(RuntimeError):
 
 
 class ClusterJobError(RuntimeError):
-    """The job itself raised on a worker (plan/UDF/capacity error)."""
+    """The job itself raised on a worker (plan/UDF/capacity error).
+    ``missing_token`` carries a lost cluster-resident token when that is
+    the cause (structured, from the worker's reply — the driver's healing
+    path reads this attribute, never the message text)."""
+
+    def __init__(self, msg: str, missing_token=None):
+        super().__init__(msg)
+        self.missing_token = missing_token
 
 
 def _free_port() -> int:
@@ -560,9 +567,17 @@ class LocalCluster(ClusterBackend):
         if errs:
             self._kill_all()  # gang state is unknown after an error
             first = min(errs)
+            # ANY failing worker's lost-resident tag makes the job
+            # healable (a peer may fail differently, e.g. a collective
+            # abort after the tagged worker raised)
+            tok = next((replies[p].get("missing_token")
+                        for p in sorted(errs)
+                        if replies[p].get("missing_token") is not None),
+                       None)
             raise ClusterJobError(
                 f"{what} failed on worker(s) {sorted(errs)}; worker "
-                f"{first} error:\n{errs[first]}")
+                f"{first} error:\n{errs[first]}",
+                missing_token=tok)
         return replies
 
 

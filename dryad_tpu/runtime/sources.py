@@ -12,7 +12,27 @@ from __future__ import annotations
 from typing import Any, Dict, Mapping
 
 __all__ = ["DeferredSource", "columns_spec", "text_spec", "store_spec",
-           "preferred_worker_for_partitions", "build_source", "count_lines"]
+           "preferred_worker_for_partitions", "build_source", "count_lines",
+           "MissingResidentToken"]
+
+
+class MissingResidentToken(KeyError):
+    """A plan referenced a cluster-resident token this worker doesn't hold
+    (the gang restarted since it was cached).  Carries the token as
+    STRUCTURED data: the worker copies ``.token`` into its error reply's
+    ``missing_token`` field, and the driver's resident-healing
+    (api/dataset.py _lost_resident_token) keys off that field — never off
+    the message text (ADVICE r3)."""
+
+    def __init__(self, token: str):
+        super().__init__(
+            f"resident token {token!r} not present on this worker — the "
+            f"gang restarted since it was cached; re-run the producing "
+            f"query")
+        self.token = token
+
+    def __str__(self) -> str:  # KeyError quotes its arg; keep the prose
+        return self.args[0]
 
 
 class DeferredSource:
@@ -133,10 +153,7 @@ def build_source(spec: Dict[str, Any], mesh, resident=None):
     if kind == "resident":
         tok = spec["token"]
         if resident is None or tok not in resident:
-            raise KeyError(
-                f"resident token {tok!r} not present on this worker — "
-                f"the gang restarted since it was cached; re-run the "
-                f"producing query")
+            raise MissingResidentToken(tok)
         return resident[tok]
     if kind == "columns":
         from dryad_tpu.exec.data import pdata_from_host

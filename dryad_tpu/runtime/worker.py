@@ -29,6 +29,15 @@ def _configure_jax(platform: str, devices_per_process: int) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 
+def _tag_missing_token(reply: dict, exc: BaseException) -> None:
+    """Copy a MissingResidentToken's token into the error reply as
+    STRUCTURED data (the driver's resident healing keys off this field,
+    not the traceback text — ADVICE r3)."""
+    from dryad_tpu.runtime.sources import MissingResidentToken
+    if isinstance(exc, MissingResidentToken):
+        reply["missing_token"] = exc.token
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True)
@@ -178,10 +187,11 @@ def main(argv=None) -> int:
                 cfg = msg.get("config") or JobConfig()
                 reply["result"] = execute_stream_job(
                     msg["spec"], fn_table, mesh, cfg)
-            except Exception:
+            except Exception as e:
                 reply = {"ok": False, "pid": args.process_id,
                          "job": msg.get("job"),
                          "error": traceback.format_exc()}
+                _tag_missing_token(reply, e)
             if not _send_reply(reply):
                 lost_control = True
                 break
@@ -212,10 +222,11 @@ def main(argv=None) -> int:
                     # every worker ships ITS partitions' rows (parallel
                     # collect); the driver concatenates parts in pid order
                     reply["table_part"] = table
-            except Exception:
+            except Exception as e:
                 reply = {"ok": False, "pid": args.process_id,
                          "job": msg.get("job"),
                          "error": traceback.format_exc()}
+                _tag_missing_token(reply, e)
             reply["events"] = events
             if not _send_reply(reply):
                 lost_control = True

@@ -14,6 +14,9 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+from dryad_tpu.utils.compile_cache import (
+    DEFAULT_CACHE_DIR as _DEFAULT_COMPILE_CACHE_DIR)
+
 __all__ = ["JobConfig"]
 
 
@@ -34,6 +37,13 @@ class JobConfig:
     range_samples_per_partition: int = 4096
     # compiled-stage LRU entries (per executor)
     compile_cache_size: int = 256
+    # persistent (on-disk) XLA compilation cache shared by all processes:
+    # the reference pays vertex codegen once per job (csc BuildAssembly,
+    # DryadLinqCodeGen.cs:2283); this is our once-per-(program, shapes)
+    # equivalent across driver restarts AND worker processes.  None
+    # disables (utils/compile_cache.py — the single source of the
+    # default path)
+    compilation_cache_dir: Optional[str] = _DEFAULT_COMPILE_CACHE_DIR
     # device-time profiling: when set, every executor run is wrapped in a
     # jax.profiler trace written under this directory (open with
     # TensorBoard / xprof — the device-timeline view the reference

@@ -190,3 +190,47 @@ def test_cluster_backend_factory_registry():
             make_cluster("nope")
     finally:
         _FACTORIES.pop("dummy", None)
+
+
+def test_persistent_compile_cache_knob(tmp_path):
+    """compilation_cache_dir points JAX's persistent compile cache at the
+    given directory (created on demand); None leaves it untouched."""
+    import jax
+
+    from dryad_tpu.utils.compile_cache import enable_persistent_cache
+
+    d = str(tmp_path / "nested" / "cc")
+    got = enable_persistent_cache(d)
+    assert got == d
+    import os
+    assert os.path.isdir(d)
+    assert jax.config.jax_compilation_cache_dir == d
+    # None DISABLES for the process (the jax config is process-global)
+    assert enable_persistent_cache(None) is None
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_bench_history_flags_regressions():
+    """benchmarks.history flags >10% slides between rounds and compares a
+    fresh run against the last recorded round (VERDICT r3 weak 3)."""
+    from benchmarks import history
+
+    rounds = {"r01": {"terasort_rows_s_chip": 100.0,
+                      "pagerank_compile_s": 50.0},
+              "r02": {"terasort_rows_s_chip": 80.0,      # -20%: flag
+                      "pagerank_compile_s": 70.0}}       # +40%: flag
+    flags = history.flag_regressions(rounds)
+    assert any("terasort_rows_s_chip" in f for f in flags)
+    assert any("pagerank_compile_s" in f for f in flags)
+    assert history.flag_regressions({"r01": rounds["r01"],
+                                     "r02": rounds["r01"]}) == []
+
+    cmp = history.compare_current({"terasort_rows_s_chip": 60.0}, rounds)
+    assert cmp["baseline_round"] == "r02"
+    assert cmp["regressions"] and "-25%" in cmp["regressions"][0]
+
+    # the real captures parse and include the recorded r02->r03 OOC slide
+    real = history.collect()
+    assert "r03" in real
+    assert any("terasort_ooc_rows_s_chip" in f
+               for f in history.flag_regressions(real))

@@ -114,3 +114,23 @@ def test_http_unknown_scheme_still_errors():
     from dryad_tpu.io.providers import UnknownSchemeError
     with pytest.raises(UnknownSchemeError):
         Context().read("gopher://nowhere/x")
+
+
+def test_http_timeout_raises_ioerror():
+    """A stalled server fails the read with a named IOError instead of
+    hanging the driver forever (ADVICE r3: every urlopen carries a
+    timeout)."""
+    import socket
+
+    from dryad_tpu.io.http_provider import read_url_bytes
+
+    # a listener that accepts but never responds
+    stall = socket.socket()
+    stall.bind(("127.0.0.1", 0))
+    stall.listen(1)
+    url = f"http://127.0.0.1:{stall.getsockname()[1]}/slow.txt"
+    try:
+        with pytest.raises(IOError, match="timed out.*slow.txt"):
+            read_url_bytes(url, timeout=0.4)
+    finally:
+        stall.close()

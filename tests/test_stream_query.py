@@ -302,3 +302,27 @@ def test_stream_unsupported_ops_fail_clearly(store):
         ds.zip_with(other).collect()
     with pytest.raises(StreamExecutionError, match="group_median"):
         ds.group_median(["k"], "v").collect()
+
+
+def test_stream_right_join_wide_right_keys(store, data, dbg, tmp_path):
+    """Right/full join where RIGHT key strings are wider than the left
+    column's max_len: unmatched right keys must arrive uncorrupted (the
+    streamed out_schema widens to max(left, right) — ADVICE r3)."""
+    lk = [b"a", b"bb", b"cc"] * 40
+    left = {"key": lk, "v": np.arange(len(lk), dtype=np.int32)}
+    lstore = str(tmp_path / "wide_left")
+    Context(config=JobConfig(string_max_len=2)).from_columns(
+        left, str_max_len=2).to_store(lstore)
+    right = {"key": [b"bb", b"longkey!", b"xx"],
+             "w": np.array([7, 8, 9], np.int32)}
+
+    for how in ("right", "full"):
+        ctx = _sctx()
+        got = (ctx.read_store_stream(lstore, chunk_rows=CHUNK)
+               .join(ctx.from_columns(right, str_max_len=8), ["key"],
+                     expansion=3.0, how=how).collect())
+        exp = (dbg.from_columns(left, str_max_len=2)
+               .join(dbg.from_columns(right, str_max_len=8), ["key"],
+                     expansion=3.0, how=how).collect())
+        assert_same_rows(got, exp)
+        assert b"longkey!" in set(bytes(x) for x in got["key"])
