@@ -181,6 +181,32 @@ def main():
     _note("bench: terasort egress...")
     ts_e2e_s = _bench(lambda: tq.collect(), warmup=0)
 
+    # DEVICE-TRUTH sort roofline: this environment's per-dispatch tunnel
+    # floor (see micro.bench_device_truth) swamps single-call stage walls,
+    # so the kernel's own rate is slope-measured with in-program
+    # repetition and compared against the slope-measured TRUE HBM rate.
+    _note("bench: sort/group device-truth slopes...")
+    from benchmarks.micro import slope_time
+    from dryad_tpu.data.columnar import Batch, StringColumn, batch_from_numpy
+    from dryad_tpu.ops import kernels as _k
+
+    _tb = batch_from_numpy(recs, str_max_len=10)
+    _kl = _tb.columns["key"].lengths
+    _pay = _tb.columns["payload"]
+    _cnt = _tb.count
+    _kd = _tb.columns["key"].data
+    _vary = jax.jit(lambda d, s: d ^ s)
+
+    def _sort_body(i, sd):
+        b = Batch({"key": StringColumn(sd ^ jnp.uint8(1), _kl),
+                   "payload": _pay}, _cnt)
+        return _k.sort_by_columns(b, [("key", False)]).columns["key"].data
+
+    sort_dev_s = slope_time(_sort_body,
+                            lambda j: _vary(_kd, jnp.uint8(hash(j) % 31)))
+    hbm_true = m["hbm_copy_gbps_true"]
+    sort_gbps_dev = sort_bytes / sort_dev_s / (1 << 30)
+
     # ---- TeraSort out-of-core via the PLAIN streamed Dataset API ----
     # (config 2, >HBM capability regime: device working set O(chunk_rows))
     from dryad_tpu.exec import ooc as _ooc
@@ -192,12 +218,13 @@ def main():
         rows = min(chunk, n_ooc - i * chunk)
         return terasort.gen_records(rows, seed=1_000_003 + i)
 
-    def run_ooc(depth):
+    def run_ooc(depth, incore=0):
         src = _ooc.ChunkSource.from_generator(gen, n_chunks, chunk,
                                               str_max_len=10)
         sctx = Context(mesh=mesh,
                        config=JobConfig(ooc_chunk_rows=chunk,
-                                        ooc_inflight=depth))
+                                        ooc_inflight=depth,
+                                        ooc_incore_bytes=incore))
         out_dir = tempfile.mkdtemp(prefix="bench-ooc-")
         t0 = time.time()
         (sctx.from_stream(src).order_by([("key", False)])
@@ -216,6 +243,15 @@ def main():
     ooc_d2 = run_ooc(2)  # double-buffered
     ooc_rows = n_ooc / ooc_d2 / nchips
     ooc_shuffle_gbps = n_ooc * 18 / ooc_d2 / (1 << 30)
+    # adaptive tier (default config): data under ooc_incore_bytes skips
+    # the per-chunk host round-trips for ONE device sort
+    _note("bench: terasort ooc (adaptive in-core tier)...")
+    run_ooc(2, incore=1 << 30)  # warm
+    ooc_ad = run_ooc(2, incore=1 << 30)
+    ooc_ad_rows = n_ooc / ooc_ad / nchips
+    # this environment's hard ceiling: the sorted output must cross the
+    # device->host link once (store write), 18 B/row
+    link_bound_rows = m["d2h_gbps"] * (1 << 30) / 18
 
     # ---- configs 3-5: ALWAYS measured fresh; sizes shrink when the
     # budget is tight (stale numbers never served — VERDICT r2 weak 1)
@@ -230,6 +266,25 @@ def main():
     t0 = time.time()
     groupbyreduce.groupbyreduce_query(ctx3.from_columns(pairs)).collect()
     comp, runw = _stage_sums(gb_log.events)
+
+    # device-truth group roofline (same methodology as the sort row)
+    _gk = jnp.asarray(pairs["k"])
+    _gcnt = jnp.asarray(n_gb, jnp.int32)
+
+    _gv = jnp.asarray(pairs["v"])
+    _gvary = jax.jit(lambda v, s: v + s)
+
+    def _group_body(i, v):
+        b = Batch({"k": _gk, "v": v + 1.0}, _gcnt)
+        out = _k.group_aggregate(b, ["k"], {
+            "n": ("count", None), "s": ("sum", "v"), "m": ("mean", "v"),
+            "lo": ("min", "v"), "hi": ("max", "v")})
+        return v + out.columns["s"]
+
+    group_dev_s = slope_time(_group_body,
+                             lambda j: _gvary(_gv,
+                                              jnp.float32(hash(j) % 13)))
+    group_gbps_dev = n_gb * 12 * 2 / group_dev_s / (1 << 30)
     extras["groupbyreduce"] = {
         "rows": n_gb, "wall_s_incl_compile": round(time.time() - t0, 2),
         "compile_s": comp, "stage_run_s": runw,
@@ -237,6 +292,11 @@ def main():
         "group_roofline_pct": round(
             100 * (n_gb * 12 * 2 / max(runw, 1e-9) / (1 << 30)) / hbm_gbps,
             2),
+        "device_truth": {
+            "group_device_ms": round(group_dev_s * 1e3, 2),
+            "group_gbps_device": round(group_gbps_dev, 2),
+            "group_roofline_pct_device": round(
+                100 * group_gbps_dev / hbm_true, 2)},
         "stages_wall_s": _stage_breakdown(gb_log.events)}
 
     _note(f"bench: kmeans... ({_remaining(budget):.0f}s left)")
@@ -353,6 +413,18 @@ def main():
                 "sort_roofline_pct": round(100 * sort_gbps / hbm_gbps, 2),
                 "sort_bytes_touched_gbps": round(sort_gbps, 3),
                 "hbm_copy_gbps": round(hbm_gbps, 2),
+                "device_truth": {
+                    "note": "stage walls above include a measured "
+                            "per-dispatch tunnel floor (transport."
+                            "dispatch_floor_ms); these rows are "
+                            "slope-measured in-program device time vs "
+                            "the TRUE HBM rate",
+                    "sort_device_ms": round(sort_dev_s * 1e3, 2),
+                    "sort_gbps_device": round(sort_gbps_dev, 2),
+                    "sort_roofline_pct_device": round(
+                        100 * sort_gbps_dev / hbm_true, 2),
+                    "hbm_copy_gbps_true": round(hbm_true, 1),
+                },
             },
             "terasort_ooc_streamed": {
                 "api": "plain Dataset (from_stream -> order_by -> "
@@ -363,6 +435,19 @@ def main():
                 "overlap_ratio": round(ooc_d2 / ooc_d1, 3),
                 "rows_per_sec_chip": round(ooc_rows, 1),
                 "shuffle_gbps_achieved": round(ooc_shuffle_gbps, 4),
+                "note": "forced out-of-core machinery "
+                        "(ooc_incore_bytes=0): every chunk round-trips "
+                        "the ~MB/s remote tunnel twice",
+            },
+            "terasort_ooc_adaptive": {
+                "api": "default config: in-core tier engaged "
+                       "(ooc_incore_bytes, exec/ooc.external_sort)",
+                "rows": n_ooc, "wall_s": round(ooc_ad, 3),
+                "rows_per_sec_chip": round(ooc_ad_rows, 1),
+                "link_bound_rows_per_sec_chip": round(link_bound_rows, 1),
+                "note": "output must cross the measured d2h link once "
+                        "(18 B/row) — rows/s is link-bound on this "
+                        "tunnel, not kernel-bound",
             },
             **extras,
             "shuffle": {

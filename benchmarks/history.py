@@ -42,6 +42,14 @@ _PATTERNS: Dict[str, Tuple[str, bool]] = {
     "kmeans_compile_s": (
         r'"kmeans_5iter": \{[^{}]*?"compile_s": ([0-9.]+)', False),
     "wire_utilization_pct": (r'"wire_utilization_pct": ([0-9.]+)', True),
+    # device-truth rows (slope-measured, round 4+): immune to the
+    # per-dispatch tunnel floor that pollutes single-call stage walls
+    "sort_device_ms": (r'"sort_device_ms": ([0-9.]+)', False),
+    "group_device_ms": (r'"group_device_ms": ([0-9.]+)', False),
+    "sort_roofline_pct_device": (
+        r'"sort_roofline_pct_device": ([0-9.]+)', True),
+    "group_roofline_pct_device": (
+        r'"group_roofline_pct_device": ([0-9.]+)', True),
 }
 
 

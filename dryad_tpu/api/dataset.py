@@ -257,17 +257,21 @@ class Context:
         """Stream a persisted store through the plain Dataset API —
         the >HBM path (1 TB TeraSort north star, BASELINE.md config 2).
 
-        On a cluster Context this returns a ClusterStream: every worker
-        streams its own store-partition subset and the gang runs chunk-
-        wave exchanges over the mesh (runtime/stream_cluster.py) — a
-        restricted surface (chunk-local ops + sort/group/count)."""
+        On a cluster Context this is an ORDINARY Dataset too: the query
+        plans through the normal lowering (exchanges included) and the
+        gang executes it as chunk waves + per-device bucket streams
+        (runtime/stream_plan.py) — the full operator surface, not a
+        restricted mini-API (VERDICT r3 item 3)."""
+        cr = chunk_rows or self.config.ooc_chunk_rows
         if self.cluster is not None:
-            from dryad_tpu.runtime.stream_cluster import ClusterStream
-            return ClusterStream(self, path,
-                                 chunk_rows or self.config.ooc_chunk_rows)
+            from dryad_tpu.runtime.sources import DeferredSource
+            spec = {"kind": "store_stream", "path": path,
+                    "chunk_rows": cr, "capacity": cr}
+            node = E.Source(parents=(), data=DeferredSource(spec),
+                            _npartitions=self.nparts)
+            return Dataset(self, node)
         from dryad_tpu.exec.ooc import ChunkSource
-        cs = ChunkSource.from_store(
-            path, chunk_rows or self.config.ooc_chunk_rows)
+        cs = ChunkSource.from_store(path, cr)
         return self.from_stream(cs)
 
     def read_text_stream(self, path, column: str = "line",

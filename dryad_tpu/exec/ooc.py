@@ -494,8 +494,11 @@ def _make_scatter_fn(key: str, n_buckets: int):
     def fn(b: Batch, bounds: jax.Array):
         from dryad_tpu.parallel.shuffle import range_dest_lane
 
+        from dryad_tpu.ops.kernels import searchsorted_small
+
         lane = range_dest_lane(b.columns[key])
-        dest = jnp.searchsorted(bounds, lane, side="right").astype(jnp.int32)
+        dest = searchsorted_small(bounds, lane,
+                                  side="right").astype(jnp.int32)
         dest = jnp.where(b.valid_mask(), dest, n_buckets)  # padding last
         order = jnp.argsort(dest, stable=True)
         grouped = b.gather(order)
@@ -563,7 +566,8 @@ class _BucketStore:
     def fragments(self, bucket: int) -> List[HChunk]:
         if not self.spill_dir:
             return self._ram[bucket]
-        self._files[bucket].flush()
+        if not self._files[bucket].closed:
+            self._files[bucket].flush()
         out: List[HChunk] = []
         with open(self._files[bucket].name, "rb") as f:
             for n in self._frag_rows[bucket]:
@@ -596,8 +600,10 @@ class _BucketStore:
             self._ram[bucket] = []
 
     def close(self) -> None:
+        """Release WRITE handles; fragments() keep reading by name."""
         for f in self._files:
-            f.close()
+            if not f.closed:
+                f.close()
 
 
 def _sorted_bucket_chunks(schema, frags: List[HChunk],
@@ -656,18 +662,39 @@ def _sorted_bucket_chunks(schema, frags: List[HChunk],
         yield HChunk(cols, len(idx))
 
 
+def _schema_row_bytes(schema) -> int:
+    total = 0
+    for spec in schema.values():
+        if spec["kind"] == "str":
+            total += spec["max_len"] + 4
+        else:
+            dt = np.dtype(spec["dtype"])
+            total += dt.itemsize * int(
+                np.prod(tuple(spec.get("shape", ())) or (1,)))
+    return max(total, 1)
+
+
 def external_sort(src: ChunkSource, keys: Sequence[Tuple[str, bool]],
                   n_buckets: int | None = None,
                   spill_dir: Optional[str] = None,
-                  depth: int | None = None) -> Iterator[HChunk]:
+                  depth: int | None = None,
+                  incore_bytes: int = 0) -> Iterator[HChunk]:
     """Globally sort an arbitrarily large chunk stream; yields sorted
-    chunks in order.  Device working set stays O(chunk_rows).
+    chunks in order.  Device working set stays O(chunk_rows) — except the
+    in-core tier below.
 
     Pass A samples range bounds on the primary key; pass B scatters chunks
     into range buckets on device (double-buffered); pass C sorts each
     bucket (recursing on oversize buckets) and emits them in bucket order —
     range buckets make concatenation globally sorted, exactly the
     TeraSort plan (sampling + RangePartition, BASELINE.md config 2).
+
+    Memory-hierarchy tier (``incore_bytes`` > 0, from
+    JobConfig.ooc_incore_bytes): pass A already counts the total rows; a
+    dataset that fits the budget skips passes B/C for ONE device sort —
+    one H2D, one sort program, one D2H — instead of round-tripping every
+    chunk through the host twice.  The reference picks RAM FIFO channels
+    over disk files by the same criterion (channelbufferqueue.cpp:777).
     """
     if depth is None:
         from dryad_tpu.utils.config import JobConfig
@@ -677,6 +704,21 @@ def external_sort(src: ChunkSource, keys: Sequence[Tuple[str, bool]],
 
     # pass A: one streaming pass collects samples AND the total row count
     samples, total = _collect_samples(src, key0)
+
+    if incore_bytes > 0 and total * _schema_row_bytes(src.schema) \
+            <= incore_bytes:
+        # in-core tier: the whole dataset in one device sort
+        merged = _concat_hchunks(src.schema, list(src))
+        cap = 1
+        while cap < max(merged.n, 1):
+            cap *= 2
+        sort_fn = _make_sort_fn(tuple(tuple(k) for k in keys))
+        out = _batch_to_chunk(sort_fn(_chunk_to_batch(merged, cap)))
+        for s in range(0, max(out.n, 1), chunk_rows):
+            e = min(s + chunk_rows, out.n)
+            if e > s:
+                yield _slice_hchunk(out, s, e)
+        return
     nb = n_buckets or max(2, -(-total // chunk_rows) * 2)
     bounds = _bounds_from_samples(samples, nb)
     jbounds = jnp.asarray(bounds)

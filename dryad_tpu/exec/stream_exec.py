@@ -415,7 +415,8 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
         def it_sort():
             return ooc.external_sort(cs, list(keys),
                                      spill_dir=_fresh_spill(spill_dir),
-                                     depth=config.ooc_inflight)
+                                     depth=config.ooc_inflight,
+                                     incore_bytes=config.ooc_incore_bytes)
 
         return ChunkSource(it_sort, cs.schema, cs.chunk_rows)
     if k == "group":

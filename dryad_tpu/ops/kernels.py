@@ -40,16 +40,171 @@ __all__ = [
 AGG_KINDS = ("sum", "count", "min", "max", "mean", "any", "all")
 
 
+def searchsorted_small(bounds: jax.Array, q: jax.Array,
+                       side: str = "left") -> jax.Array:
+    """searchsorted against a SMALL sorted array (partition bounds, bucket
+    splitters).  jnp.searchsorted's default 'scan' method lowers to a
+    while loop of random gathers — measured ~180 ms per 1M queries on TPU
+    — while 'compare_all' fuses into |bounds| vectorized compares
+    (~free for |bounds| <= a few thousand)."""
+    return jnp.searchsorted(bounds, q, side=side, method="compare_all")
+
+
+def searchsorted_big(table: jax.Array, q: jax.Array,
+                     side: str = "left") -> jax.Array:
+    """searchsorted against a LARGE sorted array (join candidate ranges).
+    'sort' method = one variadic device sort of (table ++ queries) —
+    O((n+m) log^2) vectorized passes instead of the scan method's
+    log(n) rounds of random gathers (TPU random gathers run ~9 ns/row;
+    sorts ride the vector units)."""
+    return jnp.searchsorted(table, q, side=side, method="sort")
+
+
+# ---------------------------------------------------------------------------
+# packed row transport: u32 word lanes carried as sort VALUE operands
+#
+# TPU random gathers cost ~9 ns/row and scatters serialize, while the
+# variadic sort network streams its value operands with vector-unit
+# memory access — measured 3.5x faster to CARRY a packed 20-byte payload
+# through lax.sort than to lexsort indices and gather the columns
+# (benchmarks/prim_probe.py).  So every argsort+gather pair below is
+# expressed as ONE stable lax.sort over (key lanes..., packed words...).
+
+
+def _pack_columns_u32(cols: Dict[str, Any]) -> Tuple[List[jax.Array], List]:
+    """Columns -> list of uint32 word lanes [cap] + a reassembly spec."""
+    lanes: List[jax.Array] = []
+    spec: List[Tuple] = []
+    for name in cols:
+        v = cols[name]
+        if isinstance(v, StringColumn):
+            L = v.max_len
+            L4 = -(-L // 4) * 4
+            d = jnp.pad(v.data, ((0, 0), (0, L4 - L))) if L4 != L else v.data
+            w = jax.lax.bitcast_convert_type(
+                d.reshape(d.shape[0], L4 // 4, 4), jnp.uint32)
+            k = w.shape[1]
+            lanes.extend(w[:, j] for j in range(k))
+            lanes.append(v.lengths.astype(jnp.uint32))
+            spec.append((name, "str", L, k + 1))
+        else:
+            tail = v.shape[1:]
+            flat = v.reshape(v.shape[0], -1) if tail else v[:, None]
+            if flat.dtype.itemsize == 4:
+                w = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+            elif flat.dtype.itemsize == 8:
+                w = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+                w = w.reshape(w.shape[0], -1)
+            elif flat.dtype.itemsize == 2:
+                # f16/bf16/i16/u16: BIT-level widening (a numeric astype
+                # would truncate half-precision fractions)
+                w = jax.lax.bitcast_convert_type(
+                    flat, jnp.uint16).astype(jnp.uint32)
+            else:  # bool / u8 / i8 widen losslessly (mod-256 roundtrip)
+                w = flat.astype(jnp.uint32)
+            k = w.shape[1]
+            lanes.extend(w[:, j] for j in range(k))
+            spec.append((name, "dense", (v.dtype, tail), k))
+    return lanes, spec
+
+
+def _unpack_columns_u32(lanes: List[jax.Array], spec: List) -> Dict[str, Any]:
+    cols: Dict[str, Any] = {}
+    i = 0
+    for name, kind, meta, k in spec:
+        w = lanes[i:i + k]
+        i += k
+        if kind == "str":
+            L = meta
+            data4 = jax.lax.bitcast_convert_type(
+                jnp.stack(w[:-1], axis=1), jnp.uint8)
+            data = data4.reshape(data4.shape[0], -1)[:, :L]
+            cols[name] = StringColumn(data, w[-1].astype(jnp.int32))
+        else:
+            dtype, tail = meta
+            if dtype.itemsize == 4:
+                flat = jax.lax.bitcast_convert_type(
+                    jnp.stack(w, axis=1), dtype)
+            elif dtype.itemsize == 8:
+                flat = jax.lax.bitcast_convert_type(
+                    jnp.stack(w, axis=1).reshape(w[0].shape[0], -1, 2),
+                    dtype)
+            elif dtype.itemsize == 2:
+                flat = jax.lax.bitcast_convert_type(
+                    jnp.stack(w, axis=1).astype(jnp.uint16), dtype)
+            else:
+                flat = jnp.stack(w, axis=1).astype(dtype)
+            cols[name] = flat.reshape((flat.shape[0],) + tail) if tail \
+                else flat[:, 0]
+    return cols
+
+
+
+def _sort_segments_carry(hi: jax.Array, lo: jax.Array, valid: jax.Array,
+                         n_valid, value_lanes):
+    """Value-carry hash segmentation: ONE stable variadic sort groups rows
+    by the 64-bit hash (invalid rows fold to the all-ones sentinel and
+    sort last — same collision budget as _hash_sort_segments), carrying
+    ``value_lanes`` as sort value operands.  Returns (sorted value lanes,
+    is_start, is_end, num_groups); is_start/is_end mark each hash
+    segment's first/last SORTED row among the valid prefix.  The single
+    home of this subtle boundary logic — group_aggregate, distinct, and
+    _hash_membership all call it."""
+    cap = hi.shape[0]
+    big = jnp.uint32(0xFFFFFFFF)
+    lo_s = jnp.where(valid, lo, big)
+    hi_s = jnp.where(valid, hi, big)
+    out = jax.lax.sort((hi_s, lo_s) + tuple(value_lanes), num_keys=2,
+                       is_stable=True)
+    shi, slo = out[0], out[1]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    svalid = idx < n_valid
+    differs = jnp.concatenate([
+        jnp.ones((1,), jnp.bool_),
+        (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])])
+    is_start = svalid & differs
+    nxt_start = jnp.concatenate([is_start[1:], jnp.ones((1,), jnp.bool_)])
+    is_end = svalid & (nxt_start | (idx + 1 == n_valid))
+    num_groups = is_start.sum(dtype=jnp.int32)
+    return list(out[2:]), is_start, is_end, num_groups
+
+
+# value-carry beats lexsort+gather until the packed row is so wide that
+# carrying it through every compare-exchange pass costs more than one
+# ~9 ns/row random gather (measured crossover ~32 words = 128 B/row)
+_VALOPS_MAX_WORDS = 32
+
+
+def permute_by_sort(batch: Batch, key_lanes: Sequence[jax.Array],
+                    count=None) -> Batch:
+    """Stably sort the batch's rows by the given uint32 key lanes (most
+    significant first), moving ALL columns as packed value operands of one
+    variadic lax.sort — zero random gathers.  Falls back to
+    lexsort+single-packed-gather for very wide rows."""
+    lanes, spec = _pack_columns_u32(dict(batch.columns))
+    new_count = batch.count if count is None else count
+    if len(lanes) <= _VALOPS_MAX_WORDS:
+        out = jax.lax.sort(tuple(key_lanes) + tuple(lanes),
+                           num_keys=len(key_lanes), is_stable=True)
+        return Batch(_unpack_columns_u32(list(out[len(key_lanes):]), spec),
+                     new_count)
+    order = jnp.lexsort(tuple(reversed(list(key_lanes))))
+    words = jnp.stack(lanes, axis=1)
+    g = jnp.take(words, order, axis=0)
+    return Batch(_unpack_columns_u32([g[:, j] for j in range(g.shape[1])],
+                                     spec), new_count)
+
+
 # ---------------------------------------------------------------------------
 # filtering / compaction
 
 
 def compact(batch: Batch, keep: jax.Array) -> Batch:
-    """Move rows where ``keep`` (and valid) to the front, preserving order."""
+    """Move rows where ``keep`` (and valid) to the front, preserving order.
+    One stable value-carry sort of the "drop" bool (keepers first)."""
     keep = keep & batch.valid_mask()
-    # stable argsort of "drop" bools: keepers first, original order preserved
-    perm = jnp.argsort(~keep, stable=True)
-    return batch.gather(perm, count=keep.sum(dtype=jnp.int32))
+    return permute_by_sort(batch, ((~keep).astype(jnp.uint32),),
+                           count=keep.sum(dtype=jnp.int32))
 
 
 def filter_rows(batch: Batch, predicate) -> Batch:
@@ -153,12 +308,12 @@ def sort_by_columns(batch: Batch, keys: Sequence[Tuple[str, bool]]) -> Batch:
         # shape)
         big = jnp.uint32(0xFFFFFFFF)
         lanes = [jnp.where(invalid, big, l) for l in lanes]
-        order = jnp.lexsort(tuple(reversed(lanes)))
     else:
         # general case: explicit invalid flag as the most significant key
-        order = jnp.lexsort(tuple(reversed(lanes))
-                            + (invalid.astype(jnp.uint32),))
-    return batch.gather(order)
+        lanes = [invalid.astype(jnp.uint32)] + lanes
+    # one stable variadic sort carrying every column as packed words —
+    # no post-sort gather (measured 3.5x over lexsort+gathers)
+    return permute_by_sort(batch, lanes)
 
 
 # ---------------------------------------------------------------------------
@@ -213,12 +368,14 @@ def _group_segments(batch: Batch, key_names: Sequence[str]):
     return batch.gather(order), seg, is_start, num_groups
 
 
-def _first_row_per_segment(seg: jax.Array, cap: int,
+def _first_row_per_segment(is_start: jax.Array,
                            num_groups: jax.Array) -> jax.Array:
-    """Index of the first (sorted) row of each segment; 0 past num_groups."""
-    first_idx = jax.ops.segment_min(
-        jnp.arange(cap, dtype=jnp.int32), seg, num_segments=cap)
-    return jnp.where(jnp.arange(cap) < num_groups, first_idx, 0)
+    """Index of the first (sorted) row of each segment; 0 past num_groups.
+    Scatter-free: the g-th True in ``is_start`` is segment g's first row
+    (TPU scatters serialize; the bool argsort rides the vector units)."""
+    cap = is_start.shape[0]
+    start_pos = jnp.argsort(~is_start, stable=True).astype(jnp.int32)
+    return jnp.where(jnp.arange(cap) < num_groups, start_pos, 0)
 
 
 def _segment_bounds(is_start: jax.Array, num_groups: jax.Array,
@@ -284,81 +441,76 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     count/mean-parts) merges partial aggregates — that is how the distributed
     GroupBy works (planner splits it into local combine -> shuffle -> merge).
     """
+    # Scatter- and gather-free lowering (TPU: scatters serialize, random
+    # gathers cost ~9 ns/row): ONE variadic stable sort carries the key +
+    # agg value columns as packed words alongside the 64-bit hash lanes;
+    # segmented associative scans produce running reduces whose per-group
+    # totals sit at each segment's LAST row; a second value-carry sort on
+    # the is_end flag densifies those rows to the front in group order.
     hi, lo = hash_batch_keys(batch, key_names)
-    order, seg, is_start, num_groups = _hash_sort_segments(
-        hi, lo, batch.valid_mask())
+    valid = batch.valid_mask()
     cap = batch.capacity
     n_valid = batch.count
-
-    # scatter-free segment machinery (TPU scatters serialize — sorts and
-    # prefix sums ride the vector units): sums/counts come from cumsum
-    # boundary differences.  Integer sums are exact (wraparound cancels);
-    # float32 sums use a global prefix instead of per-segment accumulation,
-    # trading bounded extra rounding for a large constant-factor win.
-    start_pos, end_excl = _segment_bounds(is_start, num_groups, n_valid)
     idx = jnp.arange(cap, dtype=jnp.int32)
-    gmask = idx < num_groups
-    counts_g = jnp.where(gmask, end_excl - start_pos, 0)
 
-    out_cols = {}
-    # representative row per group = its segment's first (sorted) row —
-    # gathered straight from the ORIGINAL batch (compose the sort
-    # permutation with the start positions) so the full sorted batch is
-    # never materialized; agg value columns are sorted individually
-    # (narrow [cap] gathers instead of one wide string gather)
-    rep_idx = jnp.take(order, jnp.where(gmask, start_pos, 0))
-    rep = batch.gather(rep_idx)
-    for k in key_names:
-        out_cols[k] = rep.columns[k]
+    needed = list(dict.fromkeys(
+        list(key_names) + [v for _, v in aggs.values() if v]))
+    lanes, spec = _pack_columns_u32({k: batch.columns[k] for k in needed})
+    slanes, is_start, is_end, num_groups = _sort_segments_carry(
+        hi, lo, valid, n_valid, lanes)
+    scols = _unpack_columns_u32(slanes, spec)
 
-    sorted_cols: Dict[str, Any] = {}
+    run_cnt = _seg_scan_reduce((idx < n_valid).astype(jnp.int32),
+                               is_start, jnp.add)
 
-    def _sorted_col(name):
-        if name not in sorted_cols:
-            sorted_cols[name] = jnp.take(batch.columns[name], order,
-                                         axis=0)
-        return sorted_cols[name]
-
+    dense_in: Dict[str, Any] = {k: scols[k] for k in key_names}
     for out_name, (kind, vname) in aggs.items():
         if kind == "count":
-            out = counts_g
+            o = run_cnt
         elif kind in ("sum", "mean"):
-            v = _sorted_col(vname)
-            if jnp.issubdtype(v.dtype, jnp.floating):
-                # floats keep per-segment accumulation (scatter): the
-                # prefix-difference trick costs ~1e-3 relative error under
-                # cancellation, which breaks the oracle-comparison contract
-                s = jax.ops.segment_sum(v, seg, num_segments=cap)
-            else:
-                s = _seg_sum_sorted(v, start_pos, end_excl, num_groups,
-                                    n_valid)
+            v = scols[vname]
+            s = _seg_scan_reduce(v, is_start, jnp.add)
             if kind == "sum":
-                out = s
+                o = s
             else:
-                c = jnp.maximum(counts_g, 1).reshape(
+                c = jnp.maximum(run_cnt, 1).reshape(
                     (cap,) + (1,) * (s.ndim - 1))
-                out = s / c.astype(s.dtype) \
+                o = s / c.astype(s.dtype) \
                     if jnp.issubdtype(s.dtype, jnp.floating) \
                     else s.astype(jnp.float32) / c
         elif kind == "min":
-            out = jax.ops.segment_min(_sorted_col(vname), seg,
-                                      num_segments=cap)
+            o = _seg_scan_reduce(scols[vname], is_start, jnp.minimum)
         elif kind == "max":
-            out = jax.ops.segment_max(_sorted_col(vname), seg,
-                                      num_segments=cap)
+            o = _seg_scan_reduce(scols[vname], is_start, jnp.maximum)
         elif kind == "any":
-            s = _seg_sum_sorted(_sorted_col(vname).astype(jnp.int32),
-                                start_pos, end_excl, num_groups, n_valid)
-            out = s > 0
+            s = _seg_scan_reduce(scols[vname].astype(jnp.int32), is_start,
+                                 jnp.add)
+            o = s > 0
         elif kind == "all":
-            s = _seg_sum_sorted(_sorted_col(vname).astype(jnp.int32),
-                                start_pos, end_excl, num_groups, n_valid)
-            out = s == counts_g
+            s = _seg_scan_reduce(scols[vname].astype(jnp.int32), is_start,
+                                 jnp.add)
+            o = s == run_cnt
         else:
             raise ValueError(f"unknown aggregate kind {kind}")
-        out_cols[out_name] = out
+        dense_in[out_name] = o
 
+    lanes2, spec2 = _pack_columns_u32(dense_in)
+    out2 = jax.lax.sort(((~is_end).astype(jnp.uint32),) + tuple(lanes2),
+                        num_keys=1, is_stable=True)
+    dcols = _unpack_columns_u32(list(out2[1:]), spec2)
+    gmask = idx < num_groups
+    out_cols = {name: _mask_rows(v, gmask) for name, v in dcols.items()}
     return Batch(out_cols, num_groups)
+
+
+def _mask_rows(col, keep: jax.Array):
+    """Zero rows where ``keep`` is False (strings get zero data+length)."""
+    if isinstance(col, StringColumn):
+        m2 = keep.reshape(-1, 1)
+        return StringColumn(jnp.where(m2, col.data, 0),
+                            jnp.where(keep, col.lengths, 0))
+    m = keep.reshape(keep.shape + (1,) * (col.ndim - 1))
+    return jnp.where(m, col, 0)
 
 
 # ---------------------------------------------------------------------------
@@ -393,11 +545,62 @@ def _segmented_merge(seg: jax.Array, states, merge_fn):
     return scanned
 
 
-def _last_row_per_segment(seg: jax.Array, cap: int,
-                          num_groups: jax.Array) -> jax.Array:
-    last_idx = jax.ops.segment_max(
-        jnp.arange(cap, dtype=jnp.int32), seg, num_segments=cap)
-    return jnp.where(jnp.arange(cap) < num_groups, last_idx, 0)
+def _last_row_per_segment(is_start: jax.Array, num_groups: jax.Array,
+                          n_valid: jax.Array) -> jax.Array:
+    """Index of the last (sorted) row of each segment; 0 past num_groups.
+    Scatter-free via _segment_bounds (XLA CSE merges the bool argsort
+    with _first_row_per_segment's when both are used)."""
+    cap = is_start.shape[0]
+    _, end_excl = _segment_bounds(is_start, num_groups, n_valid)
+    return jnp.where(jnp.arange(cap) < num_groups,
+                     jnp.maximum(end_excl - 1, 0), 0)
+
+
+def _seg_scan_reduce(v: jax.Array, is_start: jax.Array, op,
+                     reverse: bool = False) -> jax.Array:
+    """Per-row running ``op``-reduce within each segment (rows in sorted
+    segment order, ``is_start`` marking segment firsts).  One segmented
+    associative_scan — log(cap) vectorized passes, NO scatter (TPU
+    scatters serialize; measured ~25 ms per 4M rows vs ~1 ms for scans).
+    The per-segment total sits at the segment's last row (first row with
+    ``reverse=True``, whose boundary flags must mark segment ENDS).  Float
+    accumulation order is the scan's balanced tree — no cross-segment
+    cancellation (unlike a global-prefix difference), bounded rounding
+    like numpy's pairwise sums."""
+
+    def comb(a, b):
+        va, fa = a
+        vb, fb = b
+        m = fb.reshape(fb.shape + (1,) * (va.ndim - 1))
+        return jnp.where(m, vb, op(va, vb)), fa | fb
+
+    out, _ = jax.lax.associative_scan(comb, (v, is_start), reverse=reverse)
+    return out
+
+
+def _hash_membership(hi: jax.Array, lo: jax.Array, flag: jax.Array,
+                     valid: jax.Array) -> jax.Array:
+    """bool [n] in ORIGINAL row order: does the row's 64-bit-hash segment
+    contain a flagged row?  Scatter- and gather-free: one value-carry sort
+    groups hashes (carrying the flag and the original position), forward +
+    reverse segmented max-scans spread each segment's answer to every row,
+    and a second 1-key sort on the carried position restores original
+    order (the inverse-permutation-as-sort trick — TPU scatters
+    serialize)."""
+    n = hi.shape[0]
+    iota = jnp.arange(n, dtype=jnp.uint32)
+    # NOTE: valid rows sort as a prefix ONLY when valid is itself a
+    # prefix mask; callers concatenate whole-batch valid prefixes, and
+    # _sort_segments_carry's sentinel fold sorts the invalid rows last
+    # regardless, so is_start/is_end stay correct
+    (sflag, siota), is_start, is_end, _ng = _sort_segments_carry(
+        hi, lo, valid, valid.sum(dtype=jnp.int32),
+        (flag.astype(jnp.uint32), iota))
+    fwd = _seg_scan_reduce(sflag, is_start, jnp.maximum)
+    bwd = _seg_scan_reduce(sflag, is_end, jnp.maximum, reverse=True)
+    tot = jnp.maximum(fwd, bwd)
+    _, member = jax.lax.sort((siota, tot), num_keys=1, is_stable=True)
+    return member > 0
 
 
 def _group_states(batch: Batch, key_names: Sequence[str],
@@ -408,11 +611,11 @@ def _group_states(batch: Batch, key_names: Sequence[str],
     cap = batch.capacity
 
     out_cols = {}
-    rep = sb.gather(_first_row_per_segment(seg, cap, num_groups))
+    rep = sb.gather(_first_row_per_segment(is_start, num_groups))
     for k in key_names:
         out_cols[k] = rep.columns[k]
 
-    last = _last_row_per_segment(seg, cap, num_groups)
+    last = _last_row_per_segment(is_start, num_groups, batch.count)
     valid_rows = jnp.arange(cap) < num_groups
     merged_states = {}
     for out_name, (seed, merge_fn, _fin) in decs.items():
@@ -497,11 +700,11 @@ def group_decompose_merge(batch: Batch, key_names: Sequence[str],
     cap = batch.capacity
 
     out_cols = {}
-    rep = sb.gather(_first_row_per_segment(seg, cap, num_groups))
+    rep = sb.gather(_first_row_per_segment(is_start, num_groups))
     for k in key_names:
         out_cols[k] = rep.columns[k]
 
-    last = _last_row_per_segment(seg, cap, num_groups)
+    last = _last_row_per_segment(is_start, num_groups, batch.count)
     valid_rows = jnp.arange(cap) < num_groups
     for out_name, (_seed, merge_fn, fin) in decs.items():
         treedef = state_box[out_name]
@@ -685,12 +888,24 @@ def group_regroup_apply(batch: Batch, key_names: Sequence[str], fn,
 
 
 def distinct(batch: Batch, key_names: Sequence[str] | None = None) -> Batch:
-    """One representative row per distinct key (all columns kept)."""
+    """One representative row per distinct key (all columns kept).
+
+    Gather-free: value-carry sort by hash, then a second value-carry sort
+    on the is_start flag densifies each segment's first row to the front
+    in group order (see the packed-row transport note above)."""
     keys = list(key_names) if key_names else sorted(batch.names)
-    sb, seg, is_start, num_groups = _group_segments(batch, keys)
+    hi, lo = hash_batch_keys(batch, keys)
     cap = batch.capacity
-    return sb.gather(_first_row_per_segment(seg, cap, num_groups),
-                     count=num_groups)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    lanes, spec = _pack_columns_u32(dict(batch.columns))
+    slanes, is_start, _is_end, num_groups = _sort_segments_carry(
+        hi, lo, batch.valid_mask(), batch.count, lanes)
+    out2 = jax.lax.sort(((~is_start).astype(jnp.uint32),) + tuple(slanes),
+                        num_keys=1, is_stable=True)
+    cols = _unpack_columns_u32(list(out2[1:]), spec)
+    gmask = idx < num_groups
+    return Batch({k: _mask_rows(v, gmask) for k, v in cols.items()},
+                 num_groups)
 
 
 # ---------------------------------------------------------------------------
@@ -804,8 +1019,8 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     pos = jnp.arange(right.capacity)
     rkey = jnp.where(pos < right.count, rkey, jnp.uint32(0xFFFFFFFF))
 
-    start = jnp.searchsorted(rkey, lh, side="left")
-    stop = jnp.searchsorted(rkey, lh, side="right")
+    start = searchsorted_big(rkey, lh, side="left")
+    stop = searchsorted_big(rkey, lh, side="right")
     mult = jnp.where(lvalid, stop - start, 0)
     if how not in ("inner", "left", "right", "full"):
         raise ValueError(f"unknown join how={how!r}")
@@ -819,7 +1034,7 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     cum = jnp.cumsum(mult)
     total = cum[-1]
     t = jnp.arange(out_capacity, dtype=jnp.int32)
-    lid = jnp.searchsorted(cum, t, side="right").astype(jnp.int32)
+    lid = searchsorted_big(cum, t, side="right").astype(jnp.int32)
     lid_c = jnp.minimum(lid, left.capacity - 1)
     base = cum[lid_c] - mult[lid_c]
     rid = (jnp.take(start, lid_c) + (t - base)).astype(jnp.int32)
@@ -971,12 +1186,7 @@ def right_match_mask(left: Batch, right: Batch, left_keys: Sequence[str],
     is_left = jnp.concatenate([jnp.zeros(right.capacity, jnp.int32),
                                lvalid.astype(jnp.int32)])
     valid = jnp.concatenate([rvalid, lvalid])
-    n = hi.shape[0]
-    order, seg, _, _ = _hash_sort_segments(hi, lo, valid)
-    has_left = jax.ops.segment_max(jnp.take(is_left, order), seg,
-                                   num_segments=n)
-    row_has = jnp.take(has_left, jnp.clip(seg, 0, n - 1)) > 0
-    member = jnp.zeros((n,), jnp.bool_).at[order].set(row_has)
+    member = _hash_membership(hi, lo, is_left, valid)
     return member[:right.capacity] & rvalid
 
 
@@ -998,13 +1208,7 @@ def semi_anti_join(left: Batch, right: Batch, left_keys: Sequence[str],
     is_right = jnp.concatenate([jnp.zeros(left.capacity, jnp.int32),
                                 rvalid.astype(jnp.int32)])
     valid = jnp.concatenate([lvalid, rvalid])
-    n = hi.shape[0]
-    order, seg, _, _ = _hash_sort_segments(hi, lo, valid)
-    has_right = jax.ops.segment_max(jnp.take(is_right, order), seg,
-                                    num_segments=n)
-    row_has_right = jnp.take(has_right, jnp.clip(seg, 0, n - 1)) > 0
-    # scatter back to original positions
-    member = jnp.zeros((n,), jnp.bool_).at[order].set(row_has_right)
+    member = _hash_membership(hi, lo, is_right, valid)
     lmember = member[:left.capacity]
     keep = lvalid & (~lmember if anti else lmember)
     return compact(left, keep)

@@ -316,8 +316,10 @@ def range_exchange(batch: Batch, key: str, bounds: jax.Array,
     lane, computed host-side from samples (the reference computes these in a
     sampling stage: DryadLinqSampler.cs:42 + DrDynamicRangeDistributor.h:23).
     """
+    from dryad_tpu.ops.kernels import searchsorted_small
+
     lane = range_dest_lane(batch.columns[key])
-    dest = jnp.searchsorted(bounds, lane, side="right").astype(jnp.int32)
+    dest = searchsorted_small(bounds, lane, side="right").astype(jnp.int32)
     if descending:
         P = bounds.shape[0] + 1
         dest = (P - 1) - dest
@@ -355,7 +357,8 @@ def zip_exchange(a: Batch, b: Batch, suffix: str = "_r",
     start_b = jnp.sum(jnp.where(jnp.arange(P) < me, counts_b, 0))
 
     gidx = start_b + jnp.arange(b.capacity, dtype=jnp.int32)
-    dest = jnp.searchsorted(ends_a, gidx, side="right").astype(jnp.int32)
+    from dryad_tpu.ops.kernels import searchsorted_small
+    dest = searchsorted_small(ends_a, gidx, side="right").astype(jnp.int32)
     dest = jnp.where(gidx < total_a, dest, P)  # beyond left total: drop
 
     b2 = b.with_columns({"__zip_gidx": gidx})

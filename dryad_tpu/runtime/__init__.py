@@ -17,9 +17,12 @@ from dryad_tpu.runtime.interfaces import (ClusterBackend, cluster_backends,
                                           make_cluster, register_cluster)
 from dryad_tpu.runtime.sources import DeferredSource
 
-# the built-in backend registers under "local" (Interfaces.cs:545 role)
+# the built-in backends register here (Interfaces.cs:545 role):
+# "local" = worker processes on this box; "ssh" = one worker per remote
+# host over a remote shell, code staged per job (runtime/ssh_cluster.py)
 register_cluster("local", LocalCluster)
+from dryad_tpu.runtime.ssh_cluster import SshCluster  # noqa: E402  (registers "ssh")
 
-__all__ = ["LocalCluster", "WorkerFailure", "ClusterJobError",
-           "DeferredSource", "ClusterBackend", "register_cluster",
-           "make_cluster", "cluster_backends"]
+__all__ = ["LocalCluster", "SshCluster", "WorkerFailure",
+           "ClusterJobError", "DeferredSource", "ClusterBackend",
+           "register_cluster", "make_cluster", "cluster_backends"]

@@ -116,10 +116,15 @@ class LocalCluster(ClusterBackend):
 
     # -- lifecycle ---------------------------------------------------------
 
+    # control-listener bind address: loopback for the local backend;
+    # remote submission backends (runtime/ssh_cluster.py) bind all
+    # interfaces and advertise a reachable driver host
+    _bind_host = "127.0.0.1"
+
     def _start(self) -> None:
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", 0))
+        self._listener.bind((self._bind_host, 0))
         self._listener.listen(self.n_processes)
         control_port = self._listener.getsockname()[1]
         coord_port = _free_port()
@@ -514,7 +519,7 @@ class LocalCluster(ClusterBackend):
     def _gather_job_replies(self, job: int, timeout: float,
                             what: str) -> Dict[int, dict]:
         """Collect one reply per worker for ``job`` (shared by execute and
-        execute_stream).  On any error reply, stragglers get a 5s grace
+        streamed runs).  On any error reply, stragglers get a 5s grace
         drain (so co-errors reach the diagnosis) and the gang is torn
         down; on success every worker's reply is returned.  Elastic
         workers never receive gang jobs and are not awaited."""
@@ -579,29 +584,6 @@ class LocalCluster(ClusterBackend):
                 f"{first} error:\n{errs[first]}",
                 missing_token=tok)
         return replies
-
-
-    def execute_stream(self, spec_json: str, plan_json: str,
-                       config=None, timeout: float = 600.0
-                       ) -> Dict[int, dict]:
-        """Submit one streamed (out-of-core) SPMD job; returns EVERY
-        worker's result payload keyed by pid (streamed collects return
-        per-worker table parts — the driver concatenates them, instead of
-        funneling all rows through worker 0)."""
-        if not self.alive():
-            self.restart()
-        job = self.next_job_id()
-        queued = self.pending_release[:]
-        del self.pending_release[:len(queued)]
-        msg = {"cmd": "run_stream", "spec": spec_json, "plan": plan_json,
-               "job": job, "config": config, "release": queued}
-        for pid in self.gang_pids():
-            s = self._socks[pid]
-            s.setblocking(True)
-            protocol.send_msg(s, msg)
-            s.setblocking(False)
-        replies = self._gather_job_replies(job, timeout, "stream job")
-        return {pid: r.get("result") for pid, r in replies.items()}
 
 
 def _try_decode(buf: bytearray):

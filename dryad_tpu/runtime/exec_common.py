@@ -59,6 +59,18 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
 
     import numpy as np
 
+    from dryad_tpu.runtime.stream_plan import (execute_stream_plan,
+                                               has_stream_sources)
+    if has_stream_sources(source_specs):
+        # >HBM sources: the SAME plan runs as chunk waves + per-device
+        # bucket streams (runtime/stream_plan.py) — one lowering, two
+        # execution regimes (channelinterface.h:212 parity)
+        return execute_stream_plan(
+            plan_json, fn_table, source_specs, mesh, event_log=event_log,
+            store_path=store_path, store_partitioning=store_partitioning,
+            collect=collect, config=config, keep_token=keep_token,
+            release=release, store_compression=store_compression)
+
     for tok in release:
         _RESIDENT.pop(tok, None)
     sources = {key: build_source(spec, mesh, resident=_RESIDENT)

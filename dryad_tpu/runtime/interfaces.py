@@ -5,7 +5,7 @@ The reference separates the GM engine from any concrete scheduler behind
 (ClusterInterface/Interfaces.cs:324,491,545) — the same scheduler code
 serves local spawns and YARN containers.  This module is that seam for
 dryad_tpu: everything driver-side (Context submission, TaskFarm,
-ClusterStream) programs against :class:`ClusterBackend`, and new
+streamed plans) against :class:`ClusterBackend`, and new
 deployment targets (a GKE pod launcher, an SSH multi-host launcher)
 register themselves by name without touching the core.
 
@@ -61,12 +61,6 @@ class ClusterBackend(abc.ABC):
                 **kw) -> Dict[str, Any]:
         """Run one gang SPMD plan; returns worker 0's reply (collected
         tables merged from per-worker parts)."""
-
-    @abc.abstractmethod
-    def execute_stream(self, spec_json: str, plan_json: str,
-                       **kw) -> Dict[int, Any]:
-        """Run one streamed (out-of-core) SPMD job; returns every
-        worker's result payload keyed by pid."""
 
     # -- task-farm surface (per-task scheduling over gang + elastic) -------
 

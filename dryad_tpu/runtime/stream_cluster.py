@@ -1,38 +1,21 @@
-"""Streamed (out-of-core) jobs over the multi-process worker gang.
+"""Shared host<->mesh plumbing for streamed gang execution.
 
-VERDICT r2 item 2: compose the per-host OOC chunk streams with the sharded
-exchanges.  Every worker streams ITS OWN subset of the store's partitions
-in fixed-capacity chunks; the gang advances in lockstep through chunk
-WAVES, each wave running ONE jitted shard_map exchange over the full
-(dcn, dp) mesh (partial-aggregate-then-hash for group-by, sampled range
-scatter for sort); received rows spill into per-device host bucket stores
-between waves; after the last wave each worker finishes its buckets
-locally (recursive external sort / aggregate merge) and writes its own
-output partitions in parallel — process 0 only merges the metadata.
-
-This is the reference's architecture made SPMD: every vertex
-simultaneously streams disk channels AND participates in the cross-machine
-shuffle (SURVEY.md §2.8), with device working set O(chunk_rows) per chip
-regardless of total data size — the 1 TB TeraSort north star shape
-(BASELINE.md config 2) on a real pod.
-
-Mirrored determinism contract (runtime/exec_common.py): all processes
-derive the same wave count, the same range bounds, and the same retry
-decisions (exchange needs are pmax'd across the mesh inside the program),
-so the only cross-process coupling is the collectives themselves.
+Helpers used by runtime/stream_plan.py (the planned streamed runner) and
+runtime/exec_common.py (parallel collect / parallel store output):
+per-process host allgather, wave placement onto the global mesh, local
+shard readback, parallel partition writes with process-0 metadata commit,
+and range-bounds sampling (DryadLinqSampler.cs:42 role).
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dryad_tpu.plan.stages import StageOp
-
-__all__ = ["build_stream_spec", "execute_stream_job", "StreamJobError"]
+__all__ = ["StreamJobError", "local_batch_chunks"]
 
 _SAMPLES_PER_CHUNK = 512
 _MAX_SAMPLES = 8192
@@ -40,165 +23,6 @@ _MAX_SAMPLES = 8192
 
 class StreamJobError(RuntimeError):
     pass
-
-
-# ---------------------------------------------------------------------------
-# spec building (driver side)
-
-
-def build_stream_spec(path: str, chunk_rows: int, ops: List[StageOp],
-                      terminal: Dict[str, Any],
-                      fn_table: Optional[Dict[str, Any]] = None
-                      ) -> Tuple[str, str]:
-    """Serialize a streamed cluster job: (spec_json, fake_plan_json for
-    worker fn-table resolution).  Ops must be chunk-local (the shuffle is
-    the terminal's wave exchange, not a plan exchange).  A group
-    terminal's aggregates (builtin tags AND user Decomposables) ride as
-    an op-encoded param so callable refs ship like any UDF."""
-    from dryad_tpu.plan.serialize import _op_to_json
-    from dryad_tpu.plan.stages import Stage, StageGraph
-    from dryad_tpu.runtime.shiplan import _collect_refs
-
-    terminal = dict(terminal)
-    ship_ops = list(ops)
-    if terminal.get("kind") == "group":
-        agg_op = StageOp("__terminal_aggs__",
-                         {"aggs": dict(terminal.pop("aggs"))})
-        ship_ops.append(agg_op)
-    graph = StageGraph([Stage(id=0, legs=[], body=ship_ops)], 0)
-    user_names = {id(v): k for k, v in (fn_table or {}).items()}
-    fn_names = _collect_refs(graph, user_names)
-    shared: Dict[int, int] = {}
-    ops_json = [_op_to_json(o, fn_names, shared) for o in ops]
-    body_json = list(ops_json)
-    if terminal.get("kind") == "group":
-        terminal["aggs_op"] = _op_to_json(agg_op, fn_names, shared)
-        body_json.append(terminal["aggs_op"])
-    plan_json = json.dumps({"version": 1, "stages": [
-        {"id": 0, "label": "stream", "legs": [], "body": body_json}],
-        "out_stage": 0})
-    spec = {"source": {"path": path, "chunk_rows": chunk_rows},
-            "ops": ops_json, "terminal": terminal}
-    return json.dumps(spec), plan_json
-
-
-# ---------------------------------------------------------------------------
-# driver-side lazy wrapper
-
-
-class ClusterStream:
-    """Streamed dataset over a cluster Context — the restricted surface
-    that composes per-worker chunk streams with mesh exchanges.  Chunk-
-    local operators (select/where/split_words/flat_map) accumulate; the
-    terminals (count, order_by().to_store(), group_by().collect()/
-    .to_store()) submit ONE streamed SPMD job to the gang.  UDFs must be
-    importable or fn_table-registered, as with any cluster plan."""
-
-    def __init__(self, ctx, path: str, chunk_rows: int,
-                 ops: Optional[List[StageOp]] = None):
-        self._ctx = ctx
-        self._path = path
-        self._chunk_rows = chunk_rows
-        self._ops = list(ops or [])
-
-    def _with(self, op: StageOp) -> "ClusterStream":
-        return ClusterStream(self._ctx, self._path, self._chunk_rows,
-                             self._ops + [op])
-
-    def select(self, fn, label: str = "select") -> "ClusterStream":
-        return self._with(StageOp("fn", {"fn": fn, "label": label}))
-
-    def where(self, fn, label: str = "where") -> "ClusterStream":
-        return self._with(StageOp("filter", {"fn": fn, "label": label}))
-
-    def split_words(self, column: str, out_capacity: int,
-                    max_token_len: int | None = None,
-                    delims: bytes | None = None,
-                    lower: bool = False) -> "ClusterStream":
-        cfg = self._ctx.config
-        return self._with(StageOp("flat_tokens", {
-            "column": column, "out_capacity": out_capacity,
-            "max_token_len": max_token_len or cfg.token_max_len,
-            "delims": delims or cfg.token_delims, "lower": lower}))
-
-    def flat_map(self, fn, out_capacity: int,
-                 label: str = "flat_map") -> "ClusterStream":
-        return self._with(StageOp("flat_map", {
-            "fn": fn, "out_capacity": out_capacity, "label": label}))
-
-    # -- terminals ---------------------------------------------------------
-
-    def _submit(self, terminal: Dict[str, Any]) -> Dict[int, Any]:
-        spec_json, plan_json = build_stream_spec(
-            self._path, self._chunk_rows, self._ops, terminal,
-            self._ctx.fn_table)
-        return self._ctx.cluster.execute_stream(
-            spec_json, plan_json, config=self._ctx.config,
-            timeout=self._ctx.config.cluster_job_timeout_s)
-
-    def count(self) -> int:
-        parts = self._submit({"kind": "count"})
-        return sum(r["count"] for r in parts.values())
-
-    def order_by(self, keys) -> "_SortedClusterStream":
-        return _SortedClusterStream(self, [(k, bool(d)) for k, d in keys])
-
-    def group_by(self, keys, aggs) -> "_GroupedClusterStream":
-        """Builtin (kind, column) aggregates AND user Decomposables.  A
-        Decomposable must be REGISTERED by name (Context(fn_table=...) on
-        the driver + --fn-module FN_TABLE on the workers) — instances
-        carry no importable qualname, same constraint as the in-memory
-        cluster path.  Malformed specs fail HERE, before submission."""
-        from dryad_tpu.ops.kernels import AGG_KINDS
-        from dryad_tpu.plan.expr import Decomposable
-        for name, spec in aggs.items():
-            if isinstance(spec, Decomposable):
-                continue
-            if (isinstance(spec, tuple) and len(spec) == 2
-                    and spec[0] in AGG_KINDS):
-                continue
-            raise StreamJobError(
-                f"agg {name!r}: expected a (kind, column) tuple with kind "
-                f"in {AGG_KINDS} or a Decomposable, got {spec!r}")
-        return _GroupedClusterStream(self, list(keys), dict(aggs))
-
-
-class _SortedClusterStream:
-    def __init__(self, base: ClusterStream, keys):
-        self._base = base
-        self._keys = keys
-
-    def to_store(self, path: str) -> None:
-        self._base._submit({"kind": "sort",
-                            "keys": [list(k) for k in self._keys],
-                            "out": path})
-
-
-class _GroupedClusterStream:
-    def __init__(self, base: ClusterStream, keys, aggs):
-        self._base = base
-        self._keys = keys
-        self._aggs = aggs
-
-    def to_store(self, path: str) -> None:
-        self._base._submit({"kind": "group", "keys": self._keys,
-                            "aggs": self._aggs, "out": path})
-
-    def collect(self) -> Dict[str, Any]:
-        parts = self._base._submit({"kind": "group", "keys": self._keys,
-                                    "aggs": self._aggs, "out": None})
-        tables = [parts[pid]["table_part"] for pid in sorted(parts)]
-        tables = [t for t in tables if t is not None]
-        out: Dict[str, Any] = {}
-        for t in tables:
-            for k, v in t.items():
-                if k not in out:
-                    out[k] = v
-                elif isinstance(v, list):
-                    out[k] = list(out[k]) + list(v)
-                else:
-                    out[k] = np.concatenate([out[k], v])
-        return out
 
 
 # ---------------------------------------------------------------------------
@@ -355,165 +179,6 @@ def _expand(b):
     return jax.tree.map(lambda x: x[None], b)
 
 
-def _build_wave_fn(mesh, kind: str, params: Dict[str, Any], chunk_rows: int,
-                   scale: int, slack: int):
-    """One jitted shard_map program for a chunk wave: (optional local
-    partial aggregation) + global exchange.  Need channels are pmax'd by
-    the exchange itself, so every process reads identical retry info."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
-
-    from dryad_tpu.ops import kernels
-    from dryad_tpu.parallel import shuffle
-
-    axes = tuple(mesh.axis_names)
-    cap = chunk_rows * scale
-
-    def per_shard(batch, bounds):
-        b = _squeeze(batch)
-        if kind == "range":
-            out, nr, nsl = shuffle.range_exchange(
-                b, params["key"], bounds, cap,
-                descending=params["descending"], send_slack=slack,
-                axes=axes)
-        elif kind == "group":
-            if "decs" in params:
-                pb = kernels.group_decompose_partial(
-                    b, params["keys"], params["decs"], params["box"])
-            else:
-                pb = kernels.group_aggregate(b, params["keys"],
-                                             params["partial"])
-            out, nr, nsl = shuffle.hash_exchange(pb, params["keys"], cap,
-                                                 send_slack=slack,
-                                                 axes=axes)
-        else:
-            raise ValueError(kind)
-        need_scale = (-(-nr // jnp.int32(chunk_rows))).astype(jnp.int32)
-        info = jnp.stack([need_scale, jnp.asarray(nsl, jnp.int32),
-                          out.count.astype(jnp.int32)])
-        return _expand(out), info[None]
-
-    in_specs = (P(axes), P())
-    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                       out_specs=(P(axes), P(axes)), check_vma=False)
-    return jax.jit(fn)
-
-
-def _run_waves(cs, schema, mesh, kind: str, params: Dict[str, Any],
-               chunk_rows: int, config, bounds_arr):
-    """Advance the gang through lockstep chunk waves until every process's
-    stream is exhausted (a tiny per-wave continuation allgather keeps the
-    SPMD collective counts identical WITHOUT a counting pre-pass over the
-    data); append each wave's received rows to per-local-device bucket
-    stores (compacting group partials whenever a bucket exceeds the chunk
-    capacity — the streaming aggregation-tree role).  Returns (bucket
-    store, its row schema)."""
-    import jax
-    import jax.numpy as jnp
-
-    from dryad_tpu.exec import ooc
-    from dryad_tpu.ops import kernels
-
-    nprocs = jax.process_count()
-    dpp = mesh.devices.size // nprocs
-    start = jax.process_index() * dpp
-
-    # bucket store schema = the EXCHANGED row schema (partial rows for
-    # group) — probe with an empty chunk through the local part (for
-    # user decomposables this also fills the treedef box before any
-    # merge traces)
-    compact_fn = None
-    if kind == "group":
-        if "decs" in params:
-            pfn = (lambda b: kernels.group_decompose_partial(
-                b, params["keys"], params["decs"], params["box"]))
-        else:
-            pfn = (lambda b: kernels.group_aggregate(
-                b, params["keys"], params["partial"]))
-        probe = ooc._batch_to_chunk(jax.jit(pfn)(
-            ooc._chunk_to_batch(ooc.HChunk.empty_like(schema), 1)))
-        out_schema = ooc.chunk_schema(probe)
-        # merging partials is the associative combine; finalization
-        # (mean quotient / FinalReduce) happens only at the end
-        compact_fn = jax.jit(params["merge_fn"])
-    else:
-        out_schema = schema
-
-    # sort buckets hold the worker's ENTIRE received key range across all
-    # waves — they must spill to disk (the host-side bucket spill of the
-    # composition contract), or a 1 TB sort OOMs every worker.  Group
-    # buckets stay in RAM: compaction bounds them at one row per distinct
-    # key (<= chunk_rows).
-    spill = None
-    if kind == "range":
-        import tempfile
-        spill = tempfile.mkdtemp(prefix="wave-buckets-")
-    store = ooc._BucketStore(out_schema, dpp, spill_dir=spill)
-
-    def compact_bucket(d: int) -> None:
-        # merge accumulated partials down to one row per distinct key;
-        # pow2 device capacity bounds the number of retraces.  RAM-only
-        # buckets by construction (spill is never enabled for group).
-        assert store.spill_dir is None
-        merged = ooc._concat_hchunks(out_schema, store.fragments(d))
-        capm = 1
-        while capm < max(merged.n, 1):
-            capm *= 2
-        out = ooc._batch_to_chunk(compact_fn(
-            ooc._chunk_to_batch(merged, capm)))
-        if out.n > chunk_rows:
-            raise StreamJobError(
-                f"device bucket {start + d} holds {out.n} distinct groups "
-                f"> chunk capacity {chunk_rows}; raise chunk_rows")
-        store._ram[d] = [out]
-
-    fns: Dict[Tuple[int, int], Any] = {}
-    slack = config.initial_send_slack
-    scale = 1
-    jbounds = jnp.asarray(bounds_arr)
-
-    it = iter(cs)
-    w = 0
-    while True:
-        chunk = next(it, None)
-        live = _host_allgather(
-            np.asarray([1 if chunk is not None else 0], np.int32), mesh)
-        if int(live.sum()) == 0:
-            break
-        w += 1
-        for attempt in range(config.max_capacity_retries + 1):
-            key = (scale, slack)
-            fn = fns.get(key)
-            if fn is None:
-                fn = fns[key] = _build_wave_fn(mesh, kind, params,
-                                               chunk_rows, scale, slack)
-            garr = _put_wave(chunk, schema, chunk_rows, mesh)
-            out, info = fn(garr, jbounds)
-            local_info = _read_local_shards(info, start, dpp)  # [dpp, 3]
-            need_scale = int(local_info[:, 0].max())
-            need_slack = int(local_info[:, 1].max())
-            if need_scale == 0 and need_slack == 0:
-                break
-            # mirrored right-sizing (info is pmax'd mesh-wide: every
-            # process sees the same values and retries identically)
-            scale = max(scale, need_scale)
-            slack = max(slack, min(need_slack, mesh.devices.size))
-        else:
-            raise StreamJobError(
-                f"wave {w}: exchange still overflowing after "
-                f"{config.max_capacity_retries} retries (scale={scale})")
-        local = _read_local_shards(out, start, dpp)
-        _, wave_chunks = local_batch_chunks(local)
-        for d, hc in enumerate(wave_chunks):
-            if hc.n == 0:
-                continue
-            store.append(d, hc)
-            if compact_fn is not None and store.rows(d) > chunk_rows:
-                compact_bucket(d)
-    return store, out_schema
-
-
 # ---------------------------------------------------------------------------
 # parallel store output (each worker writes its own partitions)
 
@@ -637,184 +302,3 @@ def _gathered_bounds(samples: np.ndarray, mesh, n_buckets: int
     merged = np.concatenate([all_s[p, :int(all_n[p, 0])]
                              for p in range(all_s.shape[0])])
     return ooc._bounds_from_samples(merged, n_buckets)
-
-
-def _finish_sort(store, schema, keys, chunk_rows: int, mesh,
-                 out_path: str, term):
-    """Per-device buckets -> fully sorted partitions, written in parallel.
-    Output partition order equals global sort order (range buckets are
-    laid out in mesh partition order by the exchange)."""
-    import jax
-    from dryad_tpu.exec import ooc
-
-    nprocs = jax.process_count()
-    dpp = mesh.devices.size // nprocs
-    start = jax.process_index() * dpp
-    sort_fn = ooc._make_sort_fn(tuple(tuple(k) for k in keys))
-    part_chunks = []
-    for d in range(dpp):
-        frags = store.fragments(d)
-        part_chunks.append(list(ooc._sorted_bucket_chunks(
-            schema, frags, [tuple(k) for k in keys], chunk_rows, sort_fn)))
-    part_ids = list(range(start, start + dpp))
-    # ascending sorts leave partitions in range order; a descending
-    # primary cannot claim ascending range partitioning (plan/planner.py
-    # OrderBy semantics)
-    part = ({"kind": "range", "keys": [keys[0][0]]}
-            if not keys[0][1] else {"kind": "none"})
-    _write_partitions(out_path, schema, part_chunks, part_ids, mesh,
-                      chunk_rows, partitioning=part)
-
-
-def _finish_group(store, pschema, chunk_rows: int, mesh, term, final_fn):
-    """Finalize each device bucket's accumulated partials (associative
-    merge + FinalReduce / mean quotient via ``final_fn``), then either
-    write partitions in parallel or return the local host table part
-    (driver concatenates parts in pid order)."""
-    import jax
-
-    from dryad_tpu.exec import ooc
-
-    nprocs = jax.process_count()
-    dpp = mesh.devices.size // nprocs
-    start = jax.process_index() * dpp
-    keys = list(term["keys"])
-    fin = jax.jit(final_fn)
-
-    # final output schema, probed on an empty partial batch
-    fin_schema = ooc.chunk_schema(ooc._batch_to_chunk(fin(
-        ooc._chunk_to_batch(ooc.HChunk.empty_like(pschema), 1))))
-
-    finals: List[List[Any]] = []
-    for d in range(dpp):
-        frags = store.fragments(d)
-        if not frags:
-            finals.append([])
-            continue
-        merged = ooc._concat_hchunks(pschema, frags)
-        capm = 1
-        while capm < max(merged.n, 1):
-            capm *= 2
-        finals.append([ooc._batch_to_chunk(fin(
-            ooc._chunk_to_batch(merged, capm)))])
-
-    if term.get("out") is not None:
-        _write_partitions(term["out"], fin_schema, finals,
-                          list(range(start, start + dpp)), mesh,
-                          chunk_rows,
-                          partitioning={"kind": "hash", "keys": keys})
-        return None
-    # collect: return this worker's part as a host table
-    from dryad_tpu.exec.stream_exec import chunks_to_table
-    flat = [c for lst in finals for c in lst]
-    cs = ooc.ChunkSource(lambda: iter(flat), fin_schema, chunk_rows)
-    return chunks_to_table(cs)
-
-
-# ---------------------------------------------------------------------------
-# worker entry
-
-
-def execute_stream_job(spec_json: str, fn_table, mesh, config):
-    """Run one streamed job SPMD on this worker; returns the worker's
-    reply payload (merged by the driver)."""
-    import jax
-
-    from dryad_tpu.exec import ooc
-    from dryad_tpu.exec.stream_exec import (_LOCAL_KINDS, _stream_local)
-    from dryad_tpu.io.store import store_meta
-    from dryad_tpu.plan.serialize import _op_from_json
-
-    spec = json.loads(spec_json)
-    path = spec["source"]["path"]
-    chunk_rows = spec["source"]["chunk_rows"]
-    me, nprocs = jax.process_index(), jax.process_count()
-
-    meta = store_meta(path)
-    parts = [p for p in range(meta["npartitions"]) if p % nprocs == me]
-    cs = ooc.ChunkSource.from_store(path, chunk_rows, partitions=parts)
-
-    shared: Dict[int, dict] = {}
-    ops = [_op_from_json(o, fn_table, shared) for o in spec["ops"]]
-    bad = [o.kind for o in ops if o.kind not in _LOCAL_KINDS]
-    if bad:
-        raise StreamJobError(
-            f"streamed cluster jobs support chunk-local ops only; got "
-            f"{bad}")
-    if ops:
-        cs = _stream_local(cs, ops, config)
-    schema = cs.schema
-    chunk_rows = cs.chunk_rows  # local ops may change the chunk bound
-
-    term = spec["terminal"]
-    kind = term["kind"]
-    if kind == "count":
-        return {"count": sum(c.n for c in cs)}
-
-    if kind == "sort":
-        keys = [(k, bool(d)) for k, d in term["keys"]]
-        key0, desc0 = keys[0]
-        samples, _, _ = _sample_pass(cs, key0)
-        bounds = _gathered_bounds(samples, mesh, mesh.devices.size)
-        store, _ = _run_waves(cs, schema, mesh, "range",
-                              {"key": key0, "descending": desc0},
-                              chunk_rows, config, bounds)
-        try:
-            _finish_sort(store, schema, keys, chunk_rows, mesh,
-                         term["out"], term)
-        finally:
-            store.close()
-            if store.spill_dir:
-                import shutil
-                shutil.rmtree(store.spill_dir, ignore_errors=True)
-        return {"stored": term["out"]}
-
-    if kind == "group":
-        from dryad_tpu.plan.planner import (_decompose_aggs,
-                                            _has_user_decs,
-                                            _normalize_decs)
-        keys = list(term["keys"])
-        aggs = _op_from_json(term["aggs_op"], fn_table,
-                             shared).params["aggs"]
-        if _has_user_decs(aggs):
-            # user Decomposables ride the waves as flattened partial
-            # states (seed+merge in the wave program, merge compaction
-            # between waves, FinalReduce per bucket —
-            # IDecomposable.cs:34 over the cluster)
-            decs = _normalize_decs(aggs)
-            box: Dict[str, Any] = {}
-            from dryad_tpu.ops import kernels as K
-            merge_fn = (lambda b: K.group_decompose_merge(
-                b, keys, decs, box, False))
-            final_fn = (lambda b: K.group_decompose_merge(
-                b, keys, decs, box, True))
-            params = {"keys": keys, "decs": decs, "box": box,
-                      "merge_fn": merge_fn}
-        else:
-            partial, final, mean_cols = _decompose_aggs(dict(aggs))
-
-            from dryad_tpu.data.columnar import Batch as _B
-            from dryad_tpu.ops import kernels as K
-
-            def merge_fn(b):
-                return K.group_aggregate(b, keys, final)
-
-            def final_fn(b):
-                m = K.group_aggregate(b, keys, final)
-                return _B(K.mean_finalize_columns(dict(m.columns),
-                                                  mean_cols), m.count)
-
-            params = {"keys": keys, "partial": partial,
-                      "merge_fn": merge_fn}
-        # no pre-pass: the per-wave continuation flag drives the loop, so
-        # group-by reads and computes the data exactly once
-        store, pschema = _run_waves(cs, schema, mesh, "group", params,
-                                    chunk_rows, config,
-                                    np.zeros((0,), np.uint32))
-        table = _finish_group(store, pschema, chunk_rows, mesh, term,
-                              final_fn)
-        if term.get("out") is not None:
-            return {"stored": term["out"]}
-        return {"table_part": table}
-
-    raise StreamJobError(f"unknown streamed terminal {kind!r}")

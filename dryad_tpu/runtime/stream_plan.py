@@ -1,0 +1,673 @@
+"""Streamed (>HBM) execution of PLANNED StageGraphs over the worker gang.
+
+VERDICT r3 item 3: the cluster streamed path used to be a hand-mirrored
+mini-API (ClusterStream) accepting only chunk-local ops + three terminals —
+every new operator needed a third implementation.  This module replaces it:
+plain Dataset plans (the SAME planner lowering the in-memory cluster path
+uses, exchanges included) execute over per-device chunk streams:
+
+* each mesh device streams its own subset of the source store's
+  partitions (partition p -> device p mod P);
+* a leg's trailing chunk-local (and partial-safe: group/distinct) ops fuse
+  INTO the jitted wave program; whole-stream leg ops (take/skip/row_index/
+  sort/...) apply per-device through exec/stream_exec's machinery first;
+* a leg's exchange runs as lockstep chunk WAVES over the mesh (hash /
+  range / broadcast — including the hierarchical per-axis hops), received
+  rows spilling into per-device bucket stores between waves;
+* stage BODY ops then run per device over its bucket stream through the
+  single-partition streamed executor — joins materialize their
+  (bucket-aligned) right side exactly like the one-process path;
+* terminals reuse the parallel collect / parallel store writers; loop
+  state (do_while) materializes cluster-resident under keep_token.
+
+The reference's channels stream every operator identically
+(DryadVertex/.../channelinterface.h:212 makes no operator distinction);
+this gives the TPU gang the same property through ONE lowering.
+
+Mirrored-determinism contract as runtime/exec_common.py: every process
+derives the same wave count (a tiny continuation allgather), the same
+bounds, and the same retry decisions (needs are pmax'd in-program).
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dryad_tpu.plan.stages import Exchange, Stage, StageGraph, StageOp
+
+__all__ = ["execute_stream_plan", "has_stream_sources", "StreamPlanError"]
+
+
+class StreamPlanError(RuntimeError):
+    pass
+
+
+# leg-op kinds safe to apply PER CHUNK inside the wave program: chunk-local
+# ops, plus partial aggregations whose merge happens post-exchange
+_WAVE_FUSABLE = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
+                 "apply", "recap", "group", "dgroup_partial",
+                 "dgroup_local", "distinct"}
+
+_UNSUPPORTED = {
+    "group_apply": "group_apply needs whole groups materialized",
+    "group_rank": "group_median/rank needs whole groups materialized",
+    "zip": "zip_with needs global row alignment across streams",
+}
+
+
+class _StreamSpec:
+    """Planner/graph-visible marker for a streamed store source."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = spec
+
+    @property
+    def capacity(self) -> int:
+        return self.spec["chunk_rows"]
+
+
+def has_stream_sources(source_specs: Dict[str, Dict[str, Any]]) -> bool:
+    return any(s.get("kind") == "store_stream"
+               for s in source_specs.values())
+
+
+# ---------------------------------------------------------------------------
+# per-stage results: one re-iterable ChunkSource per LOCAL device
+
+
+class _DevStreams:
+    def __init__(self, streams: List[Any]):
+        self.streams = streams  # [dpp] ChunkSources, device-aligned
+
+    @property
+    def schema(self):
+        return self.streams[0].schema
+
+    @property
+    def chunk_rows(self):
+        return self.streams[0].chunk_rows
+
+
+def _source_streams(spec: Dict[str, Any], mesh, config) -> _DevStreams:
+    """Store partitions -> per-local-device chunk streams (partition p is
+    served by global device p mod P; device-aligned so output partition
+    ids line up with bucket ids)."""
+    import jax
+
+    from dryad_tpu.exec import ooc
+    from dryad_tpu.io.store import store_meta
+
+    path = spec["path"]
+    chunk_rows = spec["chunk_rows"]
+    P = mesh.devices.size
+    nprocs = jax.process_count()
+    dpp = P // nprocs
+    start = jax.process_index() * dpp
+    meta = store_meta(path)
+    streams = []
+    for d in range(dpp):
+        g = start + d
+        parts = [p for p in range(meta["npartitions"]) if p % P == g]
+        streams.append(ooc.ChunkSource.from_store(path, chunk_rows,
+                                                  partitions=parts))
+    return _DevStreams(streams)
+
+
+def _resident_streams(pd, mesh, config) -> _DevStreams:
+    """Device-resident PData -> per-device host chunk streams (loop state
+    and other in-HBM inputs joining a streamed plan)."""
+    import jax
+
+    from dryad_tpu.exec.ooc import ChunkSource
+    from dryad_tpu.runtime.stream_cluster import (_read_local_shards,
+                                                  local_batch_chunks)
+
+    nprocs = jax.process_count()
+    dpp = pd.nparts // nprocs
+    start = jax.process_index() * dpp
+    local = _read_local_shards(pd.batch, start, dpp)
+    schema, chunks = local_batch_chunks(local)
+    cap = max(pd.capacity, 1)
+    return _DevStreams([
+        ChunkSource((lambda c=c: iter([c])), schema, cap) for c in chunks])
+
+
+# ---------------------------------------------------------------------------
+# wave exchange
+
+
+def _wave_chunk_op(b, op: StageOp, scale: int):
+    """One wave-fusable op applied to a per-device chunk batch."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.exec import stream_exec
+    from dryad_tpu.ops import kernels
+
+    k, p = op.kind, op.params
+    no = jnp.zeros((), jnp.int32)
+    if k in stream_exec._LOCAL_KINDS:
+        return stream_exec._local_op(b, op, scale)
+    if k == "group":
+        return kernels.group_aggregate(b, list(p["keys"]),
+                                       dict(p["aggs"])), no
+    if k == "dgroup_partial":
+        return kernels.group_decompose_partial(
+            b, list(p["keys"]), p["decs"], p["box"]), no
+    if k == "dgroup_local":
+        return kernels.group_decompose_local(
+            b, list(p["keys"]), p["decs"], p["box"]), no
+    if k == "distinct":
+        return kernels.distinct(b, list(p["keys"]) or None), no
+    raise StreamPlanError(f"op {k!r} cannot ride a wave program")
+
+
+def _build_wave_fn(mesh, leg_ops: List[StageOp], ex: Exchange,
+                   chunk_rows: int, scale: int, slack: int):
+    """One jitted shard_map program: per-chunk leg ops + the leg's
+    exchange; need channels pmax'd in-program (mirrored retries)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dryad_tpu.parallel import shuffle
+    from dryad_tpu.runtime.stream_cluster import _expand, _squeeze
+
+    axes = tuple(mesh.axis_names)
+    out_cap = max(1, ex.out_capacity) * scale
+
+    def per_shard(batch, bounds):
+        b = _squeeze(batch)
+        need_local = jnp.zeros((), jnp.int32)
+        for op in leg_ops:
+            b, need = _wave_chunk_op(b, op, scale)
+            need_local = jnp.maximum(need_local, need)
+        if ex.kind == "hash":
+            out, nr, nsl = shuffle.hash_exchange(
+                b, list(ex.keys), out_cap, send_slack=slack, axes=axes,
+                axis=ex.axis)
+        elif ex.kind == "range":
+            out, nr, nsl = shuffle.range_exchange(
+                b, ex.keys[0], bounds, out_cap,
+                descending=ex.descending, send_slack=slack, axes=axes)
+        elif ex.kind == "broadcast":
+            out, nr, nsl = shuffle.broadcast_gather(b, out_cap, axes=axes)
+        else:
+            raise StreamPlanError(f"exchange kind {ex.kind!r}")
+        exch_scale = (-(-nr // jnp.int32(max(1, ex.out_capacity)))
+                      ).astype(jnp.int32)
+        need_scale = jnp.maximum(need_local, exch_scale)
+        need_scale = jax.lax.pmax(need_scale, axes)
+        info = jnp.stack([need_scale, jnp.asarray(nsl, jnp.int32),
+                          out.count.astype(jnp.int32)])
+        return _expand(out), info[None]
+
+    in_specs = (P(axes), P())
+    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                       out_specs=(P(axes), P(axes)), check_vma=False)
+    return jax.jit(fn)
+
+
+def _compact_fn_for(stage: Stage):
+    """Associative bucket-compaction callable from the stage's FIRST body
+    group op (merging already-merged partials is sound: the merge specs
+    are associative — sum of sums, min of mins, decomposable merge)."""
+    from dryad_tpu.ops import kernels
+
+    for op in stage.body:
+        if op.kind == "group":
+            keys, aggs = list(op.params["keys"]), dict(op.params["aggs"])
+            return lambda b: kernels.group_aggregate(b, keys, aggs)
+        if op.kind == "dgroup_merge":
+            keys = list(op.params["keys"])
+            decs, box = op.params["decs"], op.params["box"]
+            return lambda b: kernels.group_decompose_merge(
+                b, keys, decs, box, False)
+        if op.kind == "distinct":
+            keys = list(op.params["keys"]) or None
+            return lambda b: kernels.distinct(b, keys)
+    return None
+
+
+def _run_leg_waves(dev: _DevStreams, leg_ops: List[StageOp], ex: Exchange,
+                   mesh, config, bounds_arr, compact_fn, job_root: str
+                   ) -> _DevStreams:
+    """Lockstep chunk waves for one leg's exchange; returns per-device
+    bucket streams holding ALL received rows (spilled to disk for
+    unbounded kinds, RAM + compaction for group partials)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.exec import ooc
+    from dryad_tpu.exec.ooc import ChunkSource
+    from dryad_tpu.runtime.stream_cluster import (_host_allgather,
+                                                  _read_local_shards,
+                                                  local_batch_chunks)
+
+    nprocs = jax.process_count()
+    dpp = mesh.devices.size // nprocs
+    start = jax.process_index() * dpp
+    chunk_rows = dev.chunk_rows
+    schema = dev.schema
+
+    # bucket schema = the EXCHANGED row schema: probe the wave ops over an
+    # empty chunk (also fills decomposable treedef boxes pre-merge)
+    probe_b = ooc._chunk_to_batch(ooc.HChunk.empty_like(schema), 1)
+    for op in leg_ops:
+        probe_b, _ = _wave_chunk_op(probe_b, op, 1)
+    out_schema = ooc.chunk_schema(ooc._batch_to_chunk(probe_b))
+
+    spill = None if compact_fn is not None else \
+        tempfile.mkdtemp(prefix="wave-", dir=job_root)
+    store = ooc._BucketStore(out_schema, dpp, spill_dir=spill)
+    out_cap = max(1, ex.out_capacity)
+
+    def compact_bucket(d: int) -> None:
+        merged = ooc._concat_hchunks(out_schema, store.fragments(d))
+        capm = 1
+        while capm < max(merged.n, 1):
+            capm *= 2
+        out = ooc._batch_to_chunk(jax.jit(compact_fn)(
+            ooc._chunk_to_batch(merged, capm)))
+        if out.n > out_cap:
+            raise StreamPlanError(
+                f"bucket {start + d} holds {out.n} distinct groups > "
+                f"exchange capacity {out_cap}; raise chunk_rows")
+        store._ram[d] = [out]
+
+    fns: Dict[Tuple[int, int], Any] = {}
+    slack = config.initial_send_slack
+    scale = 1
+    jbounds = jnp.asarray(bounds_arr)
+    its = [iter(cs) for cs in dev.streams]
+    while True:
+        chunks = [next(it, None) for it in its]
+        live = _host_allgather(
+            np.asarray([sum(c is not None for c in chunks)], np.int32),
+            mesh)
+        if int(live.sum()) == 0:
+            break
+        for attempt in range(config.max_capacity_retries + 1):
+            key = (scale, slack)
+            fn = fns.get(key)
+            if fn is None:
+                fn = fns[key] = _build_wave_fn(mesh, leg_ops, ex,
+                                               chunk_rows, scale, slack)
+            garr = _put_aligned(chunks, schema, chunk_rows, mesh)
+            out, info = fn(garr, jbounds)
+            local_info = _read_local_shards(info, start, dpp)
+            need_scale = int(local_info[:, 0].max())
+            need_slack = int(local_info[:, 1].max())
+            if need_scale == 0 and need_slack == 0:
+                break
+            scale = max(scale, need_scale)
+            slack = max(slack, min(need_slack, mesh.devices.size))
+        else:
+            raise StreamPlanError(
+                "wave exchange still overflowing after "
+                f"{config.max_capacity_retries} retries (scale={scale})")
+        local = _read_local_shards(out, start, dpp)
+        _, wave_chunks = local_batch_chunks(local)
+        for d, hc in enumerate(wave_chunks):
+            if hc.n == 0:
+                continue
+            store.append(d, hc)
+            if compact_fn is not None and store.rows(d) > out_cap:
+                compact_bucket(d)
+    # waves done: release the spill WRITE handles (fragments() reads by
+    # name) — a long-lived worker running many streamed jobs must not
+    # accumulate open fds
+    store.close()
+
+    def bucket_source(d: int) -> ChunkSource:
+        # capacity-retried waves may have delivered fragments larger than
+        # the declared bound — re-slice so downstream chunk programs keep
+        # their static shapes
+        bound = max(out_cap, chunk_rows)
+
+        def it():
+            for frag in store.fragments(d):
+                for s in range(0, max(frag.n, 1), bound):
+                    e = min(s + bound, frag.n)
+                    if e > s:
+                        yield ooc._slice_hchunk(frag, s, e)
+        return ChunkSource(it, out_schema, bound)
+
+    return _DevStreams([bucket_source(d) for d in range(dpp)])
+
+
+def _put_aligned(chunks, schema, chunk_rows: int, mesh):
+    """Per-device host chunks -> one global mesh batch [P, chunk_rows]
+    (each process fills only its own device rows)."""
+    import jax
+
+    from dryad_tpu.data.columnar import Batch, StringColumn
+    from dryad_tpu.parallel.mesh import batch_sharding
+
+    P_total = mesh.devices.size
+    nprocs = jax.process_count()
+    dpp = P_total // nprocs
+    start = jax.process_index() * dpp
+    sharding = batch_sharding(mesh)
+
+    local_cols: Dict[str, Any] = {}
+    counts = np.asarray([c.n if c is not None else 0 for c in chunks],
+                        np.int32)
+    for k, spec in schema.items():
+        if spec["kind"] == "str":
+            L = spec["max_len"]
+            sd = np.zeros((dpp, chunk_rows, L), np.uint8)
+            sl = np.zeros((dpp, chunk_rows), np.int32)
+            for d, c in enumerate(chunks):
+                if c is not None and c.n:
+                    dat, ln = c.cols[k]
+                    sd[d, :c.n] = dat
+                    sl[d, :c.n] = ln
+            local_cols[k] = (sd, sl)
+        else:
+            dt = np.dtype(spec["dtype"])
+            tail = tuple(spec.get("shape", ()))
+            sa = np.zeros((dpp, chunk_rows) + tail, dt)
+            for d, c in enumerate(chunks):
+                if c is not None and c.n:
+                    sa[d, :c.n] = c.cols[k]
+            local_cols[k] = sa
+
+    def put(local):
+        gshape = (P_total,) + local.shape[1:]
+
+        def cb(idx):
+            s = idx[0]
+            return local[s.start - start: s.stop - start]
+
+        return jax.make_array_from_callback(gshape, sharding, cb)
+
+    cols: Dict[str, Any] = {}
+    for k, spec in schema.items():
+        if spec["kind"] == "str":
+            d, l = local_cols[k]
+            cols[k] = StringColumn(put(d), put(l))
+        else:
+            cols[k] = put(local_cols[k])
+    return Batch(cols, put(counts))
+
+
+# ---------------------------------------------------------------------------
+# leg / body streaming through the single-partition machinery
+
+
+def _apply_whole_stream_ops(cs, ops: List[StageOp], config, job_root):
+    """Leg ops with whole-stream (per-partition) semantics, applied to one
+    device's stream via exec/stream_exec."""
+    from dryad_tpu.exec import stream_exec
+
+    for kind, payload in stream_exec._split_leg_ops(list(ops)):
+        if kind == "local":
+            cs = stream_exec._stream_local(cs, payload, config)
+        else:
+            if payload.kind in _UNSUPPORTED:
+                raise StreamPlanError(
+                    f"op {payload.kind!r} is not supported over cluster "
+                    f"streams: {_UNSUPPORTED[payload.kind]}")
+            if payload.kind == "take" and payload.params.get("global"):
+                raise StreamPlanError(
+                    "global take over cluster streams is not supported — "
+                    "collect() then slice, or take() before streaming")
+            cs = stream_exec._stream_global(cs, payload, config, job_root)
+    return cs
+
+
+def _run_body(legs_out: List[_DevStreams], body: List[StageOp], config,
+              job_root) -> _DevStreams:
+    """Stage body per device over its (bucket-aligned) streams."""
+    from dryad_tpu.exec import stream_exec
+
+    dpp = len(legs_out[0].streams)
+    outs = []
+    for d in range(dpp):
+        cur = legs_out[0].streams[d]
+        rest = [ds.streams[d] for ds in legs_out[1:]]
+        for op in body:
+            if op.kind in ("join", "apply2", "semi_anti"):
+                right_b, right_h = stream_exec._materialize_small(
+                    rest.pop(0), config, "right/build")
+                cur = stream_exec._stream_local(
+                    cur, [], config, extra_right=right_b,
+                    right_chunk=right_h, body_op=op)
+            elif op.kind == "concat":
+                cur = stream_exec._concat_sources(cur, rest.pop(0))
+            elif op.kind in _UNSUPPORTED:
+                raise StreamPlanError(
+                    f"op {op.kind!r} is not supported over cluster "
+                    f"streams: {_UNSUPPORTED[op.kind]}")
+            elif op.kind == "take" and op.params.get("global"):
+                raise StreamPlanError(
+                    "global take over cluster streams is not supported")
+            elif op.kind in stream_exec._STREAM_KINDS \
+                    or op.kind == "dgroup_merge":
+                cur = _body_stream_global(cur, op, config, job_root)
+            elif op.kind in stream_exec._LOCAL_KINDS:
+                cur = stream_exec._stream_local(cur, [op], config)
+            else:
+                raise StreamPlanError(
+                    f"op {op.kind!r} unsupported over cluster streams")
+        outs.append(cur)
+    return _DevStreams(outs)
+
+
+def _body_stream_global(cs, op: StageOp, config, job_root):
+    from dryad_tpu.exec import stream_exec
+
+    if op.kind == "dgroup_merge":
+        # decomposable reduce-side merge over the bucket stream: merge
+        # partial-state rows, finalizing per the op
+        import jax
+
+        from dryad_tpu.exec import ooc
+        from dryad_tpu.ops import kernels
+
+        keys = list(op.params["keys"])
+        decs, box = op.params["decs"], op.params["box"]
+        final = op.params["finalize"]
+
+        def run(b):
+            return kernels.group_decompose_merge(b, keys, decs, box, final)
+
+        def it():
+            frags = list(cs)
+            merged = ooc._concat_hchunks(cs.schema, frags)
+            capm = 1
+            while capm < max(merged.n, 1):
+                capm *= 2
+            out = ooc._batch_to_chunk(jax.jit(run)(
+                ooc._chunk_to_batch(merged, capm)))
+            yield out
+
+        probe = ooc._batch_to_chunk(jax.jit(run)(
+            ooc._chunk_to_batch(ooc.HChunk.empty_like(cs.schema), 1)))
+        return ooc.ChunkSource(it, ooc.chunk_schema(probe), cs.chunk_rows)
+    return stream_exec._stream_global(cs, op, config, job_root)
+
+
+# ---------------------------------------------------------------------------
+# the runner
+
+
+def execute_stream_plan(plan_json: str, fn_table, source_specs, mesh,
+                        event_log=None, store_path: Optional[str] = None,
+                        store_partitioning: Optional[Dict[str, Any]] = None,
+                        collect: Any = True, config=None,
+                        keep_token: Optional[str] = None,
+                        release: tuple = (),
+                        store_compression: Optional[str] = None):
+    """Streamed counterpart of runtime/exec_common.execute_plan: same
+    submission contract ((table, extras) back to the worker loop), plan
+    executed as chunk waves + per-device bucket streams."""
+    import jax
+
+    from dryad_tpu.exec import ooc
+    from dryad_tpu.exec.stream_exec import chunks_to_table
+    from dryad_tpu.plan.serialize import graph_from_json
+    from dryad_tpu.runtime import exec_common
+    from dryad_tpu.runtime.stream_cluster import (_gathered_bounds,
+                                                  _host_allgather,
+                                                  _sample_pass,
+                                                  _write_partitions)
+    from dryad_tpu.utils.config import JobConfig
+
+    config = config or JobConfig()
+    ev = event_log or (lambda e: None)
+    for tok in release:
+        exec_common._RESIDENT.pop(tok, None)
+
+    sources: Dict[str, Any] = {}
+    for key, spec in source_specs.items():
+        if spec.get("kind") == "store_stream":
+            sources[key] = _StreamSpec(spec)
+        elif spec.get("kind") == "resident":
+            tok = spec["token"]
+            from dryad_tpu.runtime.sources import MissingResidentToken
+            if tok not in exec_common._RESIDENT:
+                raise MissingResidentToken(tok)
+            sources[key] = exec_common._RESIDENT[tok]
+        else:
+            from dryad_tpu.runtime.sources import build_source
+            sources[key] = build_source(spec, mesh,
+                                        resident=exec_common._RESIDENT)
+    graph = graph_from_json(plan_json, fn_table=fn_table, sources=sources)
+
+    nprocs = jax.process_count()
+    dpp = mesh.devices.size // nprocs
+    start = jax.process_index() * dpp
+    job_root = tempfile.mkdtemp(prefix="dryad-splan-")
+
+    def as_dev_streams(x) -> _DevStreams:
+        if isinstance(x, _DevStreams):
+            return x
+        if isinstance(x, _StreamSpec):
+            return _source_streams(x.spec, mesh, config)
+        # device-resident PData (loop state, columns, stores)
+        return _resident_streams(x, mesh, config)
+
+    import time
+
+    results: Dict[int, _DevStreams] = {}
+    for st in graph.topo_order():
+        t0 = time.time()
+        legs_out: List[_DevStreams] = []
+        for leg in st.legs:
+            if isinstance(leg.src, int):
+                src = results[leg.src]
+            elif leg.src[0] == "source":
+                src = as_dev_streams(leg.src[1])
+            else:
+                raise StreamPlanError(
+                    "placeholders are not supported in streamed cluster "
+                    "plans (do_while ships loop state as residents)")
+            src = as_dev_streams(src)
+            if leg.exchange is None:
+                streams = [
+                    _apply_whole_stream_ops(cs, list(leg.ops), config,
+                                            job_root)
+                    for cs in src.streams]
+                legs_out.append(_DevStreams(streams))
+                continue
+            # split leg ops: whole-stream prefix runs host-side per
+            # device; the trailing wave-fusable suffix rides the program
+            ops = list(leg.ops)
+            cut = len(ops)
+            while cut > 0 and ops[cut - 1].kind in _WAVE_FUSABLE:
+                cut -= 1
+            pre, fus = ops[:cut], ops[cut:]
+            streams = src.streams
+            if pre:
+                streams = [_apply_whole_stream_ops(cs, pre, config,
+                                                   job_root)
+                           for cs in streams]
+            pre_dev = _DevStreams(streams)
+            bounds = np.zeros((0,), np.uint32)
+            if leg.exchange.kind == "range":
+                # sampled global quantile bounds (DryadLinqSampler.cs:42
+                # role) from the exchange's own input streams
+                samples = []
+                for cs in pre_dev.streams:
+                    s, _, _ = _sample_pass(cs, leg.exchange.bounds_key
+                                           or leg.exchange.keys[0])
+                    samples.append(s)
+                merged = (np.concatenate(samples) if samples
+                          else np.zeros((0,), np.uint32))
+                from dryad_tpu.runtime.stream_cluster import _MAX_SAMPLES
+                if len(merged) > _MAX_SAMPLES:
+                    merged = merged[np.linspace(
+                        0, len(merged) - 1,
+                        _MAX_SAMPLES).astype(np.int64)]
+                bounds = _gathered_bounds(merged, mesh,
+                                          mesh.devices.size)
+            compact = _compact_fn_for(st) if any(
+                o.kind in ("group", "dgroup_partial", "dgroup_local")
+                for o in fus) else None
+            legs_out.append(_run_leg_waves(pre_dev, fus, leg.exchange,
+                                           mesh, config, bounds, compact,
+                                           job_root))
+        out = _run_body(legs_out, list(st.body), config, job_root)
+        results[st.id] = out
+        ev({"event": "stream_stage_done", "stage": st.id,
+            "label": st.label, "wall_s": round(time.time() - t0, 4)})
+
+    final = results[graph.out_stage]
+    extras: Dict[str, Any] = {}
+
+    drained: Optional[List[List[Any]]] = None
+
+    def drain() -> List[List[Any]]:
+        nonlocal drained
+        if drained is None:
+            drained = [list(cs) for cs in final.streams]
+        return drained
+
+    if keep_token is not None:
+        # materialize the (small: loop state / cached) result as gang-
+        # resident PData with MIRRORED capacity (allgathered max)
+        from dryad_tpu.exec.data import PData
+
+        chunks = [ooc._concat_hchunks(final.schema, frags)
+                  for frags in drain()]
+        local_max = max([c.n for c in chunks] + [1])
+        gmax = int(_host_allgather(
+            np.asarray([local_max], np.int32), mesh).max())
+        capm = 1
+        while capm < gmax:
+            capm *= 2
+        batch = _put_aligned(chunks, final.schema, capm, mesh)
+        pd = PData(batch, mesh.devices.size)
+        exec_common._RESIDENT[keep_token] = pd
+        extras["resident_capacity"] = pd.capacity
+
+    table = None
+    if collect == "count":
+        # >HBM row counts exceed int32, and jax without x64 silently
+        # truncates int64 arrays — ship (hi, lo) uint32 lanes
+        local = sum(c.n for frags in drain() for c in frags)
+        arr = np.asarray([[local >> 32, local & 0xFFFFFFFF]], np.uint32)
+        allc = _host_allgather(arr, mesh).astype(np.uint64)
+        table = int(sum((int(h) << 32) | int(l)
+                        for h, l in allc.reshape(-1, 2)))
+    elif collect:
+        merged: List[Any] = [c for frags in drain() for c in frags]
+        cs = ooc.ChunkSource(lambda: iter(merged), final.schema,
+                             max(final.chunk_rows, 1))
+        table = chunks_to_table(cs)
+    if store_path is not None:
+        part_chunks = drain()
+        part_ids = list(range(start, start + dpp))
+        _write_partitions(store_path, final.schema, part_chunks, part_ids,
+                          mesh, final.chunk_rows,
+                          partitioning=store_partitioning,
+                          compression=store_compression,
+                          capacity=final.chunk_rows)
+
+    import shutil
+    shutil.rmtree(job_root, ignore_errors=True)
+    return table, extras

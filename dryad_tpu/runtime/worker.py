@@ -159,7 +159,7 @@ def main(argv=None) -> int:
                 lost_control = True
                 break
             continue
-        if args.standalone and cmd in ("run", "run_stream"):
+        if args.standalone and cmd == "run":
             # gang SPMD jobs need jax.distributed membership, which a
             # mid-life joiner cannot acquire without a gang restart —
             # elastic workers serve independently schedulable farm tasks
@@ -167,32 +167,6 @@ def main(argv=None) -> int:
                                 "job": msg.get("job"),
                                 "error": "standalone (elastic) worker "
                                          "cannot join gang SPMD jobs"}):
-                lost_control = True
-                break
-            continue
-        if cmd == "run_stream":
-            # streamed (out-of-core) SPMD job: chunk waves + sharded
-            # exchanges + host bucket spill (runtime/stream_cluster.py)
-            reply = {"ok": True, "pid": args.process_id,
-                     "job": msg.get("job")}
-            try:
-                from dryad_tpu.runtime import exec_common
-                from dryad_tpu.runtime.shiplan import resolve_fn_table
-                from dryad_tpu.runtime.stream_cluster import \
-                    execute_stream_job
-                from dryad_tpu.utils.config import JobConfig
-                for tok in msg.get("release") or ():
-                    exec_common._RESIDENT.pop(tok, None)
-                fn_table = resolve_fn_table(msg["plan"], args.fn_module)
-                cfg = msg.get("config") or JobConfig()
-                reply["result"] = execute_stream_job(
-                    msg["spec"], fn_table, mesh, cfg)
-            except Exception as e:
-                reply = {"ok": False, "pid": args.process_id,
-                         "job": msg.get("job"),
-                         "error": traceback.format_exc()}
-                _tag_missing_token(reply, e)
-            if not _send_reply(reply):
                 lost_control = True
                 break
             continue

@@ -48,7 +48,14 @@ def enable_persistent_cache(path: Optional[str] = DEFAULT_CACHE_DIR) -> Optional
                 jax.config.update("jax_compilation_cache_dir", None)
                 _enabled_dir = None
             return None
-        resolved = os.path.abspath(os.path.expanduser(path))
+        # namespace by platform selection: CPU worker processes and the
+        # accelerator-attached driver compile with DIFFERENT machine
+        # feature sets; sharing one directory makes XLA:CPU load AOT
+        # artifacts built for the other configuration (SIGILL risk)
+        tag = (os.environ.get("JAX_PLATFORMS") or "default").replace(
+            ",", "-")
+        resolved = os.path.join(os.path.abspath(os.path.expanduser(path)),
+                                tag)
         if _enabled_dir == resolved:
             return resolved
         os.makedirs(resolved, exist_ok=True)

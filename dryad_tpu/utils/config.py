@@ -94,6 +94,13 @@ class JobConfig:
     ooc_hash_buckets: int = 64
     # in-flight device batches for the double-buffered stream (depth)
     ooc_inflight: int = 2
+    # memory-hierarchy-aware sort tier: a streamed sort whose TOTAL data
+    # (counted by the sampling pass it already runs) fits this many bytes
+    # skips the bucket round-trip — one H2D, one device sort, one D2H
+    # (the reference's channels pick RAM FIFOs over disk files the same
+    # way, channelbufferqueue vs channelbuffernativewriter).  0 forces
+    # the out-of-core machinery regardless of size.
+    ooc_incore_bytes: int = 1 << 30
     # from_store switches to streamed execution when the store holds at
     # least this many rows (0 = off); read_store_stream always streams
     ooc_auto_stream_rows: int = 0
@@ -154,6 +161,7 @@ class JobConfig:
             (self.ooc_chunk_rows >= 1, "ooc_chunk_rows >= 1"),
             (self.ooc_hash_buckets >= 1, "ooc_hash_buckets >= 1"),
             (self.ooc_inflight >= 1, "ooc_inflight >= 1"),
+            (self.ooc_incore_bytes >= 0, "ooc_incore_bytes >= 0"),
             (self.ooc_auto_stream_rows >= 0, "ooc_auto_stream_rows >= 0"),
             (self.ooc_join_build_rows >= 1, "ooc_join_build_rows >= 1"),
             (self.cluster_processes >= 1, "cluster_processes >= 1"),

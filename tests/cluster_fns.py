@@ -46,3 +46,19 @@ def second_largest(cols, count):
 # registered-by-name objects for cluster shipping (shiplan FN_TABLE path)
 SUM_DEC = make_sum_dec()
 FN_TABLE = {"sum_dec": SUM_DEC}
+
+
+# -- streamed-cluster PageRank body fns (importable, fixed constants) -------
+
+PR_NODES = 60
+PR_DAMPING = 0.85
+
+
+def pr_contrib(cols):
+    return {"node": cols["dst"], "c": cols["rank"] / cols["deg"]}
+
+
+def pr_damp(cols):
+    return {"node": cols["node"],
+            "rank": (1.0 - PR_DAMPING) / PR_NODES
+            + PR_DAMPING * cols["s"]}

@@ -163,9 +163,6 @@ def test_cluster_backend_factory_registry():
         def execute(self, plan_json, source_specs, **kw):
             return {}
 
-        def execute_stream(self, spec_json, plan_json, **kw):
-            return {}
-
         @property
         def sockets(self):
             return {}
@@ -201,10 +198,12 @@ def test_persistent_compile_cache_knob(tmp_path):
 
     d = str(tmp_path / "nested" / "cc")
     got = enable_persistent_cache(d)
-    assert got == d
+    # namespaced by platform selection (CPU workers vs accelerator driver
+    # compile with different machine feature sets)
+    assert got.startswith(d)
     import os
-    assert os.path.isdir(d)
-    assert jax.config.jax_compilation_cache_dir == d
+    assert os.path.isdir(got)
+    assert jax.config.jax_compilation_cache_dir == got
     # None DISABLES for the process (the jax config is process-global)
     assert enable_persistent_cache(None) is None
     assert jax.config.jax_compilation_cache_dir is None

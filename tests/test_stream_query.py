@@ -326,3 +326,20 @@ def test_stream_right_join_wide_right_keys(store, data, dbg, tmp_path):
                      expansion=3.0, how=how).collect())
         assert_same_rows(got, exp)
         assert b"longkey!" in set(bytes(x) for x in got["key"])
+
+
+def test_stream_sort_incore_tier_matches(store, data, tmp_path):
+    """Memory-hierarchy sort tier (JobConfig.ooc_incore_bytes): a dataset
+    under the budget sorts in ONE device pass; results are identical to
+    the forced out-of-core machinery (incore=0)."""
+    outs = []
+    for incore in (0, 1 << 30):
+        ctx = Context(config=JobConfig(ooc_chunk_rows=CHUNK,
+                                       ooc_incore_bytes=incore))
+        out = str(tmp_path / f"sorted-{incore}")
+        (ctx.read_store_stream(store, chunk_rows=CHUNK)
+         .order_by([("v", False)]).to_store(out))
+        back = Context().from_store(out).collect()
+        outs.append(np.asarray(back["v"]))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[1], np.sort(data["v"]))
