@@ -102,8 +102,21 @@ def main():
     _note(f"bench: micro done {m}")
     hbm_gbps = m["hbm_copy_gbps"]
 
+    # The shared tunnel's rates vary by 10x day to day (memory: 4.6-19
+    # MB/s d2h; today can be ~0.7).  On a DEGRADED link, full-size
+    # configs would spend the whole budget waiting on transfers/remote
+    # compiles — scale sizes down and say so (sizes are in the output;
+    # throughput figures stay honest per-row).
+    degraded = (m["d2h_gbps"] < 0.002
+                or m.get("dispatch_floor_ms", 0) > 400)
+    shrink = 4 if degraded else 1
+    if degraded:
+        _note(f"bench: DEGRADED link (d2h {m['d2h_gbps']:.4f} GB/s, "
+              f"floor {m.get('dispatch_floor_ms', 0):.0f} ms) — sizes /"
+              f"{shrink}")
+
     # ---- WordCount (config 1) ----
-    n_lines = 1_000_000
+    n_lines = 1_000_000 // shrink
     rng = np.random.RandomState(0)
     vocab = np.array(["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
                       "eta", "theta", "iota", "kappa", "lam", "mu"])
@@ -133,7 +146,7 @@ def main():
     wc_group_gbps = n_tokens * 24 * 2 / group_wall / (1 << 30)
 
     # ---- TeraSort in-memory (config 2, in-HBM regime) ----
-    n_sort = 1_000_000
+    n_sort = 1_000_000 // shrink
     recs = terasort.gen_records(n_sort)
     ts_log = EventLog()
     ctx2 = Context(mesh=mesh, event_log=ts_log)
@@ -211,7 +224,7 @@ def main():
     # (config 2, >HBM capability regime: device working set O(chunk_rows))
     from dryad_tpu.exec import ooc as _ooc
 
-    n_ooc, chunk = 1_000_000, 262_144
+    n_ooc, chunk = 1_000_000 // shrink, 262_144 // shrink
     n_chunks = -(-n_ooc // chunk)
 
     def gen(i: int):
@@ -261,7 +274,7 @@ def main():
     _note(f"bench: groupbyreduce... ({_remaining(budget):.0f}s left)")
     gb_log = EventLog()
     ctx3 = Context(mesh=mesh, event_log=gb_log)
-    n_gb = 2_000_000 if _remaining(budget) > 120 else 400_000
+    n_gb = (2_000_000 if _remaining(budget) > 120 else 400_000) // shrink
     pairs = groupbyreduce.gen_pairs(n_gb, 10_000)
     t0 = time.time()
     groupbyreduce.groupbyreduce_query(ctx3.from_columns(pairs)).collect()
@@ -302,7 +315,7 @@ def main():
     _note(f"bench: kmeans... ({_remaining(budget):.0f}s left)")
     km_log = EventLog()
     ctx5 = Context(mesh=mesh, event_log=km_log)
-    n_pts = 500_000 if _remaining(budget) > 110 else 100_000
+    n_pts = (500_000 if _remaining(budget) > 110 else 100_000) // shrink
     pts, _ = kmeans.gen_points(n_pts, 8, 16)
     t0 = time.time()
     kmeans.kmeans(ctx5, pts, 16, n_iters=5)
@@ -318,7 +331,7 @@ def main():
     _note(f"bench: pagerank x10... ({_remaining(budget):.0f}s left)")
     pr_log = EventLog()
     ctx4 = Context(mesh=mesh, event_log=pr_log)
-    if _remaining(budget) > 200:
+    if _remaining(budget) > 200 and not degraded:
         n_nodes, n_edges = 100_000, 1_000_000
     else:
         n_nodes, n_edges = 20_000, 200_000
@@ -390,6 +403,11 @@ def main():
         "details": {
             "n_chips": nchips,
             "baseline": "round-1 recorded (BENCH_r01.json)",
+            **({"degraded_link": {
+                "d2h_gbps": round(m["d2h_gbps"], 5),
+                "dispatch_floor_ms": round(
+                    m.get("dispatch_floor_ms", 0), 1),
+                "sizes_divided_by": shrink}} if degraded else {}),
             "wordcount": {
                 "lines": n_lines, "wall_s": round(wc_s, 3),
                 "rows_per_sec_chip": round(wc_rows, 1),

@@ -102,7 +102,7 @@ class Context:
         Returns the host table (default) or, with ``want_reply``, worker
         0's full reply (resident-cache metadata included).  Queued token
         releases from dropped cached Datasets piggyback on every job."""
-        from dryad_tpu.runtime import ClusterJobError
+        from dryad_tpu.runtime import ClusterJobError, WorkerFailure
         from dryad_tpu.runtime.shiplan import serialize_for_cluster
         graph = plan_query(node, self.nparts, hosts=self.hosts,
                            config=self.config)
@@ -111,6 +111,7 @@ class Context:
         # the job (several Contexts may share one cluster)
         prev_log = self.cluster.event_log
         self.cluster.event_log = self._event_log
+        replayed = False
         try:
             for heal in range(8):   # bound resident-healing retries
                 try:
@@ -123,6 +124,15 @@ class Context:
                         keep_token=keep_token,
                         store_compression=store_compression)
                     break
+                except WorkerFailure:
+                    # a wedged/dead worker tore the gang down (straggler
+                    # watchdog or process death): the job is
+                    # deterministic from its sources — replay ONCE on a
+                    # fresh gang (lineage replay, SURVEY.md §3.5; any
+                    # resident references heal below on the retry)
+                    if replayed or heal == 7:
+                        raise
+                    replayed = True
                 except ClusterJobError as e:
                     tok = self._lost_resident_token(e)
                     if tok is None or heal == 7:

@@ -328,12 +328,12 @@ def _apply_exchange(b: Batch, ex: Exchange, scale: int, slack: int, bounds,
     if ex.kind == "hash":
         # empty keys = whole row; sorted so both legs of a set op agree
         keys = list(ex.keys) or sorted(b.names)
-        out, nr, nsl = shuffle.hash_exchange(b, keys, cap, send_slack=slack,
-                                             axes=axes, axis=ex.axis)
+        out, nr, nsl, _slot = shuffle.hash_exchange(
+            b, keys, cap, send_slack=slack, axes=axes, axis=ex.axis)
     elif ex.kind == "range":
-        out, nr, nsl = shuffle.range_exchange(b, ex.bounds_key, bounds, cap,
-                                              descending=ex.descending,
-                                              send_slack=slack, axes=axes)
+        out, nr, nsl, _slot = shuffle.range_exchange(
+            b, ex.bounds_key, bounds, cap, descending=ex.descending,
+            send_slack=slack, axes=axes)
     elif ex.kind == "broadcast":
         out, nr, nsl = shuffle.broadcast_gather(b, cap, axes=axes)
     else:

@@ -249,10 +249,14 @@ class ChunkSource:
         def it():
             for p in part_ids:
                 cnt = meta["counts"][p]
-                segs, cols = _alloc_part_views(schema, cnt)
-                native.read_files(
-                    [_part_path(path, p)], [segs],
-                    compress=(meta.get("compression") == "gzip"))
+                if path.startswith("s3://"):
+                    from dryad_tpu.io.s3_store import s3_read_part_views
+                    segs, cols = s3_read_part_views(path, meta, p)
+                else:
+                    segs, cols = _alloc_part_views(schema, cnt)
+                    native.read_files(
+                        [_part_path(path, p)], [segs],
+                        compress=(meta.get("compression") == "gzip"))
                 verify_checksums(path, meta, [segs], partitions=[p])
                 hc = {k: ((cols[k][1], cols[k][2])
                           if cols[k][0] == "str" else cols[k][1])

@@ -131,6 +131,45 @@ def _http_provider(ctx, rest: str, **kw):
     return http_provider(ctx, rest, **kw)
 
 
+def _s3_provider(ctx, rest: str, column: str = "line",
+                 max_line_len: int | None = None, **kw):
+    """ctx.read("s3://bucket/prefix/"): every object under the prefix is
+    a text partition (one line per record) — the cloud counterpart of
+    the file provider (DataProvider.cs scheme dispatch; object listing
+    paginated via ListObjectsV2)."""
+    import concurrent.futures
+
+    import numpy as np
+
+    from dryad_tpu import native
+    from dryad_tpu.io.s3 import parse_s3_url
+    from dryad_tpu.io.s3_store import s3_client
+
+    bucket, prefix = parse_s3_url("s3://" + rest)
+    c = s3_client(kw.pop("s3_config", None))
+    keys = [k for k, _sz in c.list_objects(bucket, prefix)]
+    if not keys:
+        raise FileNotFoundError(f"no objects under s3://{bucket}/{prefix}")
+    max_line_len = max_line_len or ctx.config.text_max_line_len
+
+    def fetch(k):
+        return native.pack_lines(c.get_object(bucket, k), max_line_len)
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(keys))) as pool:
+        packed = list(pool.map(fetch, keys))
+    data = np.concatenate([d for d, _ in packed], axis=0)
+    lens = np.concatenate([l for _, l in packed])
+    if ctx.cluster is not None:
+        # cluster mode: ship as an ordinary columns source
+        rows = [bytes(r[:n]) for r, n in zip(data, lens)]
+        return ctx.from_columns({column: rows}, str_max_len=max_line_len)
+    from dryad_tpu.exec.data import pdata_from_packed_strings
+    pdata = pdata_from_packed_strings(data, lens, ctx.mesh, column=column)
+    return ctx.from_pdata(pdata)
+
+
 register_provider("file", _file_provider)
 register_provider("store", _store_provider)
 register_provider("http", _http_provider)
+register_provider("s3", _s3_provider)

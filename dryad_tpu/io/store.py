@@ -99,6 +99,11 @@ def write_store(path: str, pd: PData,
     UNCOMPRESSED segments, verified on read."""
     if compression not in (None, "gzip"):
         raise ValueError(f"unknown compression {compression!r}")
+    if path.startswith("s3://"):
+        # cloud adapter: same layout as objects, meta-last commit
+        from dryad_tpu.io.s3_store import s3_write_store
+        return s3_write_store(path, pd, partitioning=partitioning,
+                              compression=compression)
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     counts = np.asarray(pd.counts)
@@ -131,6 +136,9 @@ def write_store(path: str, pd: PData,
 
 
 def store_meta(path: str) -> Dict[str, Any]:
+    if path.startswith("s3://"):
+        from dryad_tpu.io.s3_store import s3_store_meta
+        return s3_store_meta(path)
     with open(os.path.join(path, "meta.json")) as f:
         return json.load(f)
 
@@ -199,13 +207,20 @@ def read_store(path: str, mesh, capacity: Optional[int] = None,
     nparts = mesh.devices.size
 
     paths, segments, partviews = [], [], []
-    for p in part_ids:
-        segs, cols = _alloc_part_views(schema, meta["counts"][p])
-        paths.append(_part_path(path, p))
-        segments.append(segs)
-        partviews.append(cols)
-    native.read_files(paths, segments,
-                      compress=(meta.get("compression") == "gzip"))
+    if path.startswith("s3://"):
+        from dryad_tpu.io.s3_store import s3_read_part_views
+        for p in part_ids:
+            segs, cols = s3_read_part_views(path, meta, p)
+            segments.append(segs)
+            partviews.append(cols)
+    else:
+        for p in part_ids:
+            segs, cols = _alloc_part_views(schema, meta["counts"][p])
+            paths.append(_part_path(path, p))
+            segments.append(segs)
+            partviews.append(cols)
+        native.read_files(paths, segments,
+                          compress=(meta.get("compression") == "gzip"))
     if verify:
         verify_checksums(path, meta, segments, partitions=part_ids)
 

@@ -40,19 +40,23 @@ _DEST = "__dest"
 
 def _exchange_one_axis(batch: Batch, dest: jax.Array, axis: str,
                        out_capacity: int, send_slack: int,
-                       all_axes: tuple
-                       ) -> Tuple[Batch, jax.Array, jax.Array]:
+                       all_axes: tuple, slot_rows: int | None = None
+                       ) -> Tuple[Batch, jax.Array, jax.Array, jax.Array]:
     """Send each valid row to index ``dest[row]`` along ``axis``; compact
     received rows.
 
-    Returns ``(batch, need_recv_rows, need_slack)`` — the NEED channels are
-    0 when everything fit; otherwise they carry the MEASURED requirement
-    (max rows any destination must hold / send-slot slack factor needed),
-    so the executor re-plans ONCE at the right size instead of laddering
-    through blind capacity doublings.  This is the reference's dynamic
-    distribution feedback (DrDynamicDistributor.cpp:388 reads real output
-    sizes) in SPMD form: the histogram is computed by the exchange program
-    itself for the price of one tiny psum."""
+    Returns ``(batch, need_recv_rows, need_slack, slot_used)`` — the NEED
+    channels are 0 when everything fit; otherwise they carry the MEASURED
+    requirement (max rows any destination must hold / send-slot slack
+    factor needed), so the executor re-plans ONCE at the right size
+    instead of laddering through blind capacity doublings.  ``slot_used``
+    is ALWAYS the measured max rows any source sent one destination
+    (pmax'd): repeated exchanges (streamed waves, re-run stages) pass it
+    back as ``slot_rows`` to ship EXACT send slots instead of the
+    structural slack padding — wire bytes converge to ~useful bytes (the
+    reference's pull shuffle ships exact file sizes; this is the SPMD
+    form of its dynamic distribution feedback,
+    DrDynamicDistributor.cpp:388)."""
     D = jax.lax.axis_size(axis)
     cap = batch.capacity
     valid = batch.valid_mask()
@@ -60,8 +64,13 @@ def _exchange_one_axis(batch: Batch, dest: jax.Array, axis: str,
 
     # per-destination slot capacity in the send buffer: worst-case a single
     # destination receives this partition's whole batch, but sizing for that
-    # squares the buffer; slack scales with the executor's overflow retry.
-    C = max(1, min(cap, -(-send_slack * cap // D)))
+    # squares the buffer; slack scales with the executor's overflow retry —
+    # and a MEASURED slot_rows (from a prior wave/run) overrides both with
+    # the exact need
+    if slot_rows is not None:
+        C = max(1, min(cap, slot_rows))
+    else:
+        C = max(1, min(cap, -(-send_slack * cap // D)))
 
     order = jnp.argsort(dest, stable=True)
     sdest = jnp.take(dest, order)
@@ -111,41 +120,46 @@ def _exchange_one_axis(batch: Batch, dest: jax.Array, axis: str,
     # any shard's shortfall poisons the whole exchange
     need_recv = jax.lax.pmax(need_recv, all_axes)
     need_slack = jax.lax.pmax(need_slack_l, all_axes)
-    return out, need_recv, need_slack
+    slot_used = jax.lax.pmax(max_cnt, all_axes)
+    return out, need_recv, need_slack, slot_used
 
 
 def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
                      send_slack: int = 2,
-                     axes: tuple = (PARTITION_AXIS,)
-                     ) -> Tuple[Batch, jax.Array, jax.Array]:
+                     axes: tuple = (PARTITION_AXIS,),
+                     slot_rows: int | None = None
+                     ) -> Tuple[Batch, jax.Array, jax.Array, jax.Array]:
     """Send each valid row to GLOBAL partition ``dest[row]`` (index over all
     mesh axes, outermost-major).  1-D mesh: one all_to_all hop.  2-D mesh:
     two hops — to the target dp column within the host, then to the target
-    host over dcn.  Returns (batch, need_recv_rows, need_slack)."""
+    host over dcn.  Returns (batch, need_recv_rows, need_slack,
+    slot_used)."""
     if len(axes) == 1:
         return _exchange_one_axis(batch, dest, axes[0], out_capacity,
-                                  send_slack, axes)
+                                  send_slack, axes, slot_rows=slot_rows)
     if len(axes) != 2:
         raise ValueError(f"unsupported mesh rank {len(axes)}")
     host_axis, dp_axis = axes
     D = jax.lax.axis_size(dp_axis)
     b1 = batch.with_columns({_DEST: dest.astype(jnp.int32)})
     # hop 1 (ICI): to the destination's dp column, within this host
-    h1, nr1, ns1 = _exchange_one_axis(b1, dest % D, dp_axis, out_capacity,
-                                      send_slack, axes)
+    h1, nr1, ns1, su1 = _exchange_one_axis(b1, dest % D, dp_axis,
+                                           out_capacity, send_slack, axes,
+                                           slot_rows=slot_rows)
     # hop 2 (DCN): to the destination host
     d2 = h1.columns[_DEST] // D
-    h2, nr2, ns2 = _exchange_one_axis(h1, d2, host_axis, out_capacity,
-                                      send_slack, axes)
+    h2, nr2, ns2, su2 = _exchange_one_axis(h1, d2, host_axis,
+                                           out_capacity, send_slack, axes,
+                                           slot_rows=slot_rows)
     out_cols = {k: v for k, v in h2.columns.items() if k != _DEST}
     return (Batch(out_cols, h2.count), jnp.maximum(nr1, nr2),
-            jnp.maximum(ns1, ns2))
+            jnp.maximum(ns1, ns2), jnp.maximum(su1, su2))
 
 
 def hash_exchange(batch: Batch, keys: Sequence[str], out_capacity: int,
                   send_slack: int = 2, axes: tuple = (PARTITION_AXIS,),
-                  axis: str | None = None
-                  ) -> Tuple[Batch, jax.Array, jax.Array]:
+                  axis: str | None = None, slot_rows: int | None = None
+                  ) -> Tuple[Batch, jax.Array, jax.Array, jax.Array]:
     """Repartition rows by key hash (HashPartition / shuffle-for-GroupBy).
 
     With ``axis`` set, the exchange touches only that mesh axis — used by
@@ -166,7 +180,8 @@ def hash_exchange(batch: Batch, keys: Sequence[str], out_capacity: int,
             dd = lo % jnp.uint32(Ddp)
             hh = (lo // jnp.uint32(Ddp)) % jnp.uint32(H)
             dest = (hh * jnp.uint32(Ddp) + dd).astype(jnp.int32)
-        return exchange_by_dest(batch, dest, out_capacity, send_slack, axes)
+        return exchange_by_dest(batch, dest, out_capacity, send_slack,
+                                axes, slot_rows=slot_rows)
     if axis == PARTITION_AXIS:
         D = jax.lax.axis_size(axis)
         dest = (lo % jnp.uint32(D)).astype(jnp.int32)
@@ -177,7 +192,7 @@ def hash_exchange(batch: Batch, keys: Sequence[str], out_capacity: int,
     else:
         raise ValueError(axis)
     return _exchange_one_axis(batch, dest, axis, out_capacity, send_slack,
-                              axes)
+                              axes, slot_rows=slot_rows)
 
 
 def _canonical_hash_dest(lo: jax.Array, axes: tuple) -> jax.Array:
@@ -273,8 +288,9 @@ def skew_join_exchange(left: Batch, right: Batch, left_keys, right_keys,
     base_l = _canonical_hash_dest(llo, axes)
     salt = (jnp.arange(left.capacity, dtype=jnp.int32) % P)
     ldest = jnp.where(is_hot_l, (base_l + salt) % P, base_l)
-    lout, lnr, lnsl = exchange_by_dest(left, ldest, left_cap,
-                                       send_slack=send_slack, axes=axes)
+    lout, lnr, lnsl, _ls = exchange_by_dest(left, ldest, left_cap,
+                                            send_slack=send_slack,
+                                            axes=axes)
 
     _, rlo = hash_batch_keys(right, list(right_keys))
     rvalid = right.valid_mask()
@@ -286,10 +302,9 @@ def skew_join_exchange(left: Batch, right: Batch, left_keys, right_keys,
     # compaction REORDERED the rows — destinations must come from the
     # compacted batch's own keys
     _, rnlo = hash_batch_keys(r_non, list(right_keys))
-    rn, rnr2, rnsl = exchange_by_dest(r_non,
-                                      _canonical_hash_dest(rnlo, axes),
-                                      right_cap, send_slack=send_slack,
-                                      axes=axes)
+    rn, rnr2, rnsl, _rs = exchange_by_dest(
+        r_non, _canonical_hash_dest(rnlo, axes), right_cap,
+        send_slack=send_slack, axes=axes)
     rout = concat2(rh, rn)   # capacity 2 * right_cap
     need_slack = jnp.maximum(lnsl, rnsl)
     return lout, rout, lnr, jnp.maximum(rnr1, rnr2), need_slack
@@ -308,8 +323,9 @@ def range_dest_lane(col) -> jax.Array:
 
 def range_exchange(batch: Batch, key: str, bounds: jax.Array,
                    out_capacity: int, descending: bool = False,
-                   send_slack: int = 2, axes: tuple = (PARTITION_AXIS,)
-                   ) -> Tuple[Batch, jax.Array, jax.Array]:
+                   send_slack: int = 2, axes: tuple = (PARTITION_AXIS,),
+                   slot_rows: int | None = None
+                   ) -> Tuple[Batch, jax.Array, jax.Array, jax.Array]:
     """Repartition by range: row -> searchsorted(bounds, lane(key)).
 
     ``bounds`` is a [P-1] uint32 array of split points over the ordering
@@ -323,7 +339,8 @@ def range_exchange(batch: Batch, key: str, bounds: jax.Array,
     if descending:
         P = bounds.shape[0] + 1
         dest = (P - 1) - dest
-    return exchange_by_dest(batch, dest, out_capacity, send_slack, axes)
+    return exchange_by_dest(batch, dest, out_capacity, send_slack, axes,
+                            slot_rows=slot_rows)
 
 
 def zip_exchange(a: Batch, b: Batch, suffix: str = "_r",
@@ -362,7 +379,7 @@ def zip_exchange(a: Batch, b: Batch, suffix: str = "_r",
     dest = jnp.where(gidx < total_a, dest, P)  # beyond left total: drop
 
     b2 = b.with_columns({"__zip_gidx": gidx})
-    recv, need_recv, need_slack = exchange_by_dest(
+    recv, need_recv, need_slack, _slot = exchange_by_dest(
         b2, dest, out_capacity=a.capacity, send_slack=send_slack, axes=axes)
     g = recv.columns["__zip_gidx"].astype(jnp.uint32)
     invalid = (~recv.valid_mask()).astype(jnp.uint32)

@@ -473,19 +473,23 @@ class LocalCluster(ClusterBackend):
         job = self.next_job_id()
         queued = self.pending_release[:]
         del self.pending_release[:len(queued)]
+        hb_every = getattr(config, "gang_heartbeat_s", 2.0) if config \
+            else 2.0
         msg = {"cmd": "run", "plan": plan_json, "sources": source_specs,
                "collect": collect, "store_path": store_path,
                "store_partitioning": store_partitioning, "job": job,
                "config": config, "keep_token": keep_token,
                "release": list(release) + queued,
-               "store_compression": store_compression}
+               "store_compression": store_compression,
+               "hb_every": hb_every}
         for pid in self.gang_pids():
             s = self._socks[pid]
             s.setblocking(True)
             protocol.send_msg(s, msg)
             s.setblocking(False)
 
-        replies = self._gather_job_replies(job, timeout, "job")
+        replies = self._gather_job_replies(job, timeout, "job",
+                                           config=config)
 
         if self.event_log is not None and 0 in replies:
             for e in replies[0].get("events", []):
@@ -517,21 +521,61 @@ class LocalCluster(ClusterBackend):
         return reply0
 
     def _gather_job_replies(self, job: int, timeout: float,
-                            what: str) -> Dict[int, dict]:
+                            what: str, config=None) -> Dict[int, dict]:
         """Collect one reply per worker for ``job`` (shared by execute and
         streamed runs).  On any error reply, stragglers get a 5s grace
         drain (so co-errors reach the diagnosis) and the gang is torn
         down; on success every worker's reply is returned.  Elastic
-        workers never receive gang jobs and are not awaited."""
+        workers never receive gang jobs and are not awaited.
+
+        STRAGGLER/WEDGE WATCHDOG (DrVertex.h:195 / DrStageStatistics.cpp
+        role for a gang that cannot duplicate one member): workers
+        heartbeat while executing; a worker silent past the heartbeat
+        timeout — or one that misses the post-first-reply margin — is
+        declared wedged, the gang is torn down, and the tagged
+        WorkerFailure lets the driver REPLAY the deterministic job on a
+        fresh gang instead of hanging every collective to the hard
+        timeout."""
+        hb_every = getattr(config, "gang_heartbeat_s", 2.0) \
+            if config else 2.0
+        hb_timeout = getattr(config, "gang_heartbeat_timeout_s", 60.0) \
+            if config else 60.0
+        rel = getattr(config, "gang_straggler_rel_margin", 1.0) \
+            if config else 1.0
+        abs_m = getattr(config, "gang_straggler_abs_margin_s", 15.0) \
+            if config else 15.0
         replies: Dict[int, dict] = {}
         pending = set(self.gang_pids())
-        deadline = time.time() + timeout
+        t0 = time.time()
+        deadline = t0 + timeout
+        first_reply_at: Optional[float] = None
+        last_seen: Dict[int, float] = {p: t0 for p in pending}
+
+        def _wedged(pids, why: str):
+            self._kill_all()
+            raise WorkerFailure(
+                f"{what}: workers {sorted(pids)} {why} — declared wedged; "
+                f"gang torn down for replay" + self._log_tails())
+
         while pending:
-            if time.time() > deadline:
+            now = time.time()
+            if now > deadline:
                 self._kill_all()
                 raise WorkerFailure(
                     f"{what} timed out after {timeout}s; workers "
                     f"{sorted(pending)} never replied" + self._log_tails())
+            if hb_every > 0:
+                silent = [p for p in pending
+                          if now - last_seen[p] > hb_timeout]
+                if silent:
+                    _wedged(silent, f"sent no heartbeat for "
+                                    f">{hb_timeout:g}s")
+            if hb_every > 0 and first_reply_at is not None:
+                margin = max(rel * (first_reply_at - t0), abs_m)
+                if now > first_reply_at + margin:
+                    _wedged(pending,
+                            f"missed the straggler margin ({margin:.1f}s "
+                            f"after the first reply)")
             self._check_deaths()
             socks = {self._socks[pid]: pid for pid in pending}
             ready, _, _ = select.select(list(socks), [], [], 0.25)
@@ -543,9 +587,15 @@ class LocalCluster(ClusterBackend):
                     raise WorkerFailure(
                         f"worker {pid} closed its control connection "
                         f"mid-{what}" + self._log_tails())
+                if frames:
+                    last_seen[pid] = time.time()
                 for reply in frames:
+                    if "hb" in reply:      # liveness only, not a reply
+                        continue
                     replies[pid] = reply
                     pending.discard(pid)
+                    if first_reply_at is None:
+                        first_reply_at = time.time()
 
             # a worker that errored before entering a collective leaves the
             # rest blocked forever — once any error reply arrives, give the
@@ -563,6 +613,8 @@ class LocalCluster(ClusterBackend):
                             pending.discard(pid)
                             continue
                         for r in frames:
+                            if "hb" in r:   # liveness frame, not a reply
+                                continue
                             replies[pid] = r
                             pending.discard(pid)
                 break

@@ -165,7 +165,8 @@ def _wave_chunk_op(b, op: StageOp, scale: int):
 
 
 def _build_wave_fn(mesh, leg_ops: List[StageOp], ex: Exchange,
-                   chunk_rows: int, scale: int, slack: int):
+                   chunk_rows: int, scale: int, slack: int,
+                   slot_rows: int | None = None):
     """One jitted shard_map program: per-chunk leg ops + the leg's
     exchange; need channels pmax'd in-program (mirrored retries)."""
     import jax
@@ -185,15 +186,17 @@ def _build_wave_fn(mesh, leg_ops: List[StageOp], ex: Exchange,
             b, need = _wave_chunk_op(b, op, scale)
             need_local = jnp.maximum(need_local, need)
         if ex.kind == "hash":
-            out, nr, nsl = shuffle.hash_exchange(
+            out, nr, nsl, slot = shuffle.hash_exchange(
                 b, list(ex.keys), out_cap, send_slack=slack, axes=axes,
-                axis=ex.axis)
+                axis=ex.axis, slot_rows=slot_rows)
         elif ex.kind == "range":
-            out, nr, nsl = shuffle.range_exchange(
+            out, nr, nsl, slot = shuffle.range_exchange(
                 b, ex.keys[0], bounds, out_cap,
-                descending=ex.descending, send_slack=slack, axes=axes)
+                descending=ex.descending, send_slack=slack, axes=axes,
+                slot_rows=slot_rows)
         elif ex.kind == "broadcast":
             out, nr, nsl = shuffle.broadcast_gather(b, out_cap, axes=axes)
+            slot = jnp.zeros((), jnp.int32)
         else:
             raise StreamPlanError(f"exchange kind {ex.kind!r}")
         exch_scale = (-(-nr // jnp.int32(max(1, ex.out_capacity)))
@@ -201,7 +204,8 @@ def _build_wave_fn(mesh, leg_ops: List[StageOp], ex: Exchange,
         need_scale = jnp.maximum(need_local, exch_scale)
         need_scale = jax.lax.pmax(need_scale, axes)
         info = jnp.stack([need_scale, jnp.asarray(nsl, jnp.int32),
-                          out.count.astype(jnp.int32)])
+                          out.count.astype(jnp.int32),
+                          jnp.asarray(slot, jnp.int32)])
         return _expand(out), info[None]
 
     in_specs = (P(axes), P())
@@ -277,9 +281,14 @@ def _run_leg_waves(dev: _DevStreams, leg_ops: List[StageOp], ex: Exchange,
                 f"exchange capacity {out_cap}; raise chunk_rows")
         store._ram[d] = [out]
 
-    fns: Dict[Tuple[int, int], Any] = {}
+    fns: Dict[Tuple, Any] = {}
     slack = config.initial_send_slack
     scale = 1
+    # measured send-slot right-sizing (DrDynamicDistributor.cpp:388 role):
+    # wave 1 ships the structural slack and MEASURES the real per-slot
+    # need; later waves ship exact slots (quantized to 16 rows to bound
+    # recompiles) — wire bytes converge to ~useful bytes
+    slot_rows: Optional[int] = None
     jbounds = jnp.asarray(bounds_arr)
     its = [iter(cs) for cs in dev.streams]
     while True:
@@ -290,20 +299,32 @@ def _run_leg_waves(dev: _DevStreams, leg_ops: List[StageOp], ex: Exchange,
         if int(live.sum()) == 0:
             break
         for attempt in range(config.max_capacity_retries + 1):
-            key = (scale, slack)
+            key = (scale, slack, slot_rows)
             fn = fns.get(key)
             if fn is None:
                 fn = fns[key] = _build_wave_fn(mesh, leg_ops, ex,
-                                               chunk_rows, scale, slack)
+                                               chunk_rows, scale, slack,
+                                               slot_rows=slot_rows)
             garr = _put_aligned(chunks, schema, chunk_rows, mesh)
             out, info = fn(garr, jbounds)
             local_info = _read_local_shards(info, start, dpp)
             need_scale = int(local_info[:, 0].max())
             need_slack = int(local_info[:, 1].max())
+            slot_used = int(local_info[:, 3].max())
             if need_scale == 0 and need_slack == 0:
+                if ex.kind != "broadcast":
+                    # steady-state exact slots for the NEXT wave (never
+                    # below this wave's measured need)
+                    q = max(16, -(-slot_used // 16) * 16)
+                    slot_rows = max(slot_rows or 0, q)
                 break
             scale = max(scale, need_scale)
-            slack = max(slack, min(need_slack, mesh.devices.size))
+            if slot_rows is not None:
+                # measured mode overflowed (data drifted): resize from
+                # the fresh measurement
+                slot_rows = max(16, -(-slot_used // 16) * 16)
+            else:
+                slack = max(slack, min(need_slack, mesh.devices.size))
         else:
             raise StreamPlanError(
                 "wave exchange still overflowing after "

@@ -84,6 +84,8 @@ def main(argv=None) -> int:
     parent_pid = os.getppid()
     host, port = args.control.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)))
+    import threading
+    send_lock = threading.Lock()   # reply thread + heartbeat thread
     protocol.send_msg(sock, {"hello": args.process_id,
                              "devices": jax.device_count()})
 
@@ -92,10 +94,22 @@ def main(argv=None) -> int:
         this worker, runtime/cluster.py retire_worker) return False
         instead of crashing the process."""
         try:
-            protocol.send_msg(sock, obj)
+            with send_lock:
+                protocol.send_msg(sock, obj)
             return True
         except OSError:
             return False
+
+    def _heartbeat(job, interval: float, stop: "threading.Event"):
+        """Progress frames while a gang job executes: the driver's
+        straggler watchdog (runtime/cluster.py) distinguishes a WEDGED
+        worker (frozen process — heartbeats stop) from a busy one
+        (heartbeats flow even while blocked in a collective, since this
+        thread runs regardless).  Reference role: vertex status updates
+        feeding DrStageStatistics (DrVertex.h:195 duplicate-on-slow)."""
+        while not stop.wait(interval):
+            if not _send_reply({"hb": args.process_id, "job": job}):
+                return
 
     lost_control = False
     while True:
@@ -174,6 +188,14 @@ def main(argv=None) -> int:
             events: list = []
             reply: dict = {"ok": True, "pid": args.process_id,
                            "job": msg.get("job")}
+            hb_stop = threading.Event()
+            hb_every = float(msg.get("hb_every") or 0)
+            hb_thread = None
+            if hb_every > 0:
+                hb_thread = threading.Thread(
+                    target=_heartbeat,
+                    args=(msg.get("job"), hb_every, hb_stop), daemon=True)
+                hb_thread.start()
             try:
                 from dryad_tpu.runtime.exec_common import execute_plan
                 from dryad_tpu.runtime.shiplan import resolve_fn_table
@@ -201,6 +223,10 @@ def main(argv=None) -> int:
                          "job": msg.get("job"),
                          "error": traceback.format_exc()}
                 _tag_missing_token(reply, e)
+            finally:
+                hb_stop.set()
+                if hb_thread is not None:
+                    hb_thread.join(timeout=5)
             reply["events"] = events
             if not _send_reply(reply):
                 lost_control = True

@@ -113,6 +113,21 @@ class JobConfig:
     cluster_startup_timeout_s: float = 180.0
     cluster_job_timeout_s: float = 600.0
     cluster_fn_modules: Tuple[str, ...] = ()
+    # gang straggler/wedge watchdog (runtime/cluster.py; the reference
+    # duplicates ANY slow vertex, DrVertex.h:195 + DrStageStatistics.cpp:
+    # 24-25 — an SPMD gang can't duplicate one member, so a wedged worker
+    # triggers teardown + one replay on a fresh gang instead of hanging
+    # every collective until the hard job timeout):
+    # workers send progress frames every hb_every seconds while a job
+    # runs (0 disables the watchdog)...
+    gang_heartbeat_s: float = 2.0
+    # ...and a worker silent for longer than this is declared WEDGED
+    gang_heartbeat_timeout_s: float = 60.0
+    # once the FIRST worker reply lands, the rest must land within
+    # max(rel x first-reply latency, abs seconds) — post-collective skew
+    # between gang members is otherwise milliseconds
+    gang_straggler_rel_margin: float = 1.0
+    gang_straggler_abs_margin_s: float = 15.0
 
     # -- task farm / speculation (runtime/farm.py) -------------------------
     # EnableSpeculativeDuplication + DrStageStatistics caps
@@ -167,6 +182,13 @@ class JobConfig:
             (self.cluster_processes >= 1, "cluster_processes >= 1"),
             (self.cluster_devices_per_process >= 1,
              "cluster_devices_per_process >= 1"),
+            (self.gang_heartbeat_s >= 0, "gang_heartbeat_s >= 0"),
+            (self.gang_heartbeat_timeout_s > 0,
+             "gang_heartbeat_timeout_s > 0"),
+            (self.gang_straggler_rel_margin >= 0,
+             "gang_straggler_rel_margin >= 0"),
+            (self.gang_straggler_abs_margin_s > 0,
+             "gang_straggler_abs_margin_s > 0"),
             (0.0 <= self.speculation_duplication_budget <= 1.0,
              "speculation_duplication_budget in [0, 1]"),
             (self.speculation_min_samples >= 1,

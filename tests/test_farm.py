@@ -80,8 +80,14 @@ def test_farm_speculates_on_straggler(cluster):
     TaskFarm(cluster).run(plan_json, warm)
 
     vals, per_task = _tasks(cluster, src_key, n_tasks=8)
+    # DETERMINISTIC straggler shape: normal tasks take 0.3s (so the
+    # second worker always answers its idle-gate ping before the queue
+    # drains — warm tasks otherwise finish in ~2ms and worker 0 wins the
+    # whole queue before worker 1 joins), the straggler 8s (decisively
+    # an outlier under any machine load)
     farm = TaskFarm(cluster, min_samples=3,
-                    delay_hook=lambda task, pid: 3.0 if pid == 1 else 0.0)
+                    delay_hook=lambda task, pid:
+                    8.0 if pid == 1 else 0.3)
     results = farm.run(plan_json, per_task)
     _check(vals, results)
     dups = [e for e in farm.events if e["event"] == "task_duplicated"]

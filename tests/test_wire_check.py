@@ -13,9 +13,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def test_wire_accounting():
     from benchmarks.wire_check import main
 
-    r = main(n_devices=8, rows_per_part=2048, n_keys=500)
+    r = main(n_devices=8, rows_per_part=4096)
     assert r["conserved"] and r["placement_ok"]
-    assert r["rows"] == 8 * 2048
-    # send_slack=2 allocates exactly 2x the rows in wire slots
-    assert r["wire_utilization_pct"] == 50.0
-    assert r["wire_bytes"] == 2 * r["useful_bytes"]
+    assert r["rows"] == 8 * 4096
+    # the DISCOVERY wave ships the structural send_slack=2 (exactly 2x
+    # the rows in wire slots)...
+    assert r["discovery_wave"]["utilization_pct_slack"] == 50.0
+    # ...and the steady state ships measured exact slots (VERDICT r3
+    # item 8: wire bytes converge to ~useful bytes)
+    assert r["wire_utilization_pct"] >= 85.0
+    # measured slots genuinely shrink the wire vs the discovery wave
+    assert (r["slot_rows_on_wire"]
+            < r["discovery_wave"]["slot_rows_on_wire"] * 0.7)
+    assert r["wire_bytes"] < 1.2 * r["useful_bytes"]
