@@ -110,6 +110,9 @@ def main():
     degraded = (m["d2h_gbps"] < 0.002
                 or m.get("dispatch_floor_ms", 0) > 400)
     shrink = 4 if degraded else 1
+    if os.environ.get("BENCH_SHRINK"):      # explicit override
+        shrink = max(1, int(os.environ["BENCH_SHRINK"]))
+        degraded = shrink > 1
     if degraded:
         _note(f"bench: DEGRADED link (d2h {m['d2h_gbps']:.4f} GB/s, "
               f"floor {m.get('dispatch_floor_ms', 0):.0f} ms) — sizes /"

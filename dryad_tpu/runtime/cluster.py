@@ -110,6 +110,16 @@ class LocalCluster(ClusterBackend):
         self._job_seq += 1
         return self._job_seq
 
+    def _emit(self, event: dict) -> None:
+        """Structured failure/lifecycle events into the driver's event
+        stream (the Calypso reporter feed the diagnosis view renders —
+        JobBrowser/Diagnosis.cs:929 role)."""
+        if self.event_log is not None:
+            try:
+                self.event_log(event)
+            except Exception:
+                pass
+
     @property
     def nparts(self) -> int:
         return self.n_processes * self.devices_per_process
@@ -269,6 +279,12 @@ class LocalCluster(ClusterBackend):
     def _check_deaths(self, during_startup: bool = False) -> None:
         for pid, proc in enumerate(self._procs):
             if proc.poll() is not None:
+                self._emit({"event": "worker_failed", "worker": pid,
+                            "error": f"process exited with "
+                                     f"rc={proc.returncode}"
+                                     + ("" if during_startup
+                                        else " mid-job"),
+                            "log_tails": self._log_tails(800)})
                 self._kill_all()
                 raise WorkerFailure(
                     f"worker {pid} exited with rc={proc.returncode}"
@@ -552,6 +568,9 @@ class LocalCluster(ClusterBackend):
         last_seen: Dict[int, float] = {p: t0 for p in pending}
 
         def _wedged(pids, why: str):
+            self._emit({"event": "worker_wedged", "workers": sorted(pids),
+                        "why": why, "what": what,
+                        "log_tails": self._log_tails(800)})
             self._kill_all()
             raise WorkerFailure(
                 f"{what}: workers {sorted(pids)} {why} — declared wedged; "
@@ -622,6 +641,10 @@ class LocalCluster(ClusterBackend):
         errs = {pid: r["error"] for pid, r in replies.items()
                 if not r.get("ok")}
         if errs:
+            self._emit({"event": "job_failed", "what": what,
+                        "workers": sorted(errs),
+                        "error": errs[min(errs)],
+                        "log_tails": self._log_tails(800)})
             self._kill_all()  # gang state is unknown after an error
             first = min(errs)
             # ANY failing worker's lost-resident tag makes the job

@@ -20,7 +20,8 @@ __all__ = ["EventLog", "job_report"]
 # event kinds by verbosity level (DRYAD_LOGGING_LEVEL role,
 # LinqToDryadJM.cs:213): 0=errors only, 1=+stage/job lifecycle, 2=all
 _LEVELS = {
-    "stage_replay": 0, "worker_failed": 0,
+    "stage_replay": 0, "worker_failed": 0, "job_failed": 0,
+    "worker_wedged": 0,
     "stage_done": 1, "plan": 1, "stage_spilled": 1, "stage_restored": 1,
     "task_done": 1, "task_duplicated": 1, "task_reassigned": 1,
     "progress": 2, "task_duplicate_ignored": 2,

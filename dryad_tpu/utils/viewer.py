@@ -2,15 +2,21 @@
 
 The reference ships a 25 kLoC WinForms JobBrowser (JobBrowser/JOM/
 jobinfo.cs: DAG drawing, per-stage Gantt, diagnosis from the Calypso
-stream).  Here the same three views render from the EventLog into ONE
-static HTML file with inline SVG — no dependencies, openable anywhere:
+stream, live refresh).  Here the same views render from the EventLog
+into ONE static HTML file with inline SVG — no dependencies:
 
 * stage DAG (topological layers, status-ringed nodes for retries/replays)
 * per-run Gantt (time from job start, overflow attempts marked)
 * per-stage table (runs, retries, replays, scale, slack, wall time)
+* FAILURE DIAGNOSIS (JobBrowser/Diagnosis.cs:929 role): worker errors,
+  wedged-gang watchdog verdicts, replay history, worker log tails —
+  rendered from the structured job_failed / worker_wedged /
+  worker_failed / stage_replay events the runtime emits
 
-Every mark carries a native tooltip; a table view accompanies the
-graphics; light/dark render from the same palette roles.
+LIVE VIEW (jobinfo.cs live model role): ``python -m
+dryad_tpu.utils.viewer events.jsonl --serve 8123`` serves the report
+re-rendered from the JSONL stream on every refresh (EventLog flushes
+per event), auto-refreshing every 2 s.
 """
 
 from __future__ import annotations
@@ -19,7 +25,7 @@ import html
 import json
 from typing import Any, Dict, List, Optional
 
-__all__ = ["job_report_html"]
+__all__ = ["job_report_html", "diagnose", "serve_live"]
 
 # palette roles (light, dark) — single accent series + reserved status hues
 _ROLES = {
@@ -208,9 +214,68 @@ def _table(stages, order) -> str:
     return f"<table>{head}{''.join(rows)}</table>"
 
 
+def diagnose(events) -> List[Dict[str, Any]]:
+    """Failure-diagnosis records from the event stream: what failed,
+    where, why, and what the runtime did about it (replay/teardown)."""
+    from dryad_tpu.utils.events import EventLog
+    if isinstance(events, EventLog):
+        events = events.events
+    out: List[Dict[str, Any]] = []
+    for e in events:
+        k = e.get("event")
+        if k == "job_failed":
+            first = (e.get("error") or "").strip().splitlines()
+            out.append({
+                "kind": "worker error", "workers": e.get("workers"),
+                "headline": first[-1] if first else "(no message)",
+                "detail": e.get("error", ""),
+                "log_tails": e.get("log_tails", "")})
+        elif k == "worker_wedged":
+            out.append({
+                "kind": "wedged gang member",
+                "workers": e.get("workers"),
+                "headline": f"{e.get('why', '')} — gang torn down for "
+                            f"replay", "detail": "",
+                "log_tails": e.get("log_tails", "")})
+        elif k == "worker_failed":
+            out.append({"kind": "worker process death",
+                        "workers": [e.get("worker")],
+                        "headline": e.get("error", "process exited"),
+                        "detail": "",
+                        "log_tails": e.get("log_tails", "")})
+        elif k == "stage_replay":
+            out.append({"kind": "stage replay",
+                        "workers": None,
+                        "headline": f"stage {e.get('stage')} replayed "
+                                    f"(attempt {e.get('attempt', '?')})",
+                        "detail": "", "log_tails": ""})
+    return out
+
+
+def _diagnosis_html(events) -> str:
+    recs = diagnose(events)
+    if not recs:
+        return ""
+    blocks = []
+    for r in recs:
+        who = (f" — worker(s) {r['workers']}" if r.get("workers") else "")
+        body = ""
+        if r["detail"]:
+            body += (f"<details><summary>traceback</summary>"
+                     f"<pre>{html.escape(r['detail'])}</pre></details>")
+        if r["log_tails"]:
+            body += (f"<details><summary>worker log tails</summary>"
+                     f"<pre>{html.escape(r['log_tails'])}</pre></details>")
+        blocks.append(
+            f'<div class="diag"><b>{html.escape(r["kind"])}</b>'
+            f'{html.escape(who)}<div class="hl">'
+            f'{html.escape(r["headline"])}</div>{body}</div>')
+    return "<h2>Diagnosis</h2>" + "".join(blocks)
+
+
 def job_report_html(events, plan_json: Optional[str] = None,
-                    path: Optional[str] = None, title: str = "dryad job"
-                    ) -> str:
+                    path: Optional[str] = None, title: str = "dryad job",
+                    live_refresh_s: Optional[float] = None) -> str:
     """Render the event stream as a self-contained HTML report; optionally
     write it to ``path``.  ``plan_json`` (plan/serialize.graph_to_json)
     adds real DAG edges; without it stages are laid out flat."""
@@ -247,9 +312,12 @@ def job_report_html(events, plan_json: Optional[str] = None,
     tile_html = "".join(
         f'<div class="tile"><div class="v">{v}</div>'
         f'<div class="k">{k}</div></div>' for k, v in tiles)
+    _live_meta = (f'<meta http-equiv="refresh" '
+                  f'content="{live_refresh_s:g}">'
+                  if live_refresh_s else "")
 
     doc = f"""<!DOCTYPE html>
-<html><head><meta charset="utf-8"><title>{html.escape(title)}</title>
+<html><head><meta charset="utf-8">{_live_meta}<title>{html.escape(title)}</title>
 <style>
   :root {{ color-scheme: light; {roles(0)} }}
   @media (prefers-color-scheme: dark) {{ :root {{ color-scheme: dark;
@@ -270,9 +338,15 @@ def job_report_html(events, plan_json: Optional[str] = None,
     text-align: right; }}
   th {{ color: var(--ink2); font-weight: 600; }}
   td:nth-child(2), th:nth-child(2) {{ text-align: left; }}
-</style></head><body>
+  .diag {{ border: 1px solid var(--critical); border-radius: 8px;
+    padding: 10px 14px; margin: 8px 0; }}
+  .diag .hl {{ color: var(--critical); }}
+  .diag pre {{ overflow-x: auto; font-size: 11px; }}
+</style></head>
+<body>
 <h1>{html.escape(title)}</h1>
 <div class="tiles">{tile_html}</div>
+{_diagnosis_html(events)}
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}
 <h2>Gantt (time from job start)</h2>{_svg_gantt(stages, order)}
 <h2>Per-stage table</h2>{_table(stages, order)}
@@ -281,3 +355,78 @@ def job_report_html(events, plan_json: Optional[str] = None,
         with open(path, "w") as f:
             f.write(doc)
     return doc
+
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Tolerant JSONL load: a partially-written trailing line (the
+    writer may be mid-flush while a live refresh reads) is skipped
+    instead of breaking the view."""
+    events: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except FileNotFoundError:
+        pass
+    return events
+
+
+def serve_live(jsonl_path: str, port: int = 0,
+               refresh_s: float = 2.0):
+    """Serve the report over HTTP, re-rendered from the JSONL event
+    stream on every request (EventLog flushes per event, so an open
+    browser follows a RUNNING job — the live JobBrowser model).
+    Returns the bound (server, port); call server.serve_forever()."""
+    import http.server
+
+    def render() -> bytes:
+        return job_report_html(_read_jsonl(jsonl_path), title=jsonl_path,
+                               live_refresh_s=refresh_s).encode()
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = render()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), H)
+    return srv, srv.server_address[1]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="dryad_tpu job viewer: render an EventLog JSONL to "
+                    "HTML, or serve it live")
+    ap.add_argument("events", help="EventLog JSONL path")
+    ap.add_argument("-o", "--out", help="write static HTML here")
+    ap.add_argument("--serve", type=int, metavar="PORT",
+                    help="serve live (re-rendered per refresh)")
+    args = ap.parse_args(argv)
+    if args.serve is not None:
+        srv, port = serve_live(args.events, args.serve)
+        print(f"live viewer: http://127.0.0.1:{port}/", flush=True)
+        srv.serve_forever()
+        return 0
+    events = _read_jsonl(args.events)
+    out = args.out or (args.events + ".html")
+    job_report_html(events, path=out, title=args.events)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

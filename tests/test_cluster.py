@@ -371,7 +371,8 @@ def test_gang_straggler_watchdog_replays(tmp_path):
                         gang_heartbeat_s=0.5,
                         gang_heartbeat_timeout_s=6.0,
                         gang_straggler_abs_margin_s=5.0)
-        ctx = Context(cluster=cl, config=cfg)
+        events = []
+        ctx = Context(cluster=cl, config=cfg, event_log=events.append)
         v = np.arange(4000, dtype=np.int32)
         # warm the gang (compiles) so the wedged run's timings are clean
         assert ctx.from_columns({"v": v}).count() == 4000
@@ -385,6 +386,10 @@ def test_gang_straggler_watchdog_replays(tmp_path):
         assert out == 4000
         # completed via watchdog + replay, nowhere near the 600s timeout
         assert wall < 240, f"took {wall:.0f}s — watchdog did not trip"
+        # the wedge verdict landed in the event stream (the diagnosis
+        # view renders it — utils/viewer.diagnose)
+        wedges = [e for e in events if e.get("event") == "worker_wedged"]
+        assert wedges and 1 in wedges[0]["workers"]
     finally:
         for p in cl._procs:
             try:
