@@ -107,9 +107,15 @@ def main():
     # configs would spend the whole budget waiting on transfers/remote
     # compiles — scale sizes down and say so (sizes are in the output;
     # throughput figures stay honest per-row).
+    # remote compiles scale with SHAPE through the tunnel (measured: a
+    # 2M-row group took 228 s to compile on a sick day, 10M exceeded
+    # 570 s) — the compile probe is the health check that matters most
     degraded = (m["d2h_gbps"] < 0.002
-                or m.get("dispatch_floor_ms", 0) > 400)
+                or m.get("dispatch_floor_ms", 0) > 400
+                or m.get("compile_probe_s", 0) > 20)
     shrink = 4 if degraded else 1
+    if m.get("compile_probe_s", 0) > 90:
+        shrink = 8
     if os.environ.get("BENCH_SHRINK"):      # explicit override
         shrink = max(1, int(os.environ["BENCH_SHRINK"]))
         degraded = shrink > 1

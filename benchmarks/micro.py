@@ -201,11 +201,24 @@ def bench_exchange_effective(rows: int = 1_000_000,
             "exchange_buckets": n_buckets}
 
 
+def bench_compile_probe() -> Dict[str, float]:
+    """Time ONE fresh-program compile (a run-unique constant defeats
+    every cache): through a remote-compile tunnel this is the health
+    probe for the compile path, which can degrade independently of the
+    transfer rates (bench.py shrinks sizes when it is sick)."""
+    salt = float(int(time.time()) % 100000)
+    x = jnp.zeros((512, 512), jnp.float32)
+    t0 = time.perf_counter()
+    jax.jit(lambda a: jnp.tanh(a * salt) @ a + salt).lower(x).compile()
+    return {"compile_probe_s": time.perf_counter() - t0}
+
+
 def run_all() -> Dict[str, float]:
     out: Dict[str, float] = {}
     out.update(bench_transfers())
     out.update(bench_hbm_copy())
     out.update(bench_device_truth())
+    out.update(bench_compile_probe())
     out.update(bench_all_to_all())
     out.update(bench_exchange_effective())
     return out

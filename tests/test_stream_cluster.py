@@ -222,3 +222,37 @@ def test_cluster_stream_pagerank_do_while(cluster, tmp_path):
     for n_, r_ in zip(out["node"], out["rank"]):
         got[int(n_)] = float(r_)
     np.testing.assert_allclose(got, exp, rtol=2e-3, atol=1e-6)
+
+
+def test_cluster_stream_worker_death_replays(store, data, tmp_path):
+    """CHAOS: a worker killed MID-STREAMED-JOB (waves in flight) is
+    detected, the gang restarts, and the driver replays the
+    deterministic streamed query to completion (lineage replay over the
+    >HBM path — SURVEY.md §3.5 applied to runtime/stream_plan.py)."""
+    import signal
+    import threading
+    import time as _time
+
+    cl = LocalCluster(n_processes=2, devices_per_process=2)
+    try:
+        ctx = Context(cluster=cl, config=JobConfig(ooc_chunk_rows=CHUNK))
+        # kill worker 1 shortly after submission (mid-wave: the job has
+        # N/CHUNK ~ 23 waves, each a collective)
+        def assassin():
+            _time.sleep(3.0)
+            os.kill(cl._procs[1].pid, signal.SIGKILL)
+
+        t = threading.Thread(target=assassin, daemon=True)
+        t.start()
+        out = str(tmp_path / "sorted-chaos")
+        (ctx.read_store_stream(store, chunk_rows=CHUNK)
+         .order_by([("v", False)]).to_store(out))
+        t.join()
+        from dryad_tpu.io.store import store_meta
+        meta = store_meta(out)
+        assert sum(meta["counts"]) == N
+        back = Context().from_store(out).collect()
+        np.testing.assert_array_equal(np.asarray(back["v"]),
+                                      np.sort(data["v"]))
+    finally:
+        cl.shutdown()
