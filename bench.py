@@ -47,6 +47,31 @@ def _remaining(budget):
     return budget - (time.time() - _T0)
 
 
+def _retrying(fn, tries=3, label=""):
+    """The remote-compile/dispatch tunnel drops connections under load
+    ('response body closed before all bytes were read'); transient RPC
+    failures get bounded retries instead of sinking the whole capture."""
+    for attempt in range(tries):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt == tries - 1:
+                raise
+            _note(f"bench: {label or 'phase'} attempt {attempt + 1} "
+                  f"failed ({e!r:.200}); retrying")
+            time.sleep(2.0)
+
+
+def _phase(name, fn):
+    """Run one optional bench config; a failure becomes an error record
+    instead of killing the capture (the driver needs SOME JSON line)."""
+    try:
+        return fn()
+    except Exception as e:
+        _note(f"bench: {name} FAILED: {e!r:.300}")
+        return {"error": repr(e)[:300]}
+
+
 def _bench(fn, warmup=1, iters=1):
     for _ in range(warmup):
         fn()
@@ -140,7 +165,7 @@ def main():
     q = wordcount.wordcount_query(
         ds, tokens_per_partition=per_part * (words_per_line + 2))
     _note("bench: wordcount...")
-    q.collect()                      # warmup (compiles)
+    _retrying(q.collect, label="wordcount warmup")   # warmup (compiles)
     mark = len(wc_log.events)
     wc_s = _bench(lambda: q.collect(), warmup=0)
     wc_events = wc_log.events[mark:]   # measured run ONLY
@@ -188,7 +213,7 @@ def main():
         ok, total = _sorted_ok(pd.batch)
         assert bool(np.asarray(ok)) and int(np.asarray(total)) == n_sort
 
-    sort_device_validated()          # warmup (compiles)
+    _retrying(sort_device_validated, label="terasort warmup")
     mark = len(ts_log.events)
     ts_s = _bench(sort_device_validated, warmup=0)
     ts_events = ts_log.events[mark:]
@@ -212,20 +237,34 @@ def main():
     from dryad_tpu.data.columnar import Batch, StringColumn, batch_from_numpy
     from dryad_tpu.ops import kernels as _k
 
+    # slope measurements are DEVICE-only (the tunnel floor cancels);
+    # they reuse the config-sized data (no extra full-size compiles on a
+    # degraded day) and widen the K spread when sizes shrank so the
+    # delta still clears the per-call jitter
+    _slope_n = n_sort
+    _k_hi = 16 if shrink == 1 else 64
     _tb = batch_from_numpy(recs, str_max_len=10)
     _kl = _tb.columns["key"].lengths
     _pay = _tb.columns["payload"]
     _cnt = _tb.count
     _kd = _tb.columns["key"].data
     _vary = jax.jit(lambda d, s: d ^ s)
+    import itertools
+    _salt = itertools.count(1)   # DISTINCT content every timed call —
+    # the tunnel memoizes repeated identical (program, inputs) calls
 
     def _sort_body(i, sd):
         b = Batch({"key": StringColumn(sd ^ jnp.uint8(1), _kl),
                    "payload": _pay}, _cnt)
         return _k.sort_by_columns(b, [("key", False)]).columns["key"].data
 
-    sort_dev_s = slope_time(_sort_body,
-                            lambda j: _vary(_kd, jnp.uint8(hash(j) % 31)))
+    sort_dev_s = _phase("sort_slope", lambda: slope_time(
+        _sort_body, lambda j: _vary(_kd, jnp.uint8(next(_salt) % 251)),
+        k_hi=_k_hi))
+    sort_slope_err = {}
+    if isinstance(sort_dev_s, dict):
+        sort_slope_err = sort_dev_s
+        sort_dev_s = float("inf")
     hbm_true = m["hbm_copy_gbps_true"]
     sort_gbps_dev = sort_bytes / sort_dev_s / (1 << 30)
 
@@ -260,17 +299,28 @@ def main():
         return wall
 
     _note("bench: terasort ooc (streamed Dataset API)...")
-    run_ooc(2)           # warm all compiles first
-    ooc_d1 = run_ooc(1)  # serialized: no transfer/compute overlap
-    ooc_d2 = run_ooc(2)  # double-buffered
-    ooc_rows = n_ooc / ooc_d2 / nchips
-    ooc_shuffle_gbps = n_ooc * 18 / ooc_d2 / (1 << 30)
-    # adaptive tier (default config): data under ooc_incore_bytes skips
-    # the per-chunk host round-trips for ONE device sort
-    _note("bench: terasort ooc (adaptive in-core tier)...")
-    run_ooc(2, incore=1 << 30)  # warm
-    ooc_ad = run_ooc(2, incore=1 << 30)
-    ooc_ad_rows = n_ooc / ooc_ad / nchips
+    ooc_d1 = ooc_d2 = ooc_ad = float("inf")
+    ooc_err = {}
+
+    def _ooc_phase():
+        nonlocal ooc_d1, ooc_d2, ooc_ad
+        _retrying(lambda: run_ooc(2), label="ooc warmup")
+        ooc_d1 = run_ooc(1)  # serialized: no transfer/compute overlap
+        ooc_d2 = run_ooc(2)  # double-buffered
+        # adaptive tier (default config): data under ooc_incore_bytes
+        # skips the per-chunk host round-trips for ONE device sort
+        _note("bench: terasort ooc (adaptive in-core tier)...")
+        _retrying(lambda: run_ooc(2, incore=1 << 30), label="ooc warm")
+        ooc_ad = run_ooc(2, incore=1 << 30)
+        return {}
+
+    ooc_err = _phase("terasort_ooc", _ooc_phase)
+    ooc_rows = (n_ooc / ooc_d2 / nchips
+                if ooc_d2 != float("inf") else None)
+    ooc_shuffle_gbps = (n_ooc * 18 / ooc_d2 / (1 << 30)
+                        if ooc_d2 != float("inf") else None)
+    ooc_ad_rows = (n_ooc / ooc_ad / nchips
+                   if ooc_ad != float("inf") else None)
     # this environment's hard ceiling: the sorted output must cross the
     # device->host link once (store write), 18 B/row
     link_bound_rows = m["d2h_gbps"] * (1 << 30) / 18
@@ -286,39 +336,63 @@ def main():
     n_gb = (2_000_000 if _remaining(budget) > 120 else 400_000) // shrink
     pairs = groupbyreduce.gen_pairs(n_gb, 10_000)
     t0 = time.time()
-    groupbyreduce.groupbyreduce_query(ctx3.from_columns(pairs)).collect()
+    def _gb_run():
+        q = groupbyreduce.groupbyreduce_query(ctx3.from_columns(pairs))
+
+        def once():
+            del gb_log.events[:]   # count only the SUCCESSFUL attempt
+            return q.collect()
+
+        _retrying(once, label="groupbyreduce")
+        return {}
+
+    gb_err = _phase("groupbyreduce", _gb_run)
     comp, runw = _stage_sums(gb_log.events)
 
-    # device-truth group roofline (same methodology as the sort row)
-    _gk = jnp.asarray(pairs["k"])
-    _gcnt = jnp.asarray(n_gb, jnp.int32)
+    # device-truth group roofline (same methodology as the sort row;
+    # config-sized shape, K spread widened under shrink)
 
+    _gslope_n = n_gb
+    _gk2 = jnp.asarray(pairs["k"])
+    _gcnt2 = jnp.asarray(_gslope_n, jnp.int32)
     _gv = jnp.asarray(pairs["v"])
     _gvary = jax.jit(lambda v, s: v + s)
 
     def _group_body(i, v):
-        b = Batch({"k": _gk, "v": v + 1.0}, _gcnt)
+        b = Batch({"k": _gk2, "v": v + 1.0}, _gcnt2)
         out = _k.group_aggregate(b, ["k"], {
             "n": ("count", None), "s": ("sum", "v"), "m": ("mean", "v"),
             "lo": ("min", "v"), "hi": ("max", "v")})
         return v + out.columns["s"]
 
-    group_dev_s = slope_time(_group_body,
-                             lambda j: _gvary(_gv,
-                                              jnp.float32(hash(j) % 13)))
-    group_gbps_dev = n_gb * 12 * 2 / group_dev_s / (1 << 30)
+    group_dev_s = _phase("group_slope", lambda: slope_time(
+        _group_body, lambda j: _gvary(_gv, jnp.float32(next(_salt))),
+        k_hi=_k_hi))
+    group_slope_err = {}
+    if isinstance(group_dev_s, dict):
+        group_slope_err = group_dev_s
+        group_dev_s = float("inf")
+    group_gbps_dev = _gslope_n * 12 * 2 / group_dev_s / (1 << 30)
+    _gb_ok = not gb_err and runw > 1e-6
     extras["groupbyreduce"] = {
+        **gb_err,
         "rows": n_gb, "wall_s_incl_compile": round(time.time() - t0, 2),
         "compile_s": comp, "stage_run_s": runw,
-        "rows_per_sec_chip_run": round(n_gb / max(runw, 1e-9) / nchips, 1),
-        "group_roofline_pct": round(
-            100 * (n_gb * 12 * 2 / max(runw, 1e-9) / (1 << 30)) / hbm_gbps,
-            2),
+        "rows_per_sec_chip_run": (round(n_gb / runw / nchips, 1)
+                                  if _gb_ok else None),
+        "group_roofline_pct": (round(
+            100 * (n_gb * 12 * 2 / runw / (1 << 30)) / hbm_gbps, 2)
+            if _gb_ok else None),
         "device_truth": {
-            "group_device_ms": round(group_dev_s * 1e3, 2),
-            "group_gbps_device": round(group_gbps_dev, 2),
-            "group_roofline_pct_device": round(
-                100 * group_gbps_dev / hbm_true, 2)},
+            **group_slope_err,
+            "group_device_ms": (round(group_dev_s * 1e3, 2)
+                                if group_dev_s != float("inf") else None),
+            "group_gbps_device": (round(group_gbps_dev, 2)
+                                  if group_dev_s != float("inf")
+                                  else None),
+            "group_roofline_pct_device": (round(
+                100 * group_gbps_dev / hbm_true, 2)
+                if group_dev_s != float("inf") else None)},
         "stages_wall_s": _stage_breakdown(gb_log.events)}
 
     _note(f"bench: kmeans... ({_remaining(budget):.0f}s left)")
@@ -327,14 +401,21 @@ def main():
     n_pts = (500_000 if _remaining(budget) > 110 else 100_000) // shrink
     pts, _ = kmeans.gen_points(n_pts, 8, 16)
     t0 = time.time()
-    kmeans.kmeans(ctx5, pts, 16, n_iters=5)
+    def _km_once():
+        del km_log.events[:]   # count only the SUCCESSFUL attempt
+        kmeans.kmeans(ctx5, pts, 16, n_iters=5)
+
+    km_err = _phase("kmeans", lambda: (
+        _retrying(_km_once, label="kmeans"), {})[1])
     comp, runw = _stage_sums(km_log.events)
     extras["kmeans_5iter"] = {
+        **km_err,
         "points": n_pts, "dim": 8, "k": 16,
         "wall_s_incl_compile": round(time.time() - t0, 2),
         "compile_s": comp, "stage_run_s": runw,
-        "points_per_sec_iter_chip_run": round(
-            n_pts * 5 / max(runw, 1e-9) / nchips, 1),
+        "points_per_sec_iter_chip_run": (round(
+            n_pts * 5 / runw / nchips, 1)
+            if not km_err and runw > 1e-6 else None),
         "stages_wall_s": _stage_breakdown(km_log.events)}
 
     _note(f"bench: pagerank x10... ({_remaining(budget):.0f}s left)")
@@ -346,14 +427,21 @@ def main():
         n_nodes, n_edges = 20_000, 200_000
     edges = pagerank.gen_graph(n_nodes, n_edges)
     t0 = time.time()
-    pagerank.pagerank(ctx4, edges, n_nodes, n_iters=10)
+    def _pr_once():
+        del pr_log.events[:]   # count only the SUCCESSFUL attempt
+        pagerank.pagerank(ctx4, edges, n_nodes, n_iters=10)
+
+    pr_err = _phase("pagerank", lambda: (
+        _retrying(_pr_once, label="pagerank"), {})[1])
     comp, runw = _stage_sums(pr_log.events)
     extras["pagerank_10iter"] = {
+        **pr_err,
         "nodes": n_nodes, "edges": n_edges,
         "wall_s_incl_compile": round(time.time() - t0, 2),
         "compile_s": comp, "stage_run_s": runw,
-        "edges_per_sec_iter_chip_run": round(
-            n_edges * 10 / max(runw, 1e-9) / nchips, 1),
+        "edges_per_sec_iter_chip_run": (round(
+            n_edges * 10 / runw / nchips, 1)
+            if not pr_err and runw > 1e-6 else None),
         "stages_wall_s": _stage_breakdown(pr_log.events)}
 
     # ---- multi-chip exchange bookkeeping on a virtual mesh ----
@@ -388,10 +476,11 @@ def main():
     # ---- bench-over-bench history (VERDICT r3 weak 3: regressions must
     # not pass unremarked) ----
     from benchmarks import history as _hist
-    current = {
+    current = {k: v for k, v in {
         "wordcount_rows_s_chip": round(wc_rows, 1),
         "terasort_rows_s_chip": round(ts_rows, 1),
-        "terasort_ooc_rows_s_chip": round(ooc_rows, 1),
+        "terasort_ooc_rows_s_chip": (round(ooc_rows, 1)
+                                     if ooc_rows is not None else None),
         "sort_roofline_pct": round(100 * sort_gbps / hbm_gbps, 2),
         "group_roofline_pct": extras["groupbyreduce"]["group_roofline_pct"],
         "groupby_rows_s_chip":
@@ -400,8 +489,13 @@ def main():
         "kmeans_compile_s": extras["kmeans_5iter"]["compile_s"],
         **({"wire_utilization_pct": wire["wire_utilization_pct"]}
            if "wire_utilization_pct" in wire else {}),
-    }
+    }.items() if v is not None}
     hist = _hist.compare_current(current)
+    if degraded:
+        hist["note"] = ("current run at reduced sizes over a degraded "
+                        "tunnel (see degraded_link) — per-row rates are "
+                        "dispatch-floor-dominated; device_truth rows are "
+                        "the comparable figures")
 
     vs = wc_rows / _R01["wordcount_rows_per_sec_chip"]
     print(json.dumps({
@@ -441,27 +535,41 @@ def main():
                 "sort_bytes_touched_gbps": round(sort_gbps, 3),
                 "hbm_copy_gbps": round(hbm_gbps, 2),
                 "device_truth": {
+                    **sort_slope_err,
                     "note": "stage walls above include a measured "
                             "per-dispatch tunnel floor (transport."
                             "dispatch_floor_ms); these rows are "
                             "slope-measured in-program device time vs "
                             "the TRUE HBM rate",
-                    "sort_device_ms": round(sort_dev_s * 1e3, 2),
-                    "sort_gbps_device": round(sort_gbps_dev, 2),
-                    "sort_roofline_pct_device": round(
-                        100 * sort_gbps_dev / hbm_true, 2),
+                    "sort_device_ms": (round(sort_dev_s * 1e3, 2)
+                                       if sort_dev_s != float("inf")
+                                       else None),
+                    "sort_gbps_device": (round(sort_gbps_dev, 2)
+                                         if sort_dev_s != float("inf")
+                                         else None),
+                    "sort_roofline_pct_device": (round(
+                        100 * sort_gbps_dev / hbm_true, 2)
+                        if sort_dev_s != float("inf") else None),
                     "hbm_copy_gbps_true": round(hbm_true, 1),
                 },
             },
             "terasort_ooc_streamed": {
+                **ooc_err,
                 "api": "plain Dataset (from_stream -> order_by -> "
                        "to_store), exec/stream_exec.py",
                 "rows": n_ooc, "chunk_rows": chunk,
-                "wall_s_depth1": round(ooc_d1, 3),
-                "wall_s_depth2": round(ooc_d2, 3),
-                "overlap_ratio": round(ooc_d2 / ooc_d1, 3),
-                "rows_per_sec_chip": round(ooc_rows, 1),
-                "shuffle_gbps_achieved": round(ooc_shuffle_gbps, 4),
+                "wall_s_depth1": (round(ooc_d1, 3)
+                                  if ooc_d1 != float("inf") else None),
+                "wall_s_depth2": (round(ooc_d2, 3)
+                                  if ooc_d2 != float("inf") else None),
+                "overlap_ratio": (round(ooc_d2 / ooc_d1, 3)
+                                  if ooc_d1 != float("inf")
+                                  and ooc_d2 != float("inf") else None),
+                "rows_per_sec_chip": (round(ooc_rows, 1)
+                                      if ooc_rows is not None else None),
+                "shuffle_gbps_achieved": (
+                    round(ooc_shuffle_gbps, 4)
+                    if ooc_shuffle_gbps is not None else None),
                 "note": "forced out-of-core machinery "
                         "(ooc_incore_bytes=0): every chunk round-trips "
                         "the ~MB/s remote tunnel twice",
@@ -469,8 +577,12 @@ def main():
             "terasort_ooc_adaptive": {
                 "api": "default config: in-core tier engaged "
                        "(ooc_incore_bytes, exec/ooc.external_sort)",
-                "rows": n_ooc, "wall_s": round(ooc_ad, 3),
-                "rows_per_sec_chip": round(ooc_ad_rows, 1),
+                "rows": n_ooc,
+                "wall_s": (round(ooc_ad, 3)
+                           if ooc_ad != float("inf") else None),
+                "rows_per_sec_chip": (round(ooc_ad_rows, 1)
+                                      if ooc_ad_rows is not None
+                                      else None),
                 "link_bound_rows_per_sec_chip": round(link_bound_rows, 1),
                 "note": "output must cross the measured d2h link once "
                         "(18 B/row) — rows/s is link-bound on this "

@@ -83,7 +83,7 @@ def bench_hbm_copy(mb: int = 512, inner: int = 8) -> Dict[str, float]:
     return {"hbm_copy_gbps": gb / t, "hbm_copy_mb": n * 4 / (1 << 20)}
 
 
-def slope_time(body, make_carry, k_lo: int = 2, k_hi: int = 8,
+def slope_time(body, make_carry, k_lo: int = 2, k_hi: int = 16,
                iters: int = 3) -> float:
     """DEVICE seconds per pass of ``body(i, carry) -> carry``, measured as
     the SLOPE between two in-program fori_loop repetition counts.
@@ -119,11 +119,17 @@ def bench_device_truth(mb: int = 256) -> Dict[str, float]:
     x = jnp.arange(n, dtype=jnp.float32)
     x.block_until_ready()
     bump = jax.jit(lambda a, s: a + s)
+    import itertools
+    ctr = itertools.count(1)
 
     def mk(j):
-        return bump(x, jnp.float32(hash(j) % 97))
+        # monotonic salt: DISTINCT content every call (a modular hash
+        # collides and the tunnel then serves a memoized result)
+        return bump(x, jnp.float32(next(ctr)))
 
-    per_pass = slope_time(lambda i, a: a + 1.0, mk)
+    # wide K spread: the delta must clear the per-call jitter of the
+    # tunnel floor (±10 ms), and fresh inputs defeat call memoization
+    per_pass = slope_time(lambda i, a: a + 1.0, mk, k_lo=4, k_hi=64)
     true_gbps = 2 * n * 4 / per_pass / (1 << 30)
     # dispatch floor: whole-call wall minus the device time it contains
     # (fresh inputs per call — see slope_time's memoization note)
@@ -206,7 +212,8 @@ def bench_compile_probe() -> Dict[str, float]:
     every cache): through a remote-compile tunnel this is the health
     probe for the compile path, which can degrade independently of the
     transfer rates (bench.py shrinks sizes when it is sick)."""
-    salt = float(int(time.time()) % 100000)
+    import uuid
+    salt = float(uuid.uuid4().int % 100003)  # unique per invocation
     x = jnp.zeros((512, 512), jnp.float32)
     t0 = time.perf_counter()
     jax.jit(lambda a: jnp.tanh(a * salt) @ a + salt).lower(x).compile()

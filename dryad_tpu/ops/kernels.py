@@ -154,9 +154,8 @@ def _sort_segments_carry(hi: jax.Array, lo: jax.Array, valid: jax.Array,
     big = jnp.uint32(0xFFFFFFFF)
     lo_s = jnp.where(valid, lo, big)
     hi_s = jnp.where(valid, hi, big)
-    out = jax.lax.sort((hi_s, lo_s) + tuple(value_lanes), num_keys=2,
-                       is_stable=True)
-    shi, slo = out[0], out[1]
+    (shi, slo), sorted_vals = _sort_carrying([hi_s, lo_s], value_lanes,
+                                             cap)
     idx = jnp.arange(cap, dtype=jnp.int32)
     svalid = idx < n_valid
     differs = jnp.concatenate([
@@ -166,13 +165,46 @@ def _sort_segments_carry(hi: jax.Array, lo: jax.Array, valid: jax.Array,
     nxt_start = jnp.concatenate([is_start[1:], jnp.ones((1,), jnp.bool_)])
     is_end = svalid & (nxt_start | (idx + 1 == n_valid))
     num_groups = is_start.sum(dtype=jnp.int32)
-    return list(out[2:]), is_start, is_end, num_groups
+    return sorted_vals, is_start, is_end, num_groups
 
 
 # value-carry beats lexsort+gather until the packed row is so wide that
 # carrying it through every compare-exchange pass costs more than one
 # ~9 ns/row random gather (measured crossover ~32 words = 128 B/row)
 _VALOPS_MAX_WORDS = 32
+# ...and until the PROGRAM gets too big: XLA:TPU unrolls sort networks,
+# so executable size scales ~log^2(n) x operands (measured 53 MB for an
+# 8-operand sort at 250k rows) — huge caps with many carried words make
+# remote compiles take minutes and binaries enormous.  Above this
+# cap x operand budget, reorder via the 3-operand index sort + ONE
+# packed gather instead (slower on-device at huge n, but compilable).
+_VALOPS_MAX_ELEMS = 48 << 20
+
+
+def _carry_fits(cap: int, n_key_lanes: int, n_val_lanes: int) -> bool:
+    return (n_val_lanes <= _VALOPS_MAX_WORDS
+            and cap * (n_key_lanes + n_val_lanes) <= _VALOPS_MAX_ELEMS)
+
+
+def _sort_carrying(key_lanes, value_lanes, cap: int):
+    """Stable sort by uint32 ``key_lanes`` returning the value lanes in
+    sorted order — value-carry when the program-size budget allows, else
+    index sort + one packed gather (see _VALOPS_MAX_ELEMS)."""
+    value_lanes = list(value_lanes)
+    if _carry_fits(cap, len(key_lanes), len(value_lanes)):
+        out = jax.lax.sort(tuple(key_lanes) + tuple(value_lanes),
+                           num_keys=len(key_lanes), is_stable=True)
+        return list(out[:len(key_lanes)]), list(out[len(key_lanes):])
+    out = jax.lax.sort(tuple(key_lanes)
+                       + (jnp.arange(cap, dtype=jnp.int32),),
+                       num_keys=len(key_lanes), is_stable=True)
+    order = out[len(key_lanes)]
+    if not value_lanes:
+        return list(out[:len(key_lanes)]), []
+    words = jnp.stack(value_lanes, axis=1)
+    g = jnp.take(words, order, axis=0)
+    return (list(out[:len(key_lanes)]),
+            [g[:, j] for j in range(len(value_lanes))])
 
 
 def permute_by_sort(batch: Batch, key_lanes: Sequence[jax.Array],
@@ -183,16 +215,8 @@ def permute_by_sort(batch: Batch, key_lanes: Sequence[jax.Array],
     lexsort+single-packed-gather for very wide rows."""
     lanes, spec = _pack_columns_u32(dict(batch.columns))
     new_count = batch.count if count is None else count
-    if len(lanes) <= _VALOPS_MAX_WORDS:
-        out = jax.lax.sort(tuple(key_lanes) + tuple(lanes),
-                           num_keys=len(key_lanes), is_stable=True)
-        return Batch(_unpack_columns_u32(list(out[len(key_lanes):]), spec),
-                     new_count)
-    order = jnp.lexsort(tuple(reversed(list(key_lanes))))
-    words = jnp.stack(lanes, axis=1)
-    g = jnp.take(words, order, axis=0)
-    return Batch(_unpack_columns_u32([g[:, j] for j in range(g.shape[1])],
-                                     spec), new_count)
+    _, svals = _sort_carrying(list(key_lanes), lanes, batch.capacity)
+    return Batch(_unpack_columns_u32(svals, spec), new_count)
 
 
 # ---------------------------------------------------------------------------
@@ -495,9 +519,8 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
         dense_in[out_name] = o
 
     lanes2, spec2 = _pack_columns_u32(dense_in)
-    out2 = jax.lax.sort(((~is_end).astype(jnp.uint32),) + tuple(lanes2),
-                        num_keys=1, is_stable=True)
-    dcols = _unpack_columns_u32(list(out2[1:]), spec2)
+    _, svals2 = _sort_carrying([(~is_end).astype(jnp.uint32)], lanes2, cap)
+    dcols = _unpack_columns_u32(svals2, spec2)
     gmask = idx < num_groups
     out_cols = {name: _mask_rows(v, gmask) for name, v in dcols.items()}
     return Batch(out_cols, num_groups)
@@ -900,9 +923,9 @@ def distinct(batch: Batch, key_names: Sequence[str] | None = None) -> Batch:
     lanes, spec = _pack_columns_u32(dict(batch.columns))
     slanes, is_start, _is_end, num_groups = _sort_segments_carry(
         hi, lo, batch.valid_mask(), batch.count, lanes)
-    out2 = jax.lax.sort(((~is_start).astype(jnp.uint32),) + tuple(slanes),
-                        num_keys=1, is_stable=True)
-    cols = _unpack_columns_u32(list(out2[1:]), spec)
+    _, svals2 = _sort_carrying([(~is_start).astype(jnp.uint32)], slanes,
+                               cap)
+    cols = _unpack_columns_u32(svals2, spec)
     gmask = idx < num_groups
     return Batch({k: _mask_rows(v, gmask) for k, v in cols.items()},
                  num_groups)

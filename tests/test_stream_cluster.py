@@ -244,10 +244,15 @@ def test_cluster_stream_worker_death_replays(store, data, tmp_path):
 
         t = threading.Thread(target=assassin, daemon=True)
         t.start()
+        t0 = _time.time()
         out = str(tmp_path / "sorted-chaos")
         (ctx.read_store_stream(store, chunk_rows=CHUNK)
          .order_by([("v", False)]).to_store(out))
         t.join()
+        if _time.time() - t0 <= 3.0:
+            pytest.skip("job finished before the kill landed — replay "
+                        "path not exercised on this (fast) run")
+
         from dryad_tpu.io.store import store_meta
         meta = store_meta(out)
         assert sum(meta["counts"]) == N
