@@ -135,11 +135,17 @@ def main():
     # remote compiles scale with SHAPE through the tunnel (measured: a
     # 2M-row group took 228 s to compile on a sick day, 10M exceeded
     # 570 s) — the compile probe is the health check that matters most
+    # thresholds calibrated against observed states: healthy service =
+    # big probe well under 20 s; at 33 s the 10M-shape stage compiles
+    # exceeded 15 minutes (super-linear shape scaling) — so anything
+    # over 25 s runs reduced sizes
     degraded = (m["d2h_gbps"] < 0.002
                 or m.get("dispatch_floor_ms", 0) > 400
-                or m.get("compile_probe_s", 0) > 20)
+                or m.get("compile_probe_s", 0) > 20
+                or m.get("compile_probe_big_s", 0) > 25)
     shrink = 4 if degraded else 1
-    if m.get("compile_probe_s", 0) > 90:
+    if (m.get("compile_probe_s", 0) > 90
+            or m.get("compile_probe_big_s", 0) > 120):
         shrink = 8
     if os.environ.get("BENCH_SHRINK"):      # explicit override
         shrink = max(1, int(os.environ["BENCH_SHRINK"]))

@@ -208,16 +208,39 @@ def bench_exchange_effective(rows: int = 1_000_000,
 
 
 def bench_compile_probe() -> Dict[str, float]:
-    """Time ONE fresh-program compile (a run-unique constant defeats
-    every cache): through a remote-compile tunnel this is the health
-    probe for the compile path, which can degrade independently of the
-    transfer rates (bench.py shrinks sizes when it is sick)."""
+    """Time fresh-program compiles (run-unique constants defeat every
+    cache): through a remote-compile tunnel the compile path can degrade
+    independently of the transfer rates — and independently PER SHAPE
+    CLASS (whole sessions observed where small programs compile in <1 s
+    while multi-million-row sort programs take 4+ minutes).  Two probes:
+    a small elementwise/matmul program, and a representative BIG sort (a
+    3-operand 2M-row sort, the shape class every full-size bench stage
+    leans on).  bench.py shrinks sizes when either is sick."""
     import uuid
     salt = float(uuid.uuid4().int % 100003)  # unique per invocation
     x = jnp.zeros((512, 512), jnp.float32)
     t0 = time.perf_counter()
     jax.jit(lambda a: jnp.tanh(a * salt) @ a + salt).lower(x).compile()
-    return {"compile_probe_s": time.perf_counter() - t0}
+    small = time.perf_counter() - t0
+    out = {"compile_probe_s": small}
+    if small > 20:
+        # small probe already sick: don't pay a big compile to learn more
+        out["compile_probe_big_s"] = float("inf")
+        return out
+    k = jnp.zeros((1 << 21,), jnp.uint32)
+    isalt = jnp.uint32(uuid.uuid4().int % 1000003)
+
+    def big(a):
+        s0, s1, s2 = jax.lax.sort(
+            (a ^ isalt, a + isalt,
+             jax.lax.iota(jnp.uint32, a.shape[0])), num_keys=2,
+            is_stable=True)
+        return s0[0] + s2[0]
+
+    t0 = time.perf_counter()
+    jax.jit(big).lower(k).compile()
+    out["compile_probe_big_s"] = time.perf_counter() - t0
+    return out
 
 
 def run_all() -> Dict[str, float]:
