@@ -479,20 +479,22 @@ class Executor:
         if self._multiproc:
             from dryad_tpu.exec.data import replicate_tree
             lanes, counts = replicate_tree((lanes, counts), self.mesh)
-        lanes = np.asarray(lanes)
-        counts = np.asarray(counts)
-        samples = []
-        for p_i in range(src.nparts):
-            take = min(int(counts[p_i]), S)
-            if take > 0:
-                samples.append(lanes[p_i, :take])
-        if not samples:
-            return jnp.zeros((self.nparts - 1,), jnp.uint32)
-        s = np.sort(np.concatenate(samples).astype(np.uint64))
-        qs = np.asarray([len(s) * (i + 1) // self.nparts
-                         for i in range(self.nparts - 1)], np.int64)
-        bounds = s[np.minimum(qs, len(s) - 1)].astype(np.uint32)
-        return jnp.asarray(bounds)
+        # split points computed ON DEVICE end to end: no host round trip
+        # between the sampled stage and the range exchange (the per-stage
+        # dispatch collapse, VERDICT r4 next-2 — bounds ride to the next
+        # stage program as a device argument).  Invalid sample slots fold
+        # to the all-ones sentinel and sort last; a valid lane equal to
+        # the sentinel only nudges a HEURISTIC split point.
+        P_ = self.nparts
+        take = jnp.minimum(counts.astype(jnp.int32), S)  # [P]
+        pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+        valid = pos < take[:, None]
+        flat = jnp.where(valid, lanes, jnp.uint32(0xFFFFFFFF)).reshape(-1)
+        srt = jnp.sort(flat)
+        n_tot = take.sum()
+        qs = (n_tot * jnp.arange(1, P_, dtype=jnp.int32)) // P_
+        bounds = jnp.take(srt, jnp.clip(qs, 0, flat.shape[0] - 1))
+        return jnp.where(n_tot > 0, bounds, 0).astype(jnp.uint32)
 
     # -- execution ---------------------------------------------------------
 
@@ -536,7 +538,38 @@ class Executor:
                 raise KeyError(f"unbound placeholder {v!r}")
         raise ValueError(leg.src)
 
-    def _run_stage(self, stage: Stage, results, bindings) -> PData:
+    def _decide_needs(self, stage: Stage, scale: int, slack: int,
+                      salted: bool, need_scale: int, need_slack: int,
+                      need_exch: int):
+        """Shared retry policy: map a stage's measured needs to
+        ("ok", ...) | ("retry", scale, slack, salted), raising
+        CapacityError for unscalable overflows.  Used by the synchronous
+        attempt loop AND by Run's deferred-needs settlement."""
+        of = need_scale > 0 or need_slack > 0
+        if not of:
+            return ("ok",)
+        if need_scale >= _UNSCALABLE or not _stage_overflow_scalable(stage):
+            raise CapacityError(
+                f"stage {stage.id} ({stage.label}) overflowed a fixed "
+                f"capacity (with_capacity truncation, sliding_window "
+                f"halo, or a zip alignment shortfall) — retrying at a "
+                f"larger scale cannot succeed; raise the declared "
+                f"capacity instead")
+        if (not salted and stage.salt_ok
+                and need_exch >= self.config.salt_trigger_factor * scale
+                and self.nparts > 1):
+            # hot-key EXCHANGE skew — see the attempt loop's comment
+            new_scale = max(stage._capacity_scale,
+                            -(-need_exch * 2 // self.nparts))
+            if need_scale > need_exch:
+                new_scale = max(new_scale, need_scale)
+            return ("retry", new_scale,
+                    max(slack, min(need_slack, self.nparts)), True)
+        return ("retry", max(scale, need_scale),
+                max(slack, min(need_slack, self.nparts)), salted)
+
+    def _run_stage(self, stage: Stage, results, bindings,
+                   defer: Optional[list] = None) -> PData:
         inputs = [self._leg_input(leg, results, bindings)
                   for leg in stage.legs]
         bounds = None
@@ -579,6 +612,25 @@ class Executor:
                 self._compile_cache.move_to_end(key)
             t0 = time.time()
             out_batch, info = fn(*args)
+            if defer is not None and attempt == 0:
+                # OPTIMISTIC path: no host sync here.  The needs vector
+                # stays on device; Run._settle batch-fetches every
+                # deferred info in ONE round trip at job end and replays
+                # (synchronously) from the first overflowing stage if
+                # any.  This is what collapses per-stage dispatches to
+                # "one program launch per stage + one fetch per job" —
+                # the reference GM likewise never chats mid-vertex (one
+                # DVertexCommandBlock start per vertex,
+                # dvertexcommand.h:199).
+                defer.append({"stage": stage, "info": info,
+                              "scale": scale, "slack": slack,
+                              "salted": salted,
+                              "compile_s": round(compile_s, 4),
+                              "enqueue_s": round(time.time() - t0, 4)})
+                stage._capacity_scale = scale
+                stage._send_slack = slack
+                stage._salted = salted
+                return PData(out_batch, self.nparts)
             if self._multiproc:
                 from dryad_tpu.exec.data import replicate_tree
                 info = replicate_tree(info, self.mesh)
@@ -600,48 +652,24 @@ class Executor:
                          "need_exchange": need_exch, "salted": salted,
                          "rows": rows, "out_bytes": out_bytes,
                          "compile_s": round(compile_s, 4),
+                         "dispatches": 2,   # program launch + info fetch
                          "wall_s": round(wall, 4)})
-            if not of:
+            decision = self._decide_needs(stage, scale, slack, salted,
+                                          need_scale, need_slack,
+                                          need_exch)
+            if decision[0] == "ok":
                 stage._capacity_scale = scale
                 stage._send_slack = slack
                 stage._salted = salted
                 return PData(out_batch, self.nparts)
-            if need_scale >= _UNSCALABLE or not _stage_overflow_scalable(
-                    stage):
-                raise CapacityError(
-                    f"stage {stage.id} ({stage.label}) overflowed a fixed "
-                    f"capacity (with_capacity truncation, sliding_window "
-                    f"halo, or a zip alignment shortfall) — retrying at a "
-                    f"larger scale cannot succeed; raise the declared "
-                    f"capacity instead")
-            if (not salted and stage.salt_ok
-                    and need_exch >= self.config.salt_trigger_factor * scale
-                    and self.nparts > 1):
-                # hot-key EXCHANGE skew (op overflows never trigger this):
-                # one destination needs >= trigger x its CURRENT capacity
-                # (need_exch is measured against the base, so compare at
-                # the sticky scale) — rewrite the exchanges into the
-                # salted form instead of growing one device's capacity
-                # toward N (DrDynamicDistributor.h:79).  Post-salt the hot
-                # rows spread over all partitions, so the exchange need
-                # shrinks by ~P; a KNOWN op need (need_scale above the
-                # exchange's) still applies at full measure — the
-                # ambiguous equal case costs at most one extra
-                # right-sized retry.
-                salted = True
-                scale = max(stage._capacity_scale,
-                            -(-need_exch * 2 // self.nparts))
-                if need_scale > need_exch:
-                    scale = max(scale, need_scale)
-                slack = max(slack, min(need_slack, self.nparts))
-                continue
             # right-size from the measured requirements (the dynamic
             # distribution managers' size feedback, DrDynamicDistributor
             # .cpp:388): ONE retry at the exact need instead of a blind
             # doubling ladder — a 90%-hot-key repartition converges in a
-            # single retry where doubling took three
-            scale = max(scale, need_scale)
-            slack = max(slack, min(need_slack, self.nparts))
+            # single retry where doubling took three.  The salted rewrite
+            # (hot-key exchange skew, DrDynamicDistributor.h:79) is
+            # decided inside _decide_needs.
+            _, scale, slack, salted = decision
         kinds = _stage_kinds(stage)
         hint = ""
         if kinds & _FIXED_OVERFLOW_KINDS:

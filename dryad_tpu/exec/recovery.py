@@ -52,6 +52,16 @@ class Run:
                                else (cfg.failure_budget if cfg else 16))
         self.failures = 0
         self._results: Dict[int, PData] = {}
+        # optimistic (deferred-needs) execution: stages run without any
+        # host sync; every needs vector is batch-fetched ONCE at job end
+        # (see _settle).  Off when spilling (the durable write already
+        # syncs each stage, and a truncated output must not be persisted
+        # as good) and on multi-process gangs (workers advance in
+        # lockstep; the sync path keeps their retry decisions identical).
+        defer_ok = (getattr(cfg, "deferred_needs", True) if cfg else True)
+        self._defer = ([] if defer_ok and not spill_dir
+                       and not getattr(executor, "_multiproc", False)
+                       else None)
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
         # record the EXECUTED plan in the event stream (Calypso topology
@@ -69,9 +79,86 @@ class Run:
 
     def output(self) -> PData:
         out = self.result(self.graph.out_stage)
+        if self._defer:
+            out = self._settle()
         self.ex._event({"event": "progress", "done": len(self._results),
                         "total": len(self.graph.stages), "pct": 100.0})
         return out
+
+    def _settle(self) -> PData:
+        """Resolve every deferred needs vector in ONE host round trip.
+
+        Fetches jnp.stack of all infos (1 dispatch + 1 fetch regardless
+        of stage count), emits the stage_done events the sync path would
+        have, and — when a stage overflowed — applies the shared retry
+        policy to its sticky knobs, invalidates it plus every dependent
+        result, and replays synchronously.  Overflow is the rare case;
+        the common case pays zero per-stage round trips."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        deferred, self._defer = self._defer, None   # replay runs sync
+        infos = np.asarray(jnp.stack([r["info"] for r in deferred]))
+        bad: Dict[int, tuple] = {}
+        for rec, info in zip(deferred, infos):
+            stage = rec["stage"]
+            need_scale = int(info[:, 0].max())
+            need_slack = int(info[:, 1].max())
+            need_exch = int(info[:, 2].max())
+            of = need_scale > 0 or need_slack > 0
+            self.ex._event({
+                "event": "stage_done", "stage": stage.id,
+                "label": stage.label, "attempt": 0,
+                "scale": rec["scale"], "slack": rec["slack"],
+                "overflow": of, "need_scale": need_scale,
+                "need_slack": need_slack, "need_exchange": need_exch,
+                "salted": rec["salted"], "rows": info[:, 3].tolist(),
+                "compile_s": rec["compile_s"], "deferred": True,
+                "dispatches": 1,   # program launch only; fetch amortized
+                "wall_s": rec["enqueue_s"]})
+            if of:
+                decision = self.ex._decide_needs(
+                    stage, rec["scale"], rec["slack"], rec["salted"],
+                    need_scale, need_slack, need_exch)
+                if decision[0] == "retry":
+                    bad[stage.id] = decision
+        if bad:
+            # the settle replay IS a capacity retry — a zero budget means
+            # the user wants the first overflow surfaced, not healed
+            from dryad_tpu.exec.executor import CapacityError
+            max_retries = getattr(self.ex.config, "max_capacity_retries",
+                                  3)
+            if max_retries == 0:
+                sid = min(bad)
+                st = self.graph.stage(sid)
+                raise CapacityError(
+                    f"stage {st.id} ({st.label}) still overflowing after "
+                    f"0 capacity retries (deferred settle)")
+            # drop every overflowed stage AND anything computed from it
+            # (their inputs were truncated), then replay synchronously
+            # with the right-sized sticky knobs
+            dirty = set(bad)
+            changed = True
+            while changed:
+                changed = False
+                for sid in list(self._results):
+                    if sid in dirty:
+                        continue
+                    st = self.graph.stage(sid)
+                    if any(d in dirty for d in st.input_stage_ids()):
+                        dirty.add(sid)
+                        changed = True
+            for sid, (_, scale, slack, salted) in bad.items():
+                st = self.graph.stage(sid)
+                st._capacity_scale = scale
+                st._send_slack = slack
+                st._salted = salted
+            for sid in dirty:
+                self._results.pop(sid, None)
+            self.ex._event({"event": "settle_replay",
+                            "stages": sorted(dirty)})
+        return self.result(self.graph.out_stage)
 
     def result(self, sid: int) -> PData:
         if sid in self._results:
@@ -84,7 +171,8 @@ class Run:
         # ensure inputs (recursively replays lost ancestors)
         for dep in stage.input_stage_ids():
             self.result(dep)
-        out = self.ex._run_stage(stage, self._results, self.bindings)
+        out = self.ex._run_stage(stage, self._results, self.bindings,
+                                 defer=self._defer)
         self._results[sid] = out
         self._save_spill(sid, out)
         # progress percentage pushed to the event stream (the reference

@@ -101,8 +101,12 @@ def sign_v4(cfg: S3Config, method: str, url: str,
     out["x-amz-date"] = amz_date
     out["x-amz-content-sha256"] = payload_hash
 
-    # canonical request
-    canonical_uri = urllib.parse.quote(parts.path or "/", safe="/")
+    # canonical request.  S3 signs the WIRE path verbatim (single
+    # encoding): the caller's URL already carries the percent-encoded key
+    # (_url), and re-quoting here would double-encode (%20 -> %2520) and
+    # make every key with a space/'+' /non-ASCII fail with
+    # SignatureDoesNotMatch against real S3/MinIO (ADVICE r4).
+    canonical_uri = parts.path or "/"
     q = urllib.parse.parse_qsl(parts.query, keep_blank_values=True)
     canonical_query = "&".join(
         f"{urllib.parse.quote(k, safe='')}="

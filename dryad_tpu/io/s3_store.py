@@ -43,8 +43,17 @@ def s3_client(config: Optional[S3Config] = None) -> S3Client:
     return _CLIENT
 
 
-def _part_key(prefix: str, p: int) -> str:
-    return f"{prefix.rstrip('/')}/part-{p:05d}.bin"
+def _part_key(prefix: str, p: int, gen: str = "") -> str:
+    """Part object key; ``gen`` is the write-generation subprefix recorded
+    in meta.json.  Parts of different generations never collide, which is
+    what makes OVERWRITING an existing store prefix atomic at the meta
+    swap: a concurrent reader holding the old meta keeps resolving the old
+    generation's objects, and a mid-write failure leaves the old meta
+    pointing at fully intact old parts (ADVICE r4: without this, new part
+    bytes replaced old ones before the new meta landed).  Empty gen reads
+    legacy stores written before generations existed."""
+    g = f"{gen}/" if gen else ""
+    return f"{prefix.rstrip('/')}/{g}part-{p:05d}.bin"
 
 
 def s3_store_meta(url: str, client: Optional[S3Client] = None
@@ -76,6 +85,8 @@ def s3_write_store(url: str, pd, partitioning=None, compression=None,
             arr_dtype = np.dtype(str(np.asarray(v[0, :1]).dtype))
             schema[k] = {"kind": "dense", "dtype": arr_dtype.name,
                          "shape": list(v.shape[2:])}
+    import uuid
+    gen = uuid.uuid4().hex[:12]
     checksums: List[str] = []
     for p in range(pd.nparts):
         segs = _part_segments_for_write(pd.batch, schema, p,
@@ -84,23 +95,56 @@ def s3_write_store(url: str, pd, partitioning=None, compression=None,
         blob = b"".join(np.ascontiguousarray(s).tobytes() for s in segs)
         if compression == "gzip":
             blob = gzip.compress(blob, compresslevel=1)
-        c.put_object(bucket, _part_key(prefix, p), blob)
+        c.put_object(bucket, _part_key(prefix, p, gen), blob)
     meta = build_meta(schema, counts.tolist(), checksums,
                       partitioning=partitioning, compression=compression,
                       capacity=pd.capacity)
-    # meta LAST = the commit
+    meta["generation"] = gen
+    # the PREVIOUS meta (if any) names the generation readers may still
+    # be holding — it survives this overwrite; anything older is garbage
+    prev_gen = None
+    try:
+        prev = json.loads(c.get_object(bucket,
+                                       prefix.rstrip("/") + "/meta.json"))
+        prev_gen = prev.get("generation", "")
+    except Exception:
+        pass
+    # meta LAST = the commit (readers resolve parts via meta.generation,
+    # so the swap is atomic even over an existing prefix)
     c.put_object(bucket, prefix.rstrip("/") + "/meta.json",
                  json.dumps(meta, indent=1).encode())
+    # two-generation retention: keep the just-superseded generation (a
+    # reader that captured its meta mid-swap can finish), best-effort
+    # delete everything older so daily overwrites do not grow the bucket
+    # without bound
+    try:
+        keep = {gen, prev_gen or ""}
+        base = prefix.rstrip("/") + "/"
+        # materialize the listing BEFORE deleting: deleting while the
+        # paginator is live shifts continuation offsets and skips keys
+        for key, _sz in list(c.list_objects(bucket, base)):
+            rel = key[len(base):]
+            if "/" in rel and rel.endswith(".bin"):
+                g = rel.split("/", 1)[0]
+                if g not in keep:
+                    c.delete_object(bucket, key)
+            elif rel.startswith("part-") and rel.endswith(".bin") \
+                    and "" not in keep:
+                c.delete_object(bucket, key)   # pre-generation legacy
+    except Exception:
+        pass   # GC must never fail a committed write
 
 
 def write_partition_objects(url: str, schema, blobs: List[bytes],
-                            part_ids: List[int],
+                            part_ids: List[int], gen: str = "",
                             client: Optional[S3Client] = None) -> None:
-    """Raw per-partition blob upload (parallel cluster writers)."""
+    """Raw per-partition blob upload (parallel cluster writers); the
+    coordinator that later commits meta.json must pass the same ``gen``
+    it records there."""
     c = client or s3_client()
     bucket, prefix = parse_s3_url(url)
     for p, blob in zip(part_ids, blobs):
-        c.put_object(bucket, _part_key(prefix, p), blob)
+        c.put_object(bucket, _part_key(prefix, p, gen), blob)
 
 
 def _fill_segments(segs: List[np.ndarray], data: bytes) -> None:
@@ -131,7 +175,8 @@ def s3_read_part_views(url: str, meta: Dict[str, Any], p: int,
     c = client or s3_client()
     bucket, prefix = parse_s3_url(url)
     segs, cols = _alloc_part_views(meta["schema"], meta["counts"][p])
-    data = c.get_object(bucket, _part_key(prefix, p))
+    data = c.get_object(bucket, _part_key(prefix, p,
+                                          meta.get("generation", "")))
     if meta.get("compression") == "gzip":
         data = gzip.decompress(data)
     _fill_segments(segs, data)

@@ -140,8 +140,24 @@ def _unpack_columns_u32(lanes: List[jax.Array], spec: List) -> Dict[str, Any]:
 
 
 
+def _segment_flags(differs: jax.Array, n_valid):
+    """Shared boundary derivation for the segment sorters: given the
+    per-row "key differs from previous row" mask over SORTED rows (row 0
+    always True), mark each segment's first/last row among the valid
+    prefix.  The single home of this subtle logic — both the hash and the
+    dense-key sorters call it."""
+    cap = differs.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    svalid = idx < n_valid
+    is_start = svalid & differs
+    nxt_start = jnp.concatenate([is_start[1:], jnp.ones((1,), jnp.bool_)])
+    is_end = svalid & (nxt_start | (idx + 1 == n_valid))
+    num_groups = is_start.sum(dtype=jnp.int32)
+    return is_start, is_end, num_groups
+
+
 def _sort_segments_carry(hi: jax.Array, lo: jax.Array, valid: jax.Array,
-                         n_valid, value_lanes):
+                         n_valid, value_lanes, stable: bool = True):
     """Value-carry hash segmentation: ONE stable variadic sort groups rows
     by the 64-bit hash (invalid rows fold to the all-ones sentinel and
     sort last — same collision budget as _hash_sort_segments), carrying
@@ -149,23 +165,43 @@ def _sort_segments_carry(hi: jax.Array, lo: jax.Array, valid: jax.Array,
     is_start, is_end, num_groups); is_start/is_end mark each hash
     segment's first/last SORTED row among the valid prefix.  The single
     home of this subtle boundary logic — group_aggregate, distinct, and
-    _hash_membership all call it."""
+    _hash_membership all call it.
+
+    ``stable=False`` drops the in-segment order guarantee (XLA's stable
+    sort costs ~2x the unstable one, measured) — safe only when nothing
+    downstream observes the order of rows WITHIN a hash segment."""
     cap = hi.shape[0]
     big = jnp.uint32(0xFFFFFFFF)
     lo_s = jnp.where(valid, lo, big)
     hi_s = jnp.where(valid, hi, big)
     (shi, slo), sorted_vals = _sort_carrying([hi_s, lo_s], value_lanes,
-                                             cap)
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    svalid = idx < n_valid
+                                             cap, stable=stable)
     differs = jnp.concatenate([
         jnp.ones((1,), jnp.bool_),
         (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])])
-    is_start = svalid & differs
-    nxt_start = jnp.concatenate([is_start[1:], jnp.ones((1,), jnp.bool_)])
-    is_end = svalid & (nxt_start | (idx + 1 == n_valid))
-    num_groups = is_start.sum(dtype=jnp.int32)
+    is_start, is_end, num_groups = _segment_flags(differs, n_valid)
     return sorted_vals, is_start, is_end, num_groups
+
+
+def _sort_segments_dense(key_lane: jax.Array, valid: jax.Array, n_valid,
+                         value_lanes):
+    """Dense-key segmentation: like _sort_segments_carry but grouping by a
+    single order-transformed u32 lane holding the EXACT key (no hash, no
+    collision budget).  An explicit invalid flag is the most significant
+    sort key (a real key may legitimately hit the all-ones lane value, so
+    the sentinel fold used for 64-bit hashes is not sound here).  The sort
+    is UNSTABLE: in-segment value order is not observed by any caller
+    (aggregates are commutative; representatives only read key columns,
+    which are equal within a segment).  Returns (sorted key lane, sorted
+    value lanes, is_start, is_end, num_groups)."""
+    cap = key_lane.shape[0]
+    inv = (~valid).astype(jnp.uint32)
+    (sinv, skey), sorted_vals = _sort_carrying(
+        [inv, key_lane], value_lanes, cap, stable=False)
+    differs = jnp.concatenate([
+        jnp.ones((1,), jnp.bool_), skey[1:] != skey[:-1]])
+    is_start, is_end, num_groups = _segment_flags(differs, n_valid)
+    return skey, sorted_vals, is_start, is_end, num_groups
 
 
 # value-carry beats lexsort+gather until the packed row is so wide that
@@ -186,14 +222,14 @@ def _carry_fits(cap: int, n_key_lanes: int, n_val_lanes: int) -> bool:
             and cap * (n_key_lanes + n_val_lanes) <= _VALOPS_MAX_ELEMS)
 
 
-def _sort_carrying(key_lanes, value_lanes, cap: int):
-    """Stable sort by uint32 ``key_lanes`` returning the value lanes in
-    sorted order — value-carry when the program-size budget allows, else
-    index sort + one packed gather (see _VALOPS_MAX_ELEMS)."""
+def _sort_carrying(key_lanes, value_lanes, cap: int, stable: bool = True):
+    """Sort by uint32 ``key_lanes`` (stable by default) returning the value
+    lanes in sorted order — value-carry when the program-size budget
+    allows, else index sort + one packed gather (see _VALOPS_MAX_ELEMS)."""
     value_lanes = list(value_lanes)
     if _carry_fits(cap, len(key_lanes), len(value_lanes)):
         out = jax.lax.sort(tuple(key_lanes) + tuple(value_lanes),
-                           num_keys=len(key_lanes), is_stable=True)
+                           num_keys=len(key_lanes), is_stable=stable)
         return list(out[:len(key_lanes)]), list(out[len(key_lanes):])
     out = jax.lax.sort(tuple(key_lanes)
                        + (jnp.arange(cap, dtype=jnp.int32),),
@@ -561,28 +597,62 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     GroupBy works (planner splits it into local combine -> shuffle -> merge).
     """
     # Scatter- and gather-free lowering (TPU: scatters serialize, random
-    # gathers cost ~9 ns/row): ONE variadic stable sort carries the key +
-    # agg value columns as packed words alongside the 64-bit hash lanes;
-    # segmented associative scans produce running reduces whose per-group
-    # totals sit at each segment's LAST row; a second value-carry sort on
-    # the is_end flag densifies those rows to the front in group order.
-    hi, lo = hash_batch_keys(batch, key_names)
+    # gathers cost ~9 ns/row): ONE variadic sort carries the agg value
+    # columns as packed words alongside the grouping lanes; segmented
+    # associative scans produce running reduces whose per-group totals sit
+    # at each segment's LAST row; a second value-carry sort on the is_end
+    # flag densifies those rows to the front in group order.
+    #
+    # Dense-key fast path: a single <=32-bit dense key groups by its EXACT
+    # order lane — no hashing (exact, no 64-bit collision budget), the key
+    # column rides as one raw lane and is rebuilt from the sorted lane,
+    # and the segment sort runs UNSTABLE (measured ~2x cheaper; nothing
+    # observes in-segment value order).
     valid = batch.valid_mask()
     cap = batch.capacity
     n_valid = batch.count
     idx = jnp.arange(cap, dtype=jnp.int32)
 
-    needed = list(dict.fromkeys(
-        list(key_names) + [v for _, v in aggs.values() if v]))
+    kcol0 = batch.columns[key_names[0]]
+    dense_fast = (len(key_names) == 1 and _lanes_reconstructible(kcol0)
+                  and not isinstance(kcol0, StringColumn)
+                  and len(_dense_sort_lanes(kcol0, False)) == 1)
+
+    needed_vals = list(dict.fromkeys(
+        v for _, v in aggs.values() if v and v not in
+        (key_names if dense_fast else ())))
+    if dense_fast:
+        needed = needed_vals
+    else:
+        needed = list(dict.fromkeys(list(key_names) + needed_vals))
     lanes, spec = _pack_columns_u32({k: batch.columns[k] for k in needed})
-    slanes, is_start, is_end, num_groups = _sort_segments_carry(
-        hi, lo, valid, n_valid, lanes)
+    if dense_fast:
+        kc = kcol0
+        if jnp.issubdtype(kc.dtype, jnp.floating):
+            # grouping equality canonicalizes signed zero (-0.0 == +0.0,
+            # matching hashing._hash_dense and the shuffle partitioner);
+            # the order-transform lane would otherwise split them
+            kc = jnp.where(kc == 0, jnp.zeros((), kc.dtype), kc)
+        key_lane = _dense_sort_lanes(kc, False)[0]
+        skey, slanes, is_start, is_end, num_groups = _sort_segments_dense(
+            key_lane, valid, n_valid, lanes)
+    else:
+        hi, lo = hash_batch_keys(batch, key_names)
+        skey = None
+        slanes, is_start, is_end, num_groups = _sort_segments_carry(
+            hi, lo, valid, n_valid, lanes, stable=False)
     scols = _unpack_columns_u32(slanes, spec)
+    if dense_fast and key_names[0] in (v for _, v in aggs.values() if v):
+        # the key column doubles as an agg value (e.g. count over key):
+        # rebuild its sorted version from the key lane
+        scols[key_names[0]] = _dense_lanes_invert([skey], kcol0.dtype,
+                                                  False)
 
     run_cnt = _seg_scan_reduce((idx < n_valid).astype(jnp.int32),
                                is_start, jnp.add)
 
-    dense_in: Dict[str, Any] = {k: scols[k] for k in key_names}
+    dense_in: Dict[str, Any] = ({} if dense_fast
+                                else {k: scols[k] for k in key_names})
     for out_name, (kind, vname) in aggs.items():
         if kind == "count":
             o = run_cnt
@@ -614,10 +684,17 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
         dense_in[out_name] = o
 
     lanes2, spec2 = _pack_columns_u32(dense_in)
+    if dense_fast:
+        lanes2 = [skey] + lanes2
     _, svals2 = _sort_carrying([(~is_end).astype(jnp.uint32)], lanes2, cap)
+    if dense_fast:
+        skey2, svals2 = svals2[0], svals2[1:]
     dcols = _unpack_columns_u32(svals2, spec2)
     gmask = idx < num_groups
     out_cols = {name: _mask_rows(v, gmask) for name, v in dcols.items()}
+    if dense_fast:
+        out_cols[key_names[0]] = _mask_rows(
+            _dense_lanes_invert([skey2], kcol0.dtype, False), gmask)
     return Batch(out_cols, num_groups)
 
 
@@ -713,11 +790,14 @@ def _hash_membership(hi: jax.Array, lo: jax.Array, flag: jax.Array,
     # regardless, so is_start/is_end stay correct
     (sflag, siota), is_start, is_end, _ng = _sort_segments_carry(
         hi, lo, valid, valid.sum(dtype=jnp.int32),
-        (flag.astype(jnp.uint32), iota))
+        (flag.astype(jnp.uint32), iota), stable=False)
     fwd = _seg_scan_reduce(sflag, is_start, jnp.maximum)
     bwd = _seg_scan_reduce(sflag, is_end, jnp.maximum, reverse=True)
     tot = jnp.maximum(fwd, bwd)
-    _, member = jax.lax.sort((siota, tot), num_keys=1, is_stable=True)
+    # both sorts run unstable: the carried iota is a total key, so the
+    # restore sort is deterministic regardless, and the first sort's
+    # in-segment order is erased by the max-scans
+    _, member = jax.lax.sort((siota, tot), num_keys=1, is_stable=False)
     return member > 0
 
 

@@ -79,6 +79,13 @@ class LocalCluster(ClusterBackend):
         self.startup_timeout = startup_timeout
         self.event_log = event_log
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="dryad-cluster-")
+        # per-cluster control-plane secret: every accepted connection must
+        # answer an HMAC challenge BEFORE any pickle is decoded (pickle
+        # executes code on load; see protocol.server_authenticate).  Local
+        # workers get it via their process environment; remote backends
+        # stage it as a 0600 file (never on a command line).
+        import secrets as _secrets
+        self._secret: Optional[bytes] = _secrets.token_bytes(32)
         self._procs: List[subprocess.Popen] = []
         self._socks: Dict[int, socket.socket] = {}
         # elastic (standalone) workers joined mid-life: control-plane
@@ -157,6 +164,9 @@ class LocalCluster(ClusterBackend):
                 conn, _ = self._listener.accept()
             except socket.timeout:
                 continue
+            if not protocol.server_authenticate(conn, self._secret):
+                conn.close()   # wrong secret / not our worker: reject
+                continue
             hello = protocol.recv_msg(conn)
             conn.setblocking(False)
             self._socks[hello["hello"]] = conn
@@ -178,6 +188,10 @@ class LocalCluster(ClusterBackend):
         env["PYTHONPATH"] = os.pathsep.join(
             [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
                           else []))
+        if self._secret is not None:
+            # in-memory env dict of a direct child: not visible on any
+            # command line (unlike the ssh backend, which stages a file)
+            env["DRYAD_CONTROL_SECRET"] = self._secret.hex()
         cmd = [sys.executable, "-m", "dryad_tpu.runtime.worker",
                "--coordinator",
                f"127.0.0.1:{coord_port if coord_port else 0}",
@@ -223,6 +237,9 @@ class LocalCluster(ClusterBackend):
                 try:
                     conn, _ = self._listener.accept()
                 except socket.timeout:
+                    continue
+                if not protocol.server_authenticate(conn, self._secret):
+                    conn.close()
                     continue
                 hello = protocol.recv_msg(conn)
                 conn.setblocking(False)
@@ -592,9 +609,22 @@ class LocalCluster(ClusterBackend):
             if hb_every > 0 and first_reply_at is not None:
                 margin = max(rel * (first_reply_at - t0), abs_m)
                 if now > first_reply_at + margin:
-                    _wedged(pending,
-                            f"missed the straggler margin ({margin:.1f}s "
-                            f"after the first reply)")
+                    # the heartbeat distinguishes BUSY from FROZEN: past
+                    # the margin, only workers whose heartbeats have ALSO
+                    # stopped are wedged.  A worker still beating is slow
+                    # but alive (deterministic skew — e.g. one member
+                    # writing far larger partitions) and keeps running
+                    # until gang_heartbeat_timeout_s or the job deadline;
+                    # declaring it wedged would fail the identical replay
+                    # too (ADVICE r4).
+                    hb_stale = max(3 * hb_every, 10.0)
+                    frozen = [p for p in pending
+                              if now - last_seen[p] > hb_stale]
+                    if frozen:
+                        _wedged(frozen,
+                                f"missed the straggler margin "
+                                f"({margin:.1f}s after the first reply) "
+                                f"with heartbeats stopped >{hb_stale:g}s")
             self._check_deaths()
             socks = {self._socks[pid]: pid for pid in pending}
             ready, _, _ = select.select(list(socks), [], [], 0.25)

@@ -2,19 +2,93 @@
 
 The role of the reference's vertex command protocol (SURVEY.md §2.2 "vertex
 commands", ProcessService HTTP endpoints): a tiny, explicit wire format —
-8-byte little-endian length + pickled payload.  Pickle is acceptable here
-because both ends are processes WE spawned on the same machine from the
-same codebase (a trusted local control plane, like the reference's
-GM<->daemon channel inside one cluster security domain); nothing in this
-module ever listens on a non-loopback interface.
+8-byte little-endian length + pickled payload.  Pickle executes arbitrary
+code on load, so every control connection must FIRST pass the shared-secret
+HMAC challenge below before a single pickled byte is decoded: the driver
+generates a per-cluster 256-bit secret, hands it to the workers it spawns
+out-of-band (process environment locally; a 0600-mode staged file over the
+remote shell for SSH deployments — never on a command line), and rejects
+any peer that cannot MAC its nonce.  This is what makes binding the
+listener on a non-loopback interface sound (runtime/ssh_cluster.py);
+the reference's GM<->daemon channel relies on the cluster security domain
+the same way (ProcessService authenticates callers via the cluster's
+credentials).
 """
 
 from __future__ import annotations
 
+import hmac
+import os
 import pickle
 import socket
 import struct
-from typing import Any
+from typing import Any, Optional
+
+_MAGIC = b"DRYD"
+_ACK = b"OK01"
+
+
+class AuthError(RuntimeError):
+    """Control-plane handshake failed (wrong secret or not our protocol)."""
+
+
+def server_authenticate(conn: socket.socket, secret: Optional[bytes],
+                        timeout: float = 10.0) -> bool:
+    """Challenge an accepted control connection BEFORE any unpickling.
+
+    Sends a random nonce, requires HMAC-SHA256(secret, nonce) back, acks.
+    Returns False (caller closes the socket) on mismatch, timeout, or a
+    peer that does not speak the handshake.  ``secret=None`` (explicitly
+    configured trust, e.g. single-machine loopback tests) skips the
+    challenge."""
+    if secret is None:
+        return True
+    nonce = os.urandom(16)
+    prev = conn.gettimeout()
+    try:
+        conn.settimeout(timeout)
+        conn.sendall(_MAGIC + nonce)
+        mac = _recv_exact(conn, 32)
+        want = hmac.new(secret, nonce, "sha256").digest()
+        if not hmac.compare_digest(want, mac):
+            return False
+        conn.sendall(_ACK)
+        return True
+    except (OSError, EOFError):
+        return False
+    finally:
+        try:
+            conn.settimeout(prev)
+        except OSError:
+            pass
+
+
+def client_authenticate(sock: socket.socket, secret: Optional[bytes]
+                        ) -> None:
+    """Answer the driver's HMAC challenge (worker side); raises AuthError
+    on a protocol mismatch or rejected MAC."""
+    if secret is None:
+        return
+    hdr = _recv_exact(sock, len(_MAGIC) + 16)
+    if hdr[:len(_MAGIC)] != _MAGIC:
+        raise AuthError("control peer did not send an auth challenge")
+    sock.sendall(hmac.new(secret, hdr[len(_MAGIC):], "sha256").digest())
+    if _recv_exact(sock, len(_ACK)) != _ACK:
+        raise AuthError("driver rejected control-plane credentials")
+
+
+def load_secret_from_env() -> Optional[bytes]:
+    """Worker-side secret source: DRYAD_CONTROL_SECRET (hex, set in the
+    spawned process environment by the local backend) or
+    DRYAD_CONTROL_SECRET_FILE (path to a 0600 staged file, SSH backend)."""
+    h = os.environ.get("DRYAD_CONTROL_SECRET")
+    if h:
+        return bytes.fromhex(h.strip())
+    p = os.environ.get("DRYAD_CONTROL_SECRET_FILE")
+    if p:
+        with open(p) as f:
+            return bytes.fromhex(f.read().strip())
+    return None
 
 _LEN = struct.Struct("<Q")
 # control messages are plans + host source columns; cap frames at 4 GiB to

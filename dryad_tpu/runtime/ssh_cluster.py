@@ -42,9 +42,31 @@ __all__ = ["SshCluster", "default_rsh"]
 
 
 def default_rsh(host: str, command: str) -> List[str]:
-    """ssh argv for one remote shell command (BatchMode: never prompt)."""
-    return ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
-            host, command]
+    """ssh argv for one remote shell command (BatchMode: never prompt).
+    ``accept-new`` pins host keys on first contact instead of disabling
+    verification outright (a silently-MITMed transport would hand the
+    attacker the staged control secret — ADVICE r4)."""
+    return ["ssh", "-o", "BatchMode=yes",
+            "-o", "StrictHostKeyChecking=accept-new", host, command]
+
+
+def _route_source_addr(target: str) -> str:
+    """The local interface address that routes toward ``target`` (UDP
+    connect sends no packets).  Falls back to the hostname's resolution,
+    then loopback — the HMAC handshake still guards whatever we bind."""
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((target, 9))
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
 
 
 def _package_tar() -> bytes:
@@ -74,8 +96,6 @@ class SshCluster(LocalCluster):
     CPU devices, the test topology); ``rsh`` (transport, see module
     docstring)."""
 
-    _bind_host = "0.0.0.0"
-
     def __init__(self, hosts: Sequence[str],
                  devices_per_process: int = 1,
                  driver_host: Optional[str] = None,
@@ -91,6 +111,28 @@ class SshCluster(LocalCluster):
         if not self.hosts:
             raise ValueError("SshCluster needs at least one host")
         self.driver_host = driver_host or socket.gethostname()
+        # bind the control listener to the SPECIFIC address workers dial,
+        # never 0.0.0.0: even with the HMAC handshake in front of the
+        # pickle decoder there is no reason to expose the port on every
+        # interface (ADVICE r4 high).  When driver_host was given
+        # explicitly, its resolution IS the reachable address; the
+        # hostname default instead uses a route probe toward the first
+        # worker host (local resolution of one's own hostname is a
+        # loopback alias like 127.0.1.1 on Debian-style /etc/hosts, which
+        # remote workers cannot reach).
+        if driver_host:
+            try:
+                self._bind_host = socket.gethostbyname(driver_host)
+            except OSError as e:
+                raise ValueError(
+                    f"driver_host {driver_host!r} does not resolve to a "
+                    f"bindable address: {e}") from e
+        else:
+            # advertise the probed IP literal too: remote resolution of
+            # the driver's bare hostname may differ from the interface
+            # that actually routes to the workers
+            self._bind_host = _route_source_addr(list(hosts)[0])
+            self.driver_host = self._bind_host
         # jax.distributed coordinator lives in worker 0's process — its
         # HOST by default; overridable (test transports run every
         # "remote" worker locally)
@@ -110,7 +152,13 @@ class SshCluster(LocalCluster):
     # -- staging (PeloponneseJobSubmission.cs:111-147 role) ----------------
 
     def _stage(self, host: str) -> None:
-        if not self.stage_code or host in self._staged:
+        if host in self._staged:
+            return
+        if not self.stage_code:
+            # no code to ship, but the control secret still travels by
+            # file — the only channel that keeps it off command lines
+            self._stage_secret(host)
+            self._staged.add(host)
             return
         if self._tar is None:
             self._tar = _package_tar()
@@ -122,7 +170,29 @@ class SshCluster(LocalCluster):
             raise WorkerFailure(
                 f"staging to {host} failed (rc={p.returncode}): "
                 f"{p.stderr.decode(errors='replace')[-500:]}")
+        self._stage_secret(host)
         self._staged.add(host)
+
+    def _stage_secret(self, host: str) -> None:
+        """Write the per-cluster control secret to a 0600 remote file over
+        the remote shell's STDIN — never on a command line (visible in ps)
+        and never in the launch environment prefix (part of the ssh
+        command string).  Workers read it via DRYAD_CONTROL_SECRET_FILE
+        and answer the driver's HMAC challenge with it
+        (protocol.server_authenticate)."""
+        path = self._secret_path()
+        cmd = (f"umask 077 && mkdir -p {shlex.quote(self.remote_root)} && "
+               f"cat > {shlex.quote(path)}")
+        p = subprocess.run(self._rsh(host, cmd),
+                           input=self._secret.hex().encode(),
+                           capture_output=True, timeout=60)
+        if p.returncode != 0:
+            raise WorkerFailure(
+                f"secret staging to {host} failed (rc={p.returncode}): "
+                f"{p.stderr.decode(errors='replace')[-500:]}")
+
+    def _secret_path(self) -> str:
+        return os.path.join(self.remote_root, ".control-secret")
 
     # -- spawn (one remote worker per host entry) --------------------------
 
@@ -134,6 +204,7 @@ class SshCluster(LocalCluster):
         coord_host = self.coordinator_host
         envs = {
             "DRYAD_WORKER_ID": str(pid),
+            "DRYAD_CONTROL_SECRET_FILE": self._secret_path(),
         }
         if self.platform == "cpu":
             envs["JAX_PLATFORMS"] = "cpu"

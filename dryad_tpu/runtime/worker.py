@@ -84,6 +84,10 @@ def main(argv=None) -> int:
     parent_pid = os.getppid()
     host, port = args.control.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)))
+    # answer the driver's HMAC challenge BEFORE any pickled traffic; the
+    # secret arrives out-of-band (env for local spawns, a 0600 staged
+    # file for ssh — see protocol.load_secret_from_env)
+    protocol.client_authenticate(sock, protocol.load_secret_from_env())
     import threading
     send_lock = threading.Lock()   # reply thread + heartbeat thread
     protocol.send_msg(sock, {"hello": args.process_id,
@@ -218,6 +222,17 @@ def main(argv=None) -> int:
                     # every worker ships ITS partitions' rows (parallel
                     # collect); the driver concatenates parts in pid order
                     reply["table_part"] = table
+                # test hook ("pid:seconds"): delay ONE worker's reply
+                # while its heartbeats keep flowing — how the watchdog
+                # tests exercise the busy-vs-frozen distinction (a slow
+                # member must NOT be declared wedged while demonstrably
+                # alive)
+                _spec = os.environ.get("DRYAD_TEST_REPLY_DELAY", "")
+                if _spec:
+                    _pid, _, _secs = _spec.partition(":")
+                    if int(_pid) == args.process_id:
+                        import time as _t
+                        _t.sleep(float(_secs))
             except Exception as e:
                 reply = {"ok": False, "pid": args.process_id,
                          "job": msg.get("job"),

@@ -23,12 +23,42 @@ import os
 import threading
 from typing import Optional
 
-__all__ = ["enable_persistent_cache", "DEFAULT_CACHE_DIR"]
+__all__ = ["enable_persistent_cache", "machine_fingerprint",
+           "DEFAULT_CACHE_DIR"]
 
 DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "dryad_tpu", "xla_cache")
 
 _lock = threading.Lock()
 _enabled_dir: Optional[str] = None
+
+
+def machine_fingerprint() -> str:
+    """Short stable hash of this host's CPU feature set + architecture.
+
+    XLA:CPU AOT artifacts embed the COMPILING machine's feature list and
+    loading them on a host with a narrower set "could lead to execution
+    errors such as SIGILL" (XLA's own warning, observed when the driver
+    and workers — or two hosts sharing ~/.cache over NFS — share one
+    cache directory).  Platform NAME alone cannot distinguish two x86
+    hosts with different AVX-512 subsets, so the cache namespace includes
+    this fingerprint.  ``DRYAD_CACHE_MACHINE_TAG`` overrides it (tests,
+    or operators who know their fleet is feature-homogeneous)."""
+    override = os.environ.get("DRYAD_CACHE_MACHINE_TAG")
+    if override:
+        return override
+    import hashlib
+    import platform
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    raw = f"{platform.machine()}|{feats}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:12]
 
 
 def enable_persistent_cache(path: Optional[str] = DEFAULT_CACHE_DIR) -> Optional[str]:
@@ -48,12 +78,15 @@ def enable_persistent_cache(path: Optional[str] = DEFAULT_CACHE_DIR) -> Optional
                 jax.config.update("jax_compilation_cache_dir", None)
                 _enabled_dir = None
             return None
-        # namespace by platform selection: CPU worker processes and the
-        # accelerator-attached driver compile with DIFFERENT machine
-        # feature sets; sharing one directory makes XLA:CPU load AOT
-        # artifacts built for the other configuration (SIGILL risk)
+        # namespace by platform selection AND machine feature set: CPU
+        # worker processes and the accelerator-attached driver compile
+        # with DIFFERENT machine feature sets, and two hosts sharing the
+        # directory (NFS home) may differ in CPU features; sharing one
+        # subdirectory makes XLA:CPU load AOT artifacts built for the
+        # other configuration (SIGILL risk — XLA prints exactly that
+        # warning).  See machine_fingerprint().
         tag = (os.environ.get("JAX_PLATFORMS") or "default").replace(
-            ",", "-")
+            ",", "-") + "-" + machine_fingerprint()
         resolved = os.path.join(os.path.abspath(os.path.expanduser(path)),
                                 tag)
         if _enabled_dir == resolved:

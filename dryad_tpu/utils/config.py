@@ -129,6 +129,15 @@ class JobConfig:
     gang_straggler_rel_margin: float = 1.0
     gang_straggler_abs_margin_s: float = 15.0
 
+    # optimistic stage execution (exec/recovery.Run._settle): stages run
+    # with ZERO per-stage host syncs; every needs vector is batch-fetched
+    # once at job end, and overflows replay synchronously from the first
+    # affected stage.  On a high-latency dispatch link (remote tunnel,
+    # ~0.1 s/round trip) this is the difference between O(stages) and
+    # O(1) round trips per job.  Reference: one DVertexCommandBlock start
+    # per vertex — the GM does not chat mid-vertex (dvertexcommand.h:199).
+    deferred_needs: bool = True
+
     # -- task farm / speculation (runtime/farm.py) -------------------------
     # EnableSpeculativeDuplication + DrStageStatistics caps
     speculation_enabled: bool = True

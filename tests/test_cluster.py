@@ -397,3 +397,30 @@ def test_gang_straggler_watchdog_replays(tmp_path):
             except (ProcessLookupError, OSError):
                 pass
         cl.shutdown()
+
+
+def test_gang_slow_but_beating_worker_not_wedged(monkeypatch):
+    """A gang member that is SLOW but alive (heartbeats flowing) must not
+    be declared wedged by the post-first-reply straggler margin — only a
+    worker whose heartbeats ALSO stopped is frozen (ADVICE r4: wedging
+    deterministic skew fails the identical replay too)."""
+    from dryad_tpu.utils.config import JobConfig
+
+    # worker 1 replies ~8s after worker 0; margin is 3s; heartbeats at
+    # 0.5s keep proving liveness the whole time
+    monkeypatch.setenv("DRYAD_TEST_REPLY_DELAY", "1:8")
+    cl = LocalCluster(n_processes=2, devices_per_process=1)
+    try:
+        cfg = JobConfig(cluster_job_timeout_s=600.0,
+                        gang_heartbeat_s=0.5,
+                        gang_heartbeat_timeout_s=60.0,
+                        gang_straggler_rel_margin=0.0,
+                        gang_straggler_abs_margin_s=3.0)
+        events = []
+        ctx = Context(cluster=cl, config=cfg, event_log=events.append)
+        v = np.arange(1000, dtype=np.int32)
+        assert ctx.from_columns({"v": v}).count() == 1000
+        wedges = [e for e in events if e.get("event") == "worker_wedged"]
+        assert not wedges, f"slow-but-beating worker wedged: {wedges}"
+    finally:
+        cl.shutdown()

