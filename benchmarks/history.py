@@ -50,7 +50,36 @@ _PATTERNS: Dict[str, Tuple[str, bool]] = {
         r'"sort_roofline_pct_device": ([0-9.]+)', True),
     "group_roofline_pct_device": (
         r'"group_roofline_pct_device": ([0-9.]+)', True),
+    # round 5+: EVERY config has a tunnel-immune device row
+    # (benchmarks/device_truth.py)
+    "sort_rows_per_s_device": (
+        r'"sort_rows_per_s_device": ([0-9.]+)', True),
+    "group_rows_per_s_device": (
+        r'"group_rows_per_s_device": ([0-9.]+)', True),
+    "wordcount_lines_per_s_device": (
+        r'"wordcount_lines_per_s_device": ([0-9.]+)', True),
+    "pagerank_edges_per_s_device": (
+        r'"pagerank_edges_per_s_device": ([0-9.]+)', True),
+    "kmeans_points_per_s_device": (
+        r'"kmeans_points_per_s_device": ([0-9.]+)', True),
+    "stream_chunk_rows_per_s_device": (
+        r'"stream_chunk_rows_per_s_device": ([0-9.]+)', True),
 }
+
+# DEVICE rows (slope-measured; the tunnel floor and link weather cancel)
+# adjudicate regressions; wall rows are tunnel-sensitive context.  The
+# tracker lists device verdicts FIRST so a wall slide on a sick-tunnel
+# day cannot mask (or fake) a real device-side regression.
+_DEVICE_METRICS = frozenset(n for n in _PATTERNS
+                            if "_device" in n)
+
+
+def _device_first(flags: List[str]) -> List[str]:
+    dev = [f for f in flags
+           if any(m in f for m in _DEVICE_METRICS)]
+    wall = [f + "  [wall row — tunnel-sensitive; see device rows]"
+            for f in flags if f not in set(dev)]
+    return dev + wall
 
 
 def _extract(text: str) -> Dict[str, float]:
@@ -111,7 +140,7 @@ def flag_regressions(rounds: Dict[str, Dict[str, float]],
                 flags.append(
                     f"{cur} vs {prev}: {name} "
                     f"{a:g} -> {b:g} ({rel:+.0%})")
-    return flags
+    return _device_first(flags)
 
 
 def compare_current(current: Dict[str, float],
@@ -139,6 +168,7 @@ def compare_current(current: Dict[str, float],
         if (rel < -threshold) if hib else (rel > threshold):
             regressions.append(f"vs {last}: {name} {a:g} -> {b:g} "
                                f"({rel:+.0%})")
+    regressions = _device_first(regressions)
     return {"baseline_round": tags[-1], "deltas": deltas,
             "baselines": baselines, "regressions": regressions}
 

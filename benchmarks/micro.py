@@ -9,7 +9,8 @@ rate.  What "line rate" means depends on the fabric available:
   ``bench_hbm_copy`` / ``bench_transfers``; the effective shuffle rate to
   compare against is ``bench_exchange_effective``.
 
-Every figure is device-time fenced via block_until_ready.
+Every figure is fenced by a device->host FETCH (see _fence): on this
+backend block_until_ready returns before execution completes.
 """
 
 from __future__ import annotations
@@ -46,15 +47,17 @@ def bench_transfers(mb: int = 64) -> Dict[str, float]:
     n = mb * (1 << 20)
     host = np.random.RandomState(0).randint(0, 255, n, np.uint8)
     dev = jax.device_put(host)
-    dev.block_until_ready()
+    _fence(dev)
     bump = jax.jit(lambda a: a + jnp.uint8(1))
-    bump(dev).block_until_ready()
+    _fence(bump(dev))
 
-    h2d = _time(lambda: jax.device_put(host).block_until_ready())
+    # h2d closed by a scalar FETCH (block_until_ready does not block on
+    # this backend); the extra round trip is negligible vs MB-scale h2d
+    h2d = _time(lambda: _fence(jax.device_put(host)))
 
     def d2h_once():
         y = bump(dev)          # fresh array, negligible compute
-        y.block_until_ready()
+        _fence(y)
         t0 = time.perf_counter()
         np.asarray(y)
         return time.perf_counter() - t0
@@ -77,36 +80,63 @@ def bench_hbm_copy(mb: int = 512, inner: int = 8) -> Dict[str, float]:
         return a + 1.0
 
     f = jax.jit(lambda a: jax.lax.fori_loop(0, inner, body, a))
-    f(x).block_until_ready()
-    t = _time(lambda: f(x).block_until_ready())
+    _fence(f(x))
+    t = _time(lambda: _fence(f(x)))
     gb = 2 * n * 4 * inner / (1 << 30)  # read + write per pass
+    # wall-based (fetch-fenced) — the tunnel round trip inflates t, so
+    # this UNDERSTATES the chip; hbm_copy_gbps_true (slope) is the honest
+    # denominator
     return {"hbm_copy_gbps": gb / t, "hbm_copy_mb": n * 4 / (1 << 20)}
 
 
-def slope_time(body, make_carry, k_lo: int = 2, k_hi: int = 16,
-               iters: int = 3) -> float:
+def _fence(tree) -> float:
+    """HARD device fence: fetch a scalar reduce of every leaf.
+
+    jax.block_until_ready is NOT a reliable fence on the remote-tunnel
+    backend (measured this round: walls of 0.05 ms for 1M-row sorts —
+    the call returns before execution completes).  Only a device->host
+    FETCH provably waits for the producing computation, so every timed
+    region ends by pulling one scalar.  The fence's own cost (a reduce
+    dispatch + a ~0.1 s round trip) is constant per call and cancels in
+    the slope."""
+    tot = 0.0
+    for l in jax.tree.leaves(tree):
+        tot += float(np.asarray(jnp.sum(l.astype(jnp.float32))))
+    return tot
+
+
+def slope_time(body, make_carry, k_lo: int = 4, k_hi: int = 32,
+               iters: int = 4) -> float:
     """DEVICE seconds per pass of ``body(i, carry) -> carry``, measured as
     the SLOPE between two in-program fori_loop repetition counts.
 
     Why: on a remote-tunnel backend each jit CALL carries a large fixed
-    dispatch cost (measured ~75-115 ms here) that swamps per-call walls —
+    dispatch cost (measured ~75-120 ms here) that swamps per-call walls —
     the round-3 bench's 91.5 "GB/s HBM copy" was mostly that floor (the
-    chip's true HBM rate, slope-measured, is ~1 TB/s).  The difference of
-    two call walls cancels the floor exactly.
+    chip's true HBM rate, slope-measured, is ~619 GB/s).  The difference
+    of two call walls cancels the floor exactly.  The K spread must be
+    wide enough that the device-time delta clears the round-trip jitter
+    (~±15 ms observed).
 
     ``make_carry(j)`` must return a FRESH carry (distinct values per j):
     the tunnel backend memoizes repeated identical (program, inputs)
-    calls, which would time cache hits instead of the device."""
+    calls, which would time cache hits instead of the device.  Timed
+    regions are closed by _fence (a scalar FETCH) — block_until_ready
+    does not actually block through the tunnel."""
     walls = {}
     for K in (k_lo, k_hi):
-        f = jax.jit(lambda c, K=K: jax.lax.fori_loop(0, K, body, c))
-        jax.block_until_ready(f(make_carry(0)))  # compile + warm
+        def run(c, K=K):
+            out = jax.lax.fori_loop(0, K, body, c)
+            return sum(jnp.sum(l.astype(jnp.float32))
+                       for l in jax.tree.leaves(out))
+        f = jax.jit(run)
+        float(np.asarray(f(make_carry(0))))      # compile + warm + fetch
         best = float("inf")
         for j in range(1, iters + 1):
             c = make_carry((K, j))
-            jax.block_until_ready(c)             # build outside the clock
+            _fence(c)                            # settle inputs
             t0 = time.perf_counter()
-            jax.block_until_ready(f(c))
+            float(np.asarray(f(c)))
             best = min(best, time.perf_counter() - t0)
         walls[K] = best
     return max((walls[k_hi] - walls[k_lo]) / (k_hi - k_lo), 1e-9)
@@ -134,13 +164,13 @@ def bench_device_truth(mb: int = 256) -> Dict[str, float]:
     # dispatch floor: whole-call wall minus the device time it contains
     # (fresh inputs per call — see slope_time's memoization note)
     f = jax.jit(lambda a: jax.lax.fori_loop(0, 4, lambda i, b: b + 1.0, a))
-    f(x).block_until_ready()
+    _fence(f(x))
     wall = float("inf")
     for j in (11, 12, 13):
         c = mk(j)
-        jax.block_until_ready(c)
+        _fence(c)
         t0 = time.perf_counter()
-        jax.block_until_ready(f(c))
+        _fence(f(c))
         wall = min(wall, time.perf_counter() - t0)
     floor = max(wall - 4 * per_pass, 0.0)
     return {"hbm_copy_gbps_true": true_gbps,
@@ -170,8 +200,8 @@ def bench_all_to_all(mesh=None, mb_per_device: int = 64) -> Dict[str, float]:
 
     f = jax.jit(shard_map(a2a, mesh=m, in_specs=PartitionSpec("dp", None),
                           out_specs=PartitionSpec("dp", None)))
-    f(x).block_until_ready()
-    t = _time(lambda: f(x).block_until_ready())
+    _fence(f(x))
+    t = _time(lambda: _fence(f(x)))
     # each device sends (P-1)/P of its block
     gb_sent = rows * 4 * (P - 1) / P / (1 << 30)
     return {"all_to_all_gbps_per_device": gb_sent / t,
