@@ -13,6 +13,7 @@ semantics contract the reference tests rely on (SURVEY.md §4).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -251,13 +252,25 @@ class Context:
         (exec/stream_exec.py) — device working set stays O(chunk_rows)
         no matter the total data size (the reference's transparent
         bounded-memory channels, channelbufferqueue.cpp:777)."""
-        from dryad_tpu.exec.stream_exec import (StreamExecutionError,
-                                                StreamSource)
+        from dryad_tpu.exec.stream_exec import StreamSource
         if self.cluster is not None:
-            raise StreamExecutionError(
-                "streamed sources are not supported on a cluster Context "
-                "yet — stream on a single-process Context, or use the "
-                "cluster path with device-resident data")
+            # FromEnumerable parity (DryadLinqContext.cs:1210): a
+            # driver-side generator cannot execute on workers, so the
+            # client SPOOLS the stream into a store the workers can
+            # reach (JobConfig.cluster_stream_spool_dir — shared fs or
+            # s3://; default: a driver temp dir, valid for
+            # single-machine clusters) and the gang streams the store
+            # through the full planned surface (runtime/stream_plan.py).
+            import tempfile
+            import uuid
+
+            from dryad_tpu.exec.ooc import write_chunks_to_store
+            root = (self.config.cluster_stream_spool_dir
+                    or tempfile.mkdtemp(prefix="dryad-spool-"))
+            path = os.path.join(root, f"stream-{uuid.uuid4().hex[:10]}")                 if "://" not in root else                 root.rstrip("/") + f"/stream-{uuid.uuid4().hex[:10]}"
+            write_chunks_to_store(path, iter(source), source.schema)
+            return self.read_store_stream(path,
+                                          chunk_rows=source.chunk_rows)
         node = E.Source(parents=(), data=StreamSource(source),
                         _npartitions=1)
         return Dataset(self, node)

@@ -878,6 +878,66 @@ def _hash_bucketed_reduce(src: ChunkSource, keys: Sequence[str],
             _chunk_to_batch(merged, chunk_rows)))
 
 
+def streaming_group_whole(src: ChunkSource, keys: Sequence[str],
+                          bucket_fn, out_schema: Dict[str, Any],
+                          n_buckets: int | None = None,
+                          depth: int | None = None,
+                          max_bucket_rows: int | None = None,
+                          what: str = "group_whole") -> Iterator[HChunk]:
+    """Whole-group operators over an arbitrarily large chunk stream.
+
+    Aggregates compose (partial + merge), but result selectors over whole
+    groups — group_apply's user fn, group_median — do NOT: every row of a
+    key must be materialized together (reference DryadLinqVertex.cs:
+    510-753, GroupBy handing complete IGroupings to user code).  So RAW
+    rows hash-scatter into ``n_buckets`` key buckets (all rows of a key
+    land in one bucket — the same alignment a post-exchange partition
+    has), each bucket accumulates on host, and finalize materializes one
+    DEVICE batch per bucket for ``bucket_fn``.  A bucket's rows must fit
+    ``max_bucket_rows`` (JobConfig.ooc_group_bucket_rows): there is no
+    associative compaction to fall back on, so the bound is the honest
+    contract — raise n_buckets (or the knob) for bigger data.
+    """
+    n_buckets, depth = _resolve_bucket_knobs(n_buckets, depth)
+    if max_bucket_rows is None:
+        from dryad_tpu.utils.config import JobConfig
+        max_bucket_rows = JobConfig().ooc_group_bucket_rows
+    chunk_rows = src.chunk_rows
+    scatter = _make_hash_scatter_fn(tuple(keys), n_buckets)
+
+    buckets: List[List[HChunk]] = [[] for _ in range(n_buckets)]
+    bucket_rows = [0] * n_buckets
+
+    for chunk in src:
+        if chunk.n == 0:
+            continue
+        grouped, hist = scatter(_chunk_to_batch(chunk, chunk_rows))
+        gh = _batch_to_chunk(grouped)
+        h = np.asarray(hist)
+        offs = np.cumsum(np.concatenate([[0], h]))
+        for i in range(n_buckets):
+            frag = _slice_hchunk(gh, int(offs[i]), int(offs[i + 1]))
+            if frag.n == 0:
+                continue
+            if bucket_rows[i] + frag.n > max_bucket_rows:
+                raise OOCError(
+                    f"{what} bucket {i} holds {bucket_rows[i]} raw rows; "
+                    f"with {frag.n} incoming it exceeds "
+                    f"ooc_group_bucket_rows={max_bucket_rows} (whole "
+                    f"groups cannot be compacted) — raise n_buckets or "
+                    f"the knob")
+            buckets[i].append(frag)
+            bucket_rows[i] += frag.n
+
+    for i in range(n_buckets):
+        if bucket_rows[i] == 0:
+            continue
+        merged = _concat_hchunks(src.schema, buckets[i])
+        buckets[i] = []
+        out = bucket_fn(_chunk_to_batch(merged, merged.n))
+        yield _batch_to_chunk(out)
+
+
 # ---------------------------------------------------------------------------
 # streaming user-decomposable aggregation (IDecomposable over streams)
 

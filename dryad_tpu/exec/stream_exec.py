@@ -75,15 +75,10 @@ _LOCAL_KINDS = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
 # op kinds with whole-stream semantics, each lowered to an ooc primitive
 _STREAM_KINDS = {"sort", "group", "dgroup_local", "distinct",
                  "group_top_k", "take", "skip", "row_index",
-                 "take_while", "skip_while", "sliding_window"}
+                 "take_while", "skip_while", "sliding_window",
+                 "group_rank", "group_apply"}
 
-_UNSUPPORTED_HINTS = {
-    "zip": "zip_with needs global row alignment",
-    "group_apply": "group_apply is not yet streamed — use group_by "
-                   "aggregates, group_top_k, or the in-memory path",
-    "group_rank": "group_median/rank needs whole groups materialized "
-                  "(medians do not compose) — not yet streamed",
-}
+_UNSUPPORTED_HINTS = {}
 
 
 def _unsupported(kind: str) -> StreamExecutionError:
@@ -458,6 +453,69 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
                 depth=config.ooc_inflight)
 
         return ChunkSource(it_topk, cs.schema, cs.chunk_rows)
+    if k == "group_rank":
+        # group_median/rank over streams: medians do not compose, so the
+        # whole-group machinery materializes each key bucket and runs the
+        # in-memory kernel per bucket (DryadLinqVertex.cs:510 whole
+        # IGroupings to the selector)
+        keys = list(p["keys"])
+        fn = jax.jit(lambda b: kernels.group_rank_select(
+            b, keys, p["by"], p["rank"], p["out"]))
+        probe = _batch_to_chunk(fn(_chunk_to_batch(
+            HChunk.empty_like(cs.schema), 1)))
+        schema = chunk_schema(probe)
+
+        def it_rank():
+            return ooc.streaming_group_whole(
+                cs, keys, fn, schema, n_buckets=config.ooc_hash_buckets,
+                depth=config.ooc_inflight,
+                max_bucket_rows=config.ooc_group_bucket_rows,
+                what="group_rank")
+
+        return ChunkSource(it_rank, schema, cs.chunk_rows)
+    if k == "group_apply":
+        # general per-group result selector over streams, with the same
+        # measured-need retry the in-memory executor gives it: the
+        # kernel's (num_groups, max_group, total_out) channel right-sizes
+        # a per-bucket retry instead of failing on the static knobs
+        keys = list(p["keys"])
+        G0, C0, O0 = p["max_groups"], p["group_capacity"], p["out_capacity"]
+        R0 = p["out_rows"]
+        fns = {}
+
+        def apply_at(scale):
+            if scale not in fns:
+                fns[scale] = jax.jit(
+                    lambda b, sc=scale: kernels.group_regroup_apply(
+                        b, keys, p["fn"], G0 * sc, C0 * sc, R0, O0 * sc))
+            return fns[scale]
+
+        def bucket_fn(b):
+            scale = 1
+            for _ in range(6):
+                out, ng, ms, tot = apply_at(scale)(b)
+                need = max(int(ng) // max(G0, 1), int(ms) // max(C0, 1),
+                           int(tot) // max(O0, 1)) + 1
+                if (int(ng) <= G0 * scale and int(ms) <= C0 * scale
+                        and int(tot) <= O0 * scale):
+                    return out
+                scale = max(scale * 2, need)
+            raise StreamExecutionError(
+                f"group_apply bucket still overflowing at scale {scale}")
+
+        probe = _batch_to_chunk(apply_at(1)(_chunk_to_batch(
+            HChunk.empty_like(cs.schema), 1))[0])
+        schema = chunk_schema(probe)
+
+        def it_apply():
+            return ooc.streaming_group_whole(
+                cs, keys, bucket_fn, schema,
+                n_buckets=config.ooc_hash_buckets,
+                depth=config.ooc_inflight,
+                max_bucket_rows=config.ooc_group_bucket_rows,
+                what="group_apply")
+
+        return ChunkSource(it_apply, schema, cs.chunk_rows)
     if k == "distinct":
         keys = tuple(p["keys"])
 
@@ -611,6 +669,45 @@ def _concat_sources(a: ChunkSource, b: ChunkSource) -> ChunkSource:
     return ChunkSource(it, a.schema, max(a.chunk_rows, b.chunk_rows))
 
 
+def _zip_sources(a: ChunkSource, b: ChunkSource,
+                 suffix: str = "_r") -> ChunkSource:
+    """Positional zip of two chunk streams via aligned dual cursors:
+    fragments are sliced to common boundaries so each emitted chunk pairs
+    row i of one side with row i of the other; the stream ends with the
+    shorter side (LINQ Zip semantics, kernels.zip2 parity)."""
+    names = set(a.schema)
+    schema = dict(a.schema)
+    for k_, spec in b.schema.items():
+        schema[k_ if k_ not in names else k_ + suffix] = dict(spec)
+
+    def it():
+        ita, itb = iter(a), iter(b)
+        fa = fb = None
+
+        def pull(it_):
+            for c in it_:
+                if c.n:
+                    return c
+            return None
+
+        while True:
+            fa = fa or pull(ita)
+            fb = fb or pull(itb)
+            if fa is None or fb is None:
+                return   # shorter side ends the stream
+            n = min(fa.n, fb.n)
+            left = _slice_hchunk(fa, 0, n)
+            right = _slice_hchunk(fb, 0, n)
+            cols = dict(left.cols)
+            for k_, v in right.cols.items():
+                cols[k_ if k_ not in names else k_ + suffix] = v
+            yield HChunk(cols, n)
+            fa = _slice_hchunk(fa, n, fa.n) if fa.n > n else None
+            fb = _slice_hchunk(fb, n, fb.n) if fb.n > n else None
+
+    return ChunkSource(it, schema, max(a.chunk_rows, b.chunk_rows))
+
+
 def _spill_stage(cs: ChunkSource, job_root: str, label: str) -> ChunkSource:
     """Materialize a multi-consumer stage once (Tee; the reference's
     materialized channel files, DrTeeVertex role).  Lives under the job's
@@ -711,6 +808,9 @@ def run_stream_graph(graph: StageGraph, config,
                                     right_chunk=right_h, body_op=op)
             elif op.kind == "concat":
                 cur = _concat_sources(cur, rest.pop(0))
+            elif op.kind == "zip":
+                cur = _zip_sources(cur, rest.pop(0),
+                                   op.params.get("suffix", "_r"))
             elif op.kind in _STREAM_KINDS:
                 cur = _stream_global(cur, op, config, sort_spill)
             elif op.kind in _LOCAL_KINDS:

@@ -51,11 +51,13 @@ _WAVE_FUSABLE = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
                  "apply", "recap", "group", "dgroup_partial",
                  "dgroup_local", "distinct"}
 
-_UNSUPPORTED = {
-    "group_apply": "group_apply needs whole groups materialized",
-    "group_rank": "group_median/rank needs whole groups materialized",
-    "zip": "zip_with needs global row alignment across streams",
-}
+# whole-group kinds (group_apply/group_rank) stream through
+# exec/ooc.streaming_group_whole — post-exchange bucket streams are
+# key-aligned, so each device materializes complete groups; zip pairs
+# per-device streams positionally (the in-memory executor's
+# per-partition zip semantics).  Nothing is unsupported here anymore
+# (channelinterface.h:212 — reference channels stream EVERY operator).
+_UNSUPPORTED: Dict[str, str] = {}
 
 
 class _StreamSpec:
@@ -459,6 +461,9 @@ def _run_body(legs_out: List[_DevStreams], body: List[StageOp], config,
                     right_chunk=right_h, body_op=op)
             elif op.kind == "concat":
                 cur = stream_exec._concat_sources(cur, rest.pop(0))
+            elif op.kind == "zip":
+                cur = stream_exec._zip_sources(
+                    cur, rest.pop(0), op.params.get("suffix", "_r"))
             elif op.kind in _UNSUPPORTED:
                 raise StreamPlanError(
                     f"op {op.kind!r} is not supported over cluster "

@@ -138,6 +138,21 @@ class JobConfig:
     # per vertex — the GM does not chat mid-vertex (dvertexcommand.h:199).
     deferred_needs: bool = True
 
+    # whole-group streamed operators (group_apply / group_median over
+    # chunk streams, exec/ooc.streaming_group_whole): max raw rows one
+    # key bucket may materialize on device — whole groups do not
+    # compose, so this bound is the honest memory contract
+    ooc_group_bucket_rows: int = 1 << 21
+
+    # cluster streamed generator sources (Dataset.from_stream /
+    # read_text_stream on a cluster Context): the driver SPOOLS the
+    # stream into a store at this directory — which must be reachable by
+    # the workers (shared filesystem or s3://) — then the gang streams
+    # the store (FromEnumerable parity: the client writes the enumerable
+    # into cluster storage, DryadLinqContext.cs:1210).  None = a driver
+    # temp dir (valid for single-machine clusters).
+    cluster_stream_spool_dir: str | None = None
+
     # -- task farm / speculation (runtime/farm.py) -------------------------
     # EnableSpeculativeDuplication + DrStageStatistics caps
     speculation_enabled: bool = True
@@ -161,6 +176,8 @@ class JobConfig:
 
     def __post_init__(self):
         checks = [
+            (self.ooc_group_bucket_rows > 0,
+             "ooc_group_bucket_rows > 0"),
             (self.max_capacity_retries >= 0, "max_capacity_retries >= 0"),
             (self.initial_send_slack >= 1, "initial_send_slack >= 1"),
             (self.range_samples_per_partition >= 2,

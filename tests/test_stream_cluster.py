@@ -261,3 +261,32 @@ def test_cluster_stream_worker_death_replays(store, data, tmp_path):
                                       np.sort(data["v"]))
     finally:
         cl.shutdown()
+
+
+def test_cluster_from_stream_spool_and_whole_group(cluster, tmp_path):
+    """from_stream on a CLUSTER Context (VERDICT r4 next-4): the driver
+    spools the generator into a worker-reachable store (FromEnumerable
+    parity) and the gang streams it through the planned surface —
+    including the whole-group group_median, which materializes complete
+    key buckets per device post-exchange."""
+    rng = np.random.RandomState(9)
+    n, chunk = 4000, CHUNK
+    k = rng.randint(0, 20, n).astype(np.int32)
+    v = rng.randint(0, 1000, n).astype(np.int32)
+
+    def gen(i):
+        lo, hi = i * chunk, min((i + 1) * chunk, n)
+        return {"k": k[lo:hi], "v": v[lo:hi]}
+
+    from dryad_tpu.exec.ooc import ChunkSource
+    cfg = JobConfig(ooc_chunk_rows=chunk,
+                    cluster_stream_spool_dir=str(tmp_path))
+    ctx = Context(cluster=cluster, config=cfg)
+    cs = ChunkSource.from_generator(gen, -(-n // chunk), chunk)
+    got = ctx.from_stream(cs).group_median(["k"], "v", out="med").collect()
+    med = dict(zip(got["k"].tolist(), got["med"].tolist()))
+
+    ref = Context().from_columns({"k": k, "v": v}) \
+        .group_median(["k"], "v", out="med").collect()
+    want = dict(zip(ref["k"].tolist(), ref["med"].tolist()))
+    assert med == want and len(med) == 20

@@ -343,3 +343,86 @@ def test_stream_sort_incore_tier_matches(store, data, tmp_path):
         outs.append(np.asarray(back["v"]))
     np.testing.assert_array_equal(outs[0], outs[1])
     np.testing.assert_array_equal(outs[1], np.sort(data["v"]))
+
+
+def test_streamed_group_median_and_apply():
+    """Whole-group ops over streams (VERDICT r4 next-4): group_median and
+    group_apply materialize complete key buckets
+    (ooc.streaming_group_whole) and match the in-memory path."""
+    import numpy as np
+
+    from dryad_tpu import Context
+    from dryad_tpu.exec.ooc import ChunkSource
+
+    rng = np.random.RandomState(3)
+    n, chunk = 30_000, 4096
+    k = rng.randint(0, 50, n).astype(np.int32)
+    v = rng.randint(0, 10_000, n).astype(np.int32)
+
+    def gen(i):
+        lo, hi = i * chunk, min((i + 1) * chunk, n)
+        return {"k": k[lo:hi], "v": v[lo:hi]}
+
+    ctx = Context()
+    cs = ChunkSource.from_generator(gen, -(-n // chunk), chunk)
+    got = (ctx.from_stream(cs)
+           .group_median(["k"], "v", out="med").collect())
+    med = dict(zip(got["k"].tolist(), got["med"].tolist()))
+
+    ref = ctx.from_columns({"k": k, "v": v}) \
+        .group_median(["k"], "v", out="med").collect()
+    want = dict(zip(ref["k"].tolist(), ref["med"].tolist()))
+    assert med == want and len(med) == 50
+
+    # group_apply: emit each group's (count, sum) via the general
+    # regroup selector — streamed == in-memory
+    import jax.numpy as jnp
+
+    def sel(cols, count):
+        m = jnp.arange(cols["v"].shape[0]) < count
+        s = jnp.where(m, cols["v"], 0).sum()
+        out = {"cnt": count[None].astype(jnp.int32),
+               "sv": s[None].astype(jnp.int32)}
+        return out, jnp.ones((1,), bool)
+
+    cs2 = ChunkSource.from_generator(gen, -(-n // chunk), chunk)
+    g1 = (ctx.from_stream(cs2)
+          .group_apply(["k"], sel, max_groups=64, group_capacity=1024,
+                       out_rows=1, out_capacity=64).collect())
+    g2 = (ctx.from_columns({"k": k, "v": v})
+          .group_apply(["k"], sel, max_groups=64, group_capacity=1024,
+                       out_rows=1, out_capacity=64).collect())
+    assert (sorted(zip(g1["k"].tolist(), g1["cnt"].tolist(),
+                       g1["sv"].tolist()))
+            == sorted(zip(g2["k"].tolist(), g2["cnt"].tolist(),
+                          g2["sv"].tolist())))
+
+
+def test_streamed_zip():
+    """zip_with over two chunk streams: aligned dual cursors, shorter
+    side ends the stream; chunk boundaries of the two sides differ."""
+    import numpy as np
+
+    from dryad_tpu import Context
+    from dryad_tpu.exec.ooc import ChunkSource
+
+    na, nb = 10_000, 8_000
+    a = np.arange(na, dtype=np.int32)
+    b = (np.arange(nb, dtype=np.int32) * 7).astype(np.int32)
+
+    def gena(i):
+        lo, hi = i * 1024, min((i + 1) * 1024, na)
+        return {"x": a[lo:hi]}
+
+    def genb(i):
+        lo, hi = i * 640, min((i + 1) * 640, nb)
+        return {"x": b[lo:hi]}
+
+    ctx = Context()
+    da = ctx.from_stream(ChunkSource.from_generator(gena, -(-na // 1024),
+                                                    1024))
+    db = ctx.from_stream(ChunkSource.from_generator(genb, -(-nb // 640),
+                                                    640))
+    out = da.zip_with(db).collect()
+    np.testing.assert_array_equal(out["x"], a[:nb])
+    np.testing.assert_array_equal(out["x_r"], b)
