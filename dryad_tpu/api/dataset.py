@@ -285,7 +285,8 @@ class Context:
         gang executes it as chunk waves + per-device bucket streams
         (runtime/stream_plan.py) — the full operator surface, not a
         restricted mini-API (VERDICT r3 item 3)."""
-        cr = chunk_rows or self.config.ooc_chunk_rows
+        cr = chunk_rows or self._auto_chunk_rows(path) \
+            or self.config.ooc_chunk_rows
         if self.cluster is not None:
             from dryad_tpu.runtime.sources import DeferredSource
             spec = {"kind": "store_stream", "path": path,
@@ -296,6 +297,35 @@ class Context:
         from dryad_tpu.exec.ooc import ChunkSource
         cs = ChunkSource.from_store(path, cr)
         return self.from_stream(cs)
+
+    def _auto_chunk_rows(self, store_path: str) -> int | None:
+        """Measured chunk sizing (JobConfig.ooc_chunk_autotune): row
+        width from the store's schema, link rate + dispatch floor from a
+        one-time probe (exec/autotune)."""
+        if not getattr(self.config, "ooc_chunk_autotune", False):
+            return None
+        try:
+            from dryad_tpu.exec.autotune import pick_chunk_rows
+            from dryad_tpu.io.store import store_meta
+            meta = store_meta(store_path)
+            row_bytes = 0
+            lanes = 0
+            for spec in meta["schema"].values():
+                if spec["kind"] == "str":
+                    row_bytes += int(spec["max_len"]) + 4
+                    lanes += -(-int(spec["max_len"]) // 4) + 1
+                else:
+                    import numpy as np
+                    w = int(np.dtype(spec["dtype"]).itemsize)
+                    n_el = 1
+                    for d in spec.get("shape", ()):
+                        n_el *= int(d)
+                    row_bytes += w * n_el
+                    lanes += max(1, w // 4) * n_el
+            return pick_chunk_rows(row_bytes, self.config,
+                                   row_lanes=lanes)
+        except Exception:
+            return None   # sizing is a heuristic; never fail the query
 
     def read_text_stream(self, path, column: str = "line",
                          chunk_rows: int | None = None,

@@ -144,6 +144,13 @@ class JobConfig:
     # compose, so this bound is the honest memory contract
     ooc_group_bucket_rows: int = 1 << 21
 
+    # pick ooc chunk sizes from MEASURED link + dispatch rates instead of
+    # the static ooc_chunk_rows (exec/autotune.pick_chunk_rows): on a
+    # high-latency tunnel the tuner grows chunks until the per-dispatch
+    # floor is amortized; on healthy hardware the lower clamp applies.
+    # Opt-in: explicit chunk_rows arguments always win.
+    ooc_chunk_autotune: bool = False
+
     # cluster streamed generator sources (Dataset.from_stream /
     # read_text_stream on a cluster Context): the driver SPOOLS the
     # stream into a store at this directory — which must be reachable by

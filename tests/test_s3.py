@@ -390,3 +390,72 @@ def test_s3_store_overwrite_is_atomic_at_meta(s3env, tmp_path):
     g3 = s3_store_meta(url)["generation"]
     assert g3 in gens and new_meta["generation"] in gens
     assert old_meta["generation"] not in gens
+
+
+def test_s3_streamed_terasort_composition(s3env, tmp_path):
+    """The >HBM x remote-store composition (VERDICT r4 next-5): stream a
+    TeraSort from s3:// through the OOC chunk path (forced out-of-core)
+    and land the sorted store locally — sortedness and row conservation
+    verified."""
+    from dryad_tpu import Context
+    from dryad_tpu.apps import terasort
+    from dryad_tpu.io.store import store_meta, read_store
+    from dryad_tpu.utils.config import JobConfig
+
+    n, chunk = 4000, 512
+    recs = terasort.gen_records(n, seed=5)
+    Context().from_columns(recs, str_max_len=10).to_store("s3://bkt/tera")
+
+    sctx = Context(config=JobConfig(ooc_chunk_rows=chunk,
+                                    ooc_incore_bytes=0, ooc_inflight=2))
+    out = str(tmp_path / "sorted")
+    (sctx.read_store_stream("s3://bkt/tera", chunk_rows=chunk)
+     .order_by([("key", False)]).to_store(out))
+    meta = store_meta(out)
+    assert sum(meta["counts"]) == n
+    pd = read_store(out, sctx.mesh)
+    from dryad_tpu.data.columnar import StringColumn
+    kc = pd.batch.columns["key"]
+    keys = []
+    for p in range(pd.nparts):
+        cnt = int(np.asarray(pd.counts)[p])
+        d = np.asarray(kc.data[p, :cnt])
+        ln = np.asarray(kc.lengths[p, :cnt])
+        keys.extend(bytes(d[i, :ln[i]]) for i in range(cnt))
+    assert keys == sorted(bytes(k) for k in recs["key"])
+
+
+def test_s3_streamed_cluster_terasort(s3env, tmp_path):
+    """Streamed TeraSort FROM s3 over the real 2-process worker gang:
+    every worker pulls its own s3 chunk waves (the block-streamed cloud
+    read role, channelbufferhdfs.cpp:69-97)."""
+    import os as _os
+
+    from dryad_tpu import Context
+    from dryad_tpu.apps import terasort
+    from dryad_tpu.io.store import store_meta
+    from dryad_tpu.runtime import LocalCluster
+    from dryad_tpu.utils.config import JobConfig
+
+    n, chunk = 3000, 256
+    recs = terasort.gen_records(n, seed=6)
+    # 4 partitions so both workers' devices own store partitions
+    Context().from_columns(recs, str_max_len=10) \
+        .hash_partition(["key"]).to_store("s3://bkt/ctera")
+
+    # workers inherit the driver's env (incl. the fake-server endpoint
+    # the s3env fixture just set) at spawn
+    _os.environ["PYTHONPATH"] = (_os.path.dirname(__file__)
+                                 + _os.pathsep
+                                 + _os.environ.get("PYTHONPATH", ""))
+    cl = LocalCluster(n_processes=2, devices_per_process=2)
+    try:
+        cfg = JobConfig(ooc_chunk_rows=chunk, ooc_incore_bytes=0)
+        ctx = Context(cluster=cl, config=cfg)
+        out = str(tmp_path / "csorted")
+        (ctx.read_store_stream("s3://bkt/ctera", chunk_rows=chunk)
+         .order_by([("key", False)]).to_store(out))
+        meta = store_meta(out)
+        assert sum(meta["counts"]) == n
+    finally:
+        cl.shutdown()

@@ -293,14 +293,19 @@ def test_stream_sliding_window(store, data, dbg):
     assert len(empty["v"]) == 0
 
 
-def test_stream_unsupported_ops_fail_clearly(store):
-    from dryad_tpu.exec.stream_exec import StreamExecutionError
-    ctx = _sctx()
+def test_stream_whole_group_bucket_bound_fails_clearly(store):
+    """The whole-group streamed ops have ONE hard contract: a key
+    bucket's raw rows must fit ooc_group_bucket_rows (whole groups
+    cannot be compacted).  Exceeding it raises with the knob named."""
+    from dryad_tpu.exec.ooc import OOCError
+    from dryad_tpu.utils.config import JobConfig
+
+    ctx = Context(config=JobConfig(ooc_chunk_rows=CHUNK,
+                                   ooc_incore_bytes=0,
+                                   ooc_group_bucket_rows=8,
+                                   ooc_hash_buckets=2))
     ds = ctx.read_store_stream(store, chunk_rows=CHUNK)
-    with pytest.raises(StreamExecutionError, match="zip"):
-        other = ctx.from_columns({"x": np.arange(5, dtype=np.int32)})
-        ds.zip_with(other).collect()
-    with pytest.raises(StreamExecutionError, match="group_median"):
+    with pytest.raises(OOCError, match="ooc_group_bucket_rows"):
         ds.group_median(["k"], "v").collect()
 
 
