@@ -74,6 +74,7 @@ class Context:
             self.nparts = cluster.nparts
             self.hosts = (cluster.n_processes
                           if cluster.n_processes > 1 else 1)
+            self.levels = (("dp", "dcn") if self.hosts > 1 else ())
             self.executor = None
             self._event_log = event_log
             self._token_seq = 0
@@ -85,9 +86,13 @@ class Context:
             return
         self.mesh = mesh if mesh is not None else make_mesh()
         self.nparts = self.mesh.devices.size
-        # 2-D (dcn, dp) meshes trigger hierarchical aggregation plans
+        # multi-level meshes trigger hierarchical aggregation plans; the
+        # planner's level chain is the mesh's axes innermost-first
+        # (2-D: dp -> dcn; 3-D: dp -> host -> dcn)
         self.hosts = (self.mesh.devices.shape[0]
-                      if len(self.mesh.axis_names) == 2 else 1)
+                      if len(self.mesh.axis_names) >= 2 else 1)
+        self.levels = (tuple(reversed(self.mesh.axis_names))
+                       if len(self.mesh.axis_names) >= 2 else ())
         self.executor = Executor(self.mesh, event_log=event_log,
                                  config=self.config)
 
@@ -106,6 +111,7 @@ class Context:
         from dryad_tpu.runtime import ClusterJobError, WorkerFailure
         from dryad_tpu.runtime.shiplan import serialize_for_cluster
         graph = plan_query(node, self.nparts, hosts=self.hosts,
+                           levels=self.levels,
                            config=self.config)
         plan_json, specs = serialize_for_cluster(graph, self.fn_table)
         # route worker events to THIS context's logger for the duration of
@@ -479,7 +485,8 @@ class Context:
         ph = E.Placeholder(parents=(), name="__loop", _npartitions=self.nparts,
                            capacity=cur.capacity)
         body_ds = body(Dataset(self, ph))
-        graph = plan_query(body_ds.node, self.nparts, hosts=self.hosts)
+        graph = plan_query(body_ds.node, self.nparts,
+                           hosts=self.hosts, levels=self.levels)
         for _ in range(n_iters):
             nxt = self.executor.run(graph, bindings={"__loop": cur})
             if nxt.capacity != cur.capacity:
@@ -861,7 +868,9 @@ class Dataset:
 
     def _materialize(self) -> PData:
         graph = plan_query(self.node, self.ctx.nparts,
-                           hosts=self.ctx.hosts, config=self.ctx.config)
+                           hosts=self.ctx.hosts,
+                           levels=self.ctx.levels,
+                           config=self.ctx.config)
         pd = self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
         # runtime hot-key salting changes the OUTPUT PLACEMENT: any
         # partitioning claim persisted from this materialization
@@ -1018,4 +1027,5 @@ class Dataset:
     def explain(self) -> str:
         return plan_query(self.node, self.ctx.nparts,
                           hosts=self.ctx.hosts,
+                          levels=self.ctx.levels,
                           config=self.ctx.config).explain()

@@ -22,19 +22,35 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 PARTITION_AXIS = "dp"
+MID_AXIS = "host"
 HOST_AXIS = "dcn"
 
-__all__ = ["PARTITION_AXIS", "HOST_AXIS", "make_mesh", "mesh_axes",
-           "partition_spec", "batch_sharding", "axis_sizes"]
+__all__ = ["PARTITION_AXIS", "MID_AXIS", "HOST_AXIS", "make_mesh",
+           "mesh_axes", "partition_spec", "batch_sharding", "axis_sizes"]
 
 
 def make_mesh(devices=None, n: int | None = None,
-              hosts: int | None = None) -> Mesh:
-    """Partition mesh over the given (or all) devices.  With ``hosts`` > 1,
-    a 2-D (dcn, dp) mesh: dp within a host/slice, dcn across."""
+              hosts: int | None = None,
+              pods: int | None = None) -> Mesh:
+    """Partition mesh over the given (or all) devices.
+
+    ``hosts`` > 1: 2-D (dcn, dp) — dp within a host/slice (ICI), dcn
+    across.  ``pods`` > 1 too: 3-D (dcn, host, dp) — the three-level
+    topology of the reference's aggregation trees (machine -> pod ->
+    overall, DrDynamicAggregateManager.h:99): dp rides ICI inside a
+    host, host crosses hosts within a pod, dcn crosses pods."""
     devs = list(devices) if devices is not None else jax.devices()
     if n is not None:
         devs = devs[:n]
+    if pods and pods > 1:
+        if not hosts or hosts < 1:
+            raise ValueError("pods > 1 needs hosts (hosts per pod)")
+        if len(devs) % (pods * hosts):
+            raise ValueError(f"{len(devs)} devices not divisible by "
+                             f"{pods} pods x {hosts} hosts")
+        arr = np.asarray(devs).reshape(pods, hosts,
+                                       len(devs) // (pods * hosts))
+        return Mesh(arr, (HOST_AXIS, MID_AXIS, PARTITION_AXIS))
     if hosts and hosts > 1:
         if len(devs) % hosts:
             raise ValueError(f"{len(devs)} devices not divisible by "

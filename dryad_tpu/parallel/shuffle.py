@@ -137,23 +137,26 @@ def exchange_by_dest(batch: Batch, dest: jax.Array, out_capacity: int,
     if len(axes) == 1:
         return _exchange_one_axis(batch, dest, axes[0], out_capacity,
                                   send_slack, axes, slot_rows=slot_rows)
-    if len(axes) != 2:
-        raise ValueError(f"unsupported mesh rank {len(axes)}")
-    host_axis, dp_axis = axes
-    D = jax.lax.axis_size(dp_axis)
-    b1 = batch.with_columns({_DEST: dest.astype(jnp.int32)})
-    # hop 1 (ICI): to the destination's dp column, within this host
-    h1, nr1, ns1, su1 = _exchange_one_axis(b1, dest % D, dp_axis,
-                                           out_capacity, send_slack, axes,
-                                           slot_rows=slot_rows)
-    # hop 2 (DCN): to the destination host
-    d2 = h1.columns[_DEST] // D
-    h2, nr2, ns2, su2 = _exchange_one_axis(h1, d2, host_axis,
-                                           out_capacity, send_slack, axes,
-                                           slot_rows=slot_rows)
-    out_cols = {k: v for k, v in h2.columns.items() if k != _DEST}
-    return (Batch(out_cols, h2.count), jnp.maximum(nr1, nr2),
-            jnp.maximum(ns1, ns2), jnp.maximum(su1, su2))
+    # N-D mesh: dimension-ordered routing, innermost axis first (the
+    # cheapest fabric carries the first hop; each later hop fixes one
+    # more coordinate of the mixed-radix destination).  2-D: the classic
+    # ICI-then-DCN two-hop; 3-D adds the pod level
+    # (DrDynamicAggregateManager.h:99 machine->pod->overall).
+    cur = batch.with_columns({_DEST: dest.astype(jnp.int32)})
+    nr = ns = su = None
+    radix = 1
+    for ax in reversed(axes):
+        sz = jax.lax.axis_size(ax)
+        coord = (cur.columns[_DEST] // radix) % sz
+        cur, nr_i, ns_i, su_i = _exchange_one_axis(
+            cur, coord, ax, out_capacity, send_slack, axes,
+            slot_rows=slot_rows)
+        nr = nr_i if nr is None else jnp.maximum(nr, nr_i)
+        ns = ns_i if ns is None else jnp.maximum(ns, ns_i)
+        su = su_i if su is None else jnp.maximum(su, su_i)
+        radix *= sz
+    out_cols = {k: v for k, v in cur.columns.items() if k != _DEST}
+    return Batch(out_cols, cur.count), nr, ns, su
 
 
 def hash_exchange(batch: Batch, keys: Sequence[str], out_capacity: int,
@@ -171,41 +174,36 @@ def hash_exchange(batch: Batch, keys: Sequence[str], out_capacity: int,
     """
     _, lo = hash_batch_keys(batch, keys)
     if axis is None:
-        if len(axes) == 1:
-            D = jax.lax.axis_size(axes[0])
-            dest = (lo % jnp.uint32(D)).astype(jnp.int32)
-        else:
-            Ddp = jax.lax.axis_size(axes[1])
-            H = jax.lax.axis_size(axes[0])
-            dd = lo % jnp.uint32(Ddp)
-            hh = (lo // jnp.uint32(Ddp)) % jnp.uint32(H)
-            dest = (hh * jnp.uint32(Ddp) + dd).astype(jnp.int32)
+        dest = _canonical_hash_dest(lo, axes)
         return exchange_by_dest(batch, dest, out_capacity, send_slack,
                                 axes, slot_rows=slot_rows)
-    if axis == PARTITION_AXIS:
-        D = jax.lax.axis_size(axis)
-        dest = (lo % jnp.uint32(D)).astype(jnp.int32)
-    elif axis == HOST_AXIS:
-        Ddp = jax.lax.axis_size(PARTITION_AXIS)
-        H = jax.lax.axis_size(axis)
-        dest = ((lo // jnp.uint32(Ddp)) % jnp.uint32(H)).astype(jnp.int32)
-    else:
+    if axis not in axes:
         raise ValueError(axis)
+    # per-axis hop of the hierarchical lowering: this axis's coordinate
+    # of the SAME mixed-radix key->place mapping the global form uses
+    # (combine innermost first — machine->pod->overall trees)
+    radix = jnp.uint32(1)
+    for a in reversed(axes):
+        if a == axis:
+            break
+        radix = radix * jnp.uint32(jax.lax.axis_size(a))
+    sz = jax.lax.axis_size(axis)
+    dest = ((lo // radix) % jnp.uint32(sz)).astype(jnp.int32)
     return _exchange_one_axis(batch, dest, axis, out_capacity, send_slack,
                               axes, slot_rows=slot_rows)
 
 
 def _canonical_hash_dest(lo: jax.Array, axes: tuple) -> jax.Array:
-    """Global destination partition of a key's lo-hash — the SAME mapping
-    hash_exchange uses (1-D: lo % D; 2-D: the (dcn, dp) split)."""
-    if len(axes) == 1:
-        D = jax.lax.axis_size(axes[0])
-        return (lo % jnp.uint32(D)).astype(jnp.int32)
-    Ddp = jax.lax.axis_size(axes[1])
-    H = jax.lax.axis_size(axes[0])
-    dd = lo % jnp.uint32(Ddp)
-    hh = (lo // jnp.uint32(Ddp)) % jnp.uint32(H)
-    return (hh * jnp.uint32(Ddp) + dd).astype(jnp.int32)
+    """Global destination partition of a key's lo-hash — the SAME
+    mixed-radix mapping for every mesh rank: coordinate on each axis =
+    (lo // inner_radix) % axis_size, innermost axis least significant."""
+    radix = jnp.uint32(1)
+    dest = jnp.zeros(lo.shape, jnp.uint32)
+    for a in reversed(axes):
+        sz = jnp.uint32(jax.lax.axis_size(a))
+        dest = dest + ((lo // radix) % sz) * radix
+        radix = radix * sz
+    return dest.astype(jnp.int32)
 
 
 def _total_parts(axes: tuple) -> int:

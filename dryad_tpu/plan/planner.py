@@ -125,9 +125,16 @@ def _has_user_decs(aggs: Dict[str, Any]) -> bool:
 
 
 class Planner:
-    def __init__(self, npartitions: int, hosts: int = 1, config=None):
+    def __init__(self, npartitions: int, hosts: int = 1, config=None,
+                 levels: tuple = ()):
         self.nparts = npartitions
-        self.hosts = hosts  # >1 => 2-D (dcn, dp) mesh: hierarchical aggs
+        self.hosts = hosts  # >1 => multi-level mesh: hierarchical aggs
+        # hierarchy axes INNERMOST FIRST ("dp", ["host",] "dcn") — one
+        # combine stage per level (the reference's machine->pod->overall
+        # aggregation trees, DrDynamicAggregateManager.h:99); 2-level
+        # default keeps the classic ICI-then-DCN lowering
+        self.levels = tuple(levels) or (("dp", "dcn") if hosts > 1
+                                        else ())
         self.config = config
         self.stages: List[Stage] = []
         self.frags: Dict[int, Fragment] = {}
@@ -209,22 +216,21 @@ class Planner:
             return f
         f.ops.append(StageOp("dgroup_partial", {"keys": keys, "decs": decs,
                                                 "box": box}))
-        if self.hosts > 1:
-            ex1 = Exchange("hash", keys=keys, out_capacity=f.capacity,
-                           axis="dp")
-            st1 = self._new_stage(
-                [Leg(f.src, f.ops, ex1)],
-                [StageOp("dgroup_merge", {"keys": keys, "decs": decs,
-                                          "box": box, "finalize": False})],
-                "dgroupby-ici")
-            ex2 = Exchange("hash", keys=keys, out_capacity=f.capacity,
-                           axis="dcn")
-            st2 = self._new_stage(
-                [Leg(st1.id, [], ex2)],
-                [StageOp("dgroup_merge", {"keys": keys, "decs": decs,
-                                          "box": box, "finalize": True})],
-                "dgroupby-dcn")
-            return Fragment(st2.id, [], f.capacity,
+        if self.levels:
+            src, ops = f.src, f.ops
+            st = None
+            for i, ax in enumerate(self.levels):
+                last = i == len(self.levels) - 1
+                ex = Exchange("hash", keys=keys, out_capacity=f.capacity,
+                              axis=ax)
+                st = self._new_stage(
+                    [Leg(src, ops, ex)],
+                    [StageOp("dgroup_merge",
+                             {"keys": keys, "decs": decs, "box": box,
+                              "finalize": last})],
+                    f"dgroupby-{ax}")
+                src, ops = st.id, []
+            return Fragment(st.id, [], f.capacity,
                             E.Partitioning("hash", keys))
         ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
         st = self._new_stage(
@@ -381,27 +387,28 @@ class Planner:
                 return f
             partial, final, mean_cols = _decompose_aggs(n.aggs)
             f.ops.append(StageOp("group", {"keys": keys, "aggs": partial}))
-            if self.hosts > 1:
+            if self.levels:
                 # hierarchical aggregation over mesh axes (the reference's
-                # machine->pod->overall trees, DrDynamicAggregateManager.h:99):
-                # combine within each host over ICI first, so the scarce DCN
-                # hop carries one partial per (host, key) instead of one per
-                # (device, key)
-                ex1 = Exchange("hash", keys=keys, out_capacity=f.capacity,
-                               axis="dp")
-                body: List[StageOp] = [
-                    StageOp("group", {"keys": keys, "aggs": final})]
-                st1 = self._new_stage([Leg(f.src, f.ops, ex1)], body,
-                                      "groupby-ici")
-                ex2 = Exchange("hash", keys=keys, out_capacity=f.capacity,
-                               axis="dcn")
-                body2: List[StageOp] = [
-                    StageOp("group", {"keys": keys, "aggs": final})]
-                if mean_cols:
-                    body2.append(StageOp("mean_fin", {"cols": mean_cols}))
-                st2 = self._new_stage([Leg(st1.id, [], ex2)], body2,
-                                      "groupby-dcn")
-                return Fragment(st2.id, [], f.capacity,
+                # machine->pod->overall trees,
+                # DrDynamicAggregateManager.h:99): combine innermost
+                # first, so each scarcer fabric carries one partial per
+                # (level, key) instead of one per (device, key); depth
+                # follows the mesh rank (3-level: dp -> host -> dcn)
+                src, ops = f.src, f.ops
+                st = None
+                for i, ax in enumerate(self.levels):
+                    last = i == len(self.levels) - 1
+                    ex = Exchange("hash", keys=keys,
+                                  out_capacity=f.capacity, axis=ax)
+                    body: List[StageOp] = [
+                        StageOp("group", {"keys": keys, "aggs": final})]
+                    if last and mean_cols:
+                        body.append(StageOp("mean_fin",
+                                            {"cols": mean_cols}))
+                    st = self._new_stage([Leg(src, ops, ex)], body,
+                                         f"groupby-{ax}")
+                    src, ops = st.id, []
+                return Fragment(st.id, [], f.capacity,
                                 E.Partitioning("hash", keys))
             ex = Exchange("hash", keys=keys, out_capacity=f.capacity)
             body = [StageOp("group", {"keys": keys, "aggs": final})]
@@ -627,5 +634,6 @@ class Planner:
 
 
 def plan_query(root: E.Node, npartitions: int, hosts: int = 1,
-               config=None) -> StageGraph:
-    return Planner(npartitions, hosts=hosts, config=config).plan(root)
+               config=None, levels: tuple = ()) -> StageGraph:
+    return Planner(npartitions, hosts=hosts, config=config,
+                   levels=levels).plan(root)

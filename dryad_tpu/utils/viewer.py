@@ -83,7 +83,15 @@ def _collect_stages(events) -> Dict[int, Dict[str, Any]]:
             end = float(e.get("ts", 0.0))
             s["runs"].append({"start": end - wall, "end": end,
                               "overflow": bool(e.get("overflow")),
-                              "scale": e.get("scale", 1)})
+                              "scale": e.get("scale", 1),
+                              "attempt": e.get("attempt", 0),
+                              "slack": e.get("slack"),
+                              "need_scale": e.get("need_scale", 0),
+                              "need_slack": e.get("need_slack", 0),
+                              "salted": e.get("salted", False),
+                              "deferred": bool(e.get("deferred")),
+                              "dispatches": e.get("dispatches"),
+                              "compile_s": e.get("compile_s", 0.0)})
             s["wall_s"] += wall
             s["compile_s"] += float(e.get("compile_s", 0.0))
             if e.get("rows") is not None:
@@ -142,6 +150,7 @@ def _svg_dag(stages, deps, order) -> str:
             badge = "&#9888; retried"
         label = html.escape(str(s["label"]))[:18]
         parts.append(
+            f'<a href="#stage-{sid}">'
             f'<g><rect x="{x}" y="{y}" rx="6" width="128" height="38" '
             f'fill="var(--node)"{ring}/>'
             f'<title>stage {sid} {label}: {len(s["runs"])} run(s), '
@@ -150,7 +159,7 @@ def _svg_dag(stages, deps, order) -> str:
             f'<text x="{x + 8}" y="{y + 16}" class="t1">{sid} '
             f'{label}</text>'
             f'<text x="{x + 8}" y="{y + 31}" class="t2">'
-            f'{s["wall_s"]:.2f}s {badge}</text></g>')
+            f'{s["wall_s"]:.2f}s {badge}</text></g></a>')
     parts.append("</svg>")
     return "".join(parts)
 
@@ -204,7 +213,8 @@ def _table(stages, order) -> str:
     for sid in order:
         s = stages[sid]
         rows.append(
-            f"<tr><td>{sid}</td><td>{html.escape(str(s['label']))}</td>"
+            f"<tr><td><a href='#stage-{sid}'>{sid}</a></td>"
+            f"<td>{html.escape(str(s['label']))}</td>"
             f"<td>{len(s['runs'])}</td><td>{s['retries']}</td>"
             f"<td>{s['replays']}</td><td>{s['scale']}</td>"
             f"<td>{s['slack']}</td><td>{s['rows']}</td>"
@@ -212,6 +222,60 @@ def _table(stages, order) -> str:
             f"<td>{s['compile_s']:.3f}</td>"
             f"<td>{s['wall_s']:.3f}</td></tr>")
     return f"<table>{head}{''.join(rows)}</table>"
+
+
+def _stage_details(stages, order, events) -> str:
+    """Per-stage drill-down (the JobBrowser vertex view role,
+    JobBrowser/JOM/jobinfo.cs:3539): attempt history with the capacity
+    knobs, measured needs, dispatch counts and compile/run split, plus
+    this stage's replay records — every DAG node and table row links
+    here."""
+    replays: Dict[int, List[dict]] = {}
+    for e in events:
+        if e.get("event") in ("stage_replay", "stage_restored",
+                              "stage_spilled", "settle_replay"):
+            if e.get("event") == "settle_replay":
+                for sid in e.get("stages", ()):
+                    replays.setdefault(sid, []).append(e)
+            else:
+                replays.setdefault(e.get("stage"), []).append(e)
+    blocks = []
+    for sid in order:
+        s = stages[sid]
+        rows = []
+        for r in s["runs"]:
+            flags = []
+            if r.get("deferred"):
+                flags.append("deferred")
+            if r.get("salted"):
+                flags.append("salted")
+            if r.get("overflow"):
+                flags.append("&#9888; overflow")
+            rows.append(
+                f"<tr><td>{r.get('attempt', 0)}</td>"
+                f"<td>{r.get('scale', 1)}</td>"
+                f"<td>{r.get('slack', '')}</td>"
+                f"<td>{r.get('need_scale', 0)}/"
+                f"{r.get('need_slack', 0)}</td>"
+                f"<td>{r.get('dispatches', '')}</td>"
+                f"<td>{r.get('compile_s', 0):.3f}</td>"
+                f"<td>{r['end'] - r['start']:.3f}</td>"
+                f"<td>{' '.join(flags)}</td></tr>")
+        rep = "".join(
+            f"<li>{html.escape(e.get('event', ''))} "
+            f"(failures so far: {e.get('failures', '?')})</li>"
+            for e in replays.get(sid, ()))
+        rep_html = f"<ul>{rep}</ul>" if rep else ""
+        blocks.append(
+            f'<details id="stage-{sid}" class="stage">'
+            f'<summary>stage {sid} — '
+            f'{html.escape(str(s["label"]))}: {len(s["runs"])} attempt(s),'
+            f' {s["replays"]} replay(s), {s["wall_s"]:.3f}s</summary>'
+            f'<table><tr><th>attempt</th><th>scale</th><th>slack</th>'
+            f'<th>need&nbsp;scale/slack</th><th>dispatches</th>'
+            f'<th>compile&nbsp;s</th><th>wall&nbsp;s</th><th>flags</th>'
+            f'</tr>{"".join(rows)}</table>{rep_html}</details>')
+    return ("<h2>Stage drill-down</h2>" + "".join(blocks)) if blocks         else ""
 
 
 def diagnose(events) -> List[Dict[str, Any]]:
@@ -245,7 +309,7 @@ def diagnose(events) -> List[Dict[str, Any]]:
                         "log_tails": e.get("log_tails", "")})
         elif k == "stage_replay":
             out.append({"kind": "stage replay",
-                        "workers": None,
+                        "workers": None, "stage": e.get("stage"),
                         "headline": f"stage {e.get('stage')} replayed "
                                     f"(attempt {e.get('attempt', '?')})",
                         "detail": "", "log_tails": ""})
@@ -266,10 +330,13 @@ def _diagnosis_html(events) -> str:
         if r["log_tails"]:
             body += (f"<details><summary>worker log tails</summary>"
                      f"<pre>{html.escape(r['log_tails'])}</pre></details>")
+        link = (f' <a href="#stage-{r["stage"]}">replay attempt '
+                f'&#8594; stage {r["stage"]}</a>'
+                if r.get("stage") is not None else "")
         blocks.append(
             f'<div class="diag"><b>{html.escape(r["kind"])}</b>'
             f'{html.escape(who)}<div class="hl">'
-            f'{html.escape(r["headline"])}</div>{body}</div>')
+            f'{html.escape(r["headline"])}{link}</div>{body}</div>')
     return "<h2>Diagnosis</h2>" + "".join(blocks)
 
 
@@ -350,6 +417,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}
 <h2>Gantt (time from job start)</h2>{_svg_gantt(stages, order)}
 <h2>Per-stage table</h2>{_table(stages, order)}
+{_stage_details(stages, order, events)}
 </body></html>"""
     if path:
         with open(path, "w") as f:

@@ -76,7 +76,7 @@ def test_job_report_multi_attempt_replay_stream():
     assert doc.count("<line") >= 1 + 4   # 1 DAG edge + 4+ Gantt gridlines
 
     # Gantt: one bar per stage_done (4), the overflow attempt marked
-    gantt = doc.split('aria-label="stage Gantt"')[1]
+    gantt = doc.split('aria-label="stage Gantt"')[1].split("</svg>")[0]
     assert gantt.count('class="bar"') == 4
     assert gantt.count("overflow") == 2   # tooltip note + visible note
 
@@ -175,3 +175,36 @@ def test_read_jsonl_tolerates_partial_tail(tmp_path):
         f.write('{"event": "job_failed", "err')   # mid-flush
     evs = _read_jsonl(p)
     assert len(evs) == 1 and evs[0]["stage"] == 0
+
+
+def test_stage_drilldown_links_wedge_to_replay():
+    """VERDICT r4 next-10: a failed chaos job's page names the wedged
+    worker, shows its log tail, and links the replay attempt to the
+    per-stage drill-down (attempt history incl needs/dispatches)."""
+    from dryad_tpu.utils.viewer import job_report_html
+
+    events = [
+        {"event": "stage_done", "stage": 0, "label": "groupby",
+         "attempt": 0, "scale": 1, "slack": 2, "overflow": True,
+         "need_scale": 3, "need_slack": 0, "salted": False,
+         "rows": [10, 10], "out_bytes": 100, "compile_s": 1.2,
+         "dispatches": 2, "wall_s": 0.5, "ts": 100.5},
+        {"event": "worker_wedged", "workers": [1],
+         "why": "sent no heartbeat for >6s", "what": "job 3",
+         "log_tails": "worker-1.log: stuck in collective"},
+        {"event": "stage_replay", "stage": 0, "label": "groupby",
+         "failures": 1},
+        {"event": "stage_done", "stage": 0, "label": "groupby",
+         "attempt": 1, "scale": 3, "slack": 2, "overflow": False,
+         "need_scale": 0, "need_slack": 0, "salted": False,
+         "rows": [10, 10], "out_bytes": 100, "compile_s": 0.8,
+         "dispatches": 2, "wall_s": 0.4, "ts": 108.4},
+    ]
+    doc = job_report_html(events)
+    # names the wedged worker + shows its log tail
+    assert "wedged gang member" in doc and "[1]" in doc
+    assert "stuck in collective" in doc
+    # replay attempt links into the stage drill-down anchor
+    assert 'href="#stage-0"' in doc and 'id="stage-0"' in doc
+    # drill-down carries the attempt history with measured needs
+    assert "attempt" in doc and "3/0" in doc and "overflow" in doc
