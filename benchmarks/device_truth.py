@@ -37,7 +37,7 @@ from benchmarks.micro import slope_time
 _salt = itertools.count(1)
 
 
-def sort_slope(recs: dict, k_hi: int = 16) -> Dict[str, float]:
+def sort_slope(recs: dict, k_hi: int = 64) -> Dict[str, float]:
     """TeraSort in-memory sort body (sort_by_columns on the 10-byte
     string key + i32 payload)."""
     from dryad_tpu.data.columnar import Batch, StringColumn, \
@@ -64,7 +64,7 @@ def sort_slope(recs: dict, k_hi: int = 16) -> Dict[str, float]:
             "sort_gbps_device": n * 18 * 2 / t / (1 << 30)}
 
 
-def group_slope(pairs: dict, k_hi: int = 16) -> Dict[str, float]:
+def group_slope(pairs: dict, k_hi: int = 64) -> Dict[str, float]:
     """GroupByReduce body (5 aggregates over a dense i32 key)."""
     from dryad_tpu.data.columnar import Batch
     from dryad_tpu.ops import kernels as _k

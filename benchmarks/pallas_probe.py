@@ -1,0 +1,175 @@
+"""Pallas building-block probes on the real chip.
+
+Measures the primitive costs every data-plane kernel design decision
+hangs on, with the same fetch-fenced slope methodology as micro.py
+(tunnel floor cancels).  Run:  python benchmarks/pallas_probe.py
+
+Questions answered (each maps to a shipped or REJECTED design in
+ops/pallas_kernels — the module docstring there carries the verdicts):
+  * sort_stage_ps      — XLA variadic sort cost per row per stage (the
+                         comparison-network bound all sort paths pay;
+                         measured 3.9 ps — why pallas bitonic/radix
+                         sorts were rejected)
+  * gather_ns_row      — random-gather cost (~10.7 ns/row — why every
+                         argsort+gather path loses to value-carry sorts)
+  * hist_pallas vs hist_sort — the shipped tile-histogram kernel vs
+                         XLA's bincount lowering (72x at 2M)
+  * compact_sort       — the sort-based compact's true rate (0.86 G
+                         rows/s — beat the rejected permutation-matmul
+                         pallas compaction's 0.45)
+  * cumsum_pallas vs cumsum_xla — the shipped streaming prefix-scan vs
+                         XLA's log-depth cumsum (4.5x at 512k)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks.micro import slope_time
+
+_salt = itertools.count(1)
+
+
+def _mk_u32(n, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(0, 1 << 31, n, np.int64)
+        .astype(np.uint32))
+
+
+def probe_sort_stages(n: int = 1 << 21) -> dict:
+    """ps per row per compare-exchange stage, 1-key/2-carry u32 sort."""
+    k = _mk_u32(n)
+    v1 = _mk_u32(n, 1)
+    v2 = _mk_u32(n, 2)
+    vary = jax.jit(lambda a, s: a ^ s)
+
+    def body(i, kk):
+        s = jax.lax.sort((kk, v1, v2), num_keys=1, is_stable=False)
+        return s[0] ^ kk
+
+    t = slope_time(body, lambda j: vary(k, jnp.uint32(next(_salt))),
+                   k_hi=16)
+    lg = math.ceil(math.log2(n))
+    stages = lg * (lg + 1) // 2
+    return {"sort_n": n, "sort_s": t,
+            "sort_stage_ps_row": t / n / stages * 1e12}
+
+
+def probe_gather(n: int = 1 << 21) -> dict:
+    """random jnp.take ns/row (3 carried u32 words per row)."""
+    idx = jnp.asarray(np.random.RandomState(3).permutation(n)
+                      .astype(np.int32))
+    w = jnp.stack([_mk_u32(n, 4), _mk_u32(n, 5), _mk_u32(n, 6)], axis=1)
+    vary = jax.jit(lambda a, s: (a + s) % n)
+
+    def body(i, ix):
+        g = jnp.take(w, ix, axis=0)
+        return (ix + g[:, 0].astype(jnp.int32)) % n
+
+    t = slope_time(body, lambda j: vary(idx, jnp.int32(next(_salt))),
+                   k_hi=8)
+    return {"gather_n": n, "gather_ns_row": t / n * 1e9}
+
+
+def probe_hist_sort(n: int = 1 << 21, B: int = 64) -> dict:
+    """sort-based histogram (the argsort/bincount family's cost)."""
+    bid = jnp.asarray((np.random.RandomState(7).randint(0, B, n))
+                      .astype(np.int32))
+    vary = jax.jit(lambda a, s: (a + s) % B)
+
+    def body(i, b):
+        h = jnp.bincount(b, length=B)
+        return (b + h[0]) % B
+
+    t = slope_time(body, lambda j: vary(bid, jnp.int32(next(_salt))),
+                   k_hi=16)
+    return {"hist_sort_n": n, "hist_sort_ms": t * 1e3,
+            "hist_sort_grows_s": n / t / 1e9}
+
+
+def probe_hist_pallas(n: int = 1 << 21, B: int = 64,
+                      tile: int = 16384) -> dict:
+    from dryad_tpu.ops.pallas_kernels import hist_buckets
+    bid = jnp.asarray((np.random.RandomState(7).randint(0, B, n))
+                      .astype(np.int32))
+    vary = jax.jit(lambda a, s: (a + s) % B)
+
+    def body(i, b):
+        h = hist_buckets(b, B)
+        return (b + h[0]) % B
+
+    t = slope_time(body, lambda j: vary(bid, jnp.int32(next(_salt))),
+                   k_hi=16)
+    return {"hist_pallas_n": n, "hist_pallas_ms": t * 1e3,
+            "hist_pallas_grows_s": n / t / 1e9}
+
+
+def probe_compact_sort(n: int = 1 << 21, W: int = 5) -> dict:
+    """sort-based stable compaction (current kernels.compact cost
+    shape: 1 mask lane + W carried u32 words)."""
+    keep = jnp.asarray((np.random.RandomState(9).rand(n) < 0.5))
+    lanes = [_mk_u32(n, 10 + i) for i in range(W)]
+    vary = jax.jit(lambda a, s: a ^ (s > 0))
+
+    def body(i, kp):
+        out = jax.lax.sort(
+            ((~kp).astype(jnp.uint32),) + tuple(lanes),
+            num_keys=1, is_stable=True)
+        return kp ^ (out[1] > 0)
+
+    t = slope_time(body, lambda j: vary(keep, jnp.int32(next(_salt) % 2)),
+                   k_hi=8)
+    return {"compact_sort_n": n, "compact_sort_ms": t * 1e3,
+            "compact_sort_grows_s": n / t / 1e9}
+
+
+def probe_cumsum_xla(n: int = 1 << 19) -> dict:
+    x = jnp.asarray(np.random.RandomState(5).rand(n).astype(np.float32))
+    vary = jax.jit(lambda v, s: v + s)
+
+    def body(i, v):
+        return v + jnp.cumsum(v)[-1] * 1e-9
+
+    t = slope_time(body, lambda j: vary(x, jnp.float32(next(_salt))),
+                   k_hi=64)
+    return {"cumsum_xla_n": n, "cumsum_xla_ms": t * 1e3}
+
+
+def probe_cumsum_pallas(n: int = 1 << 19) -> dict:
+    from dryad_tpu.ops.pallas_kernels import prefix_sum
+    x = jnp.asarray(np.random.RandomState(5).rand(n).astype(np.float32))
+    vary = jax.jit(lambda v, s: v + s)
+
+    def body(i, v):
+        return v + prefix_sum(v) * 1e-9
+
+    t = slope_time(body, lambda j: vary(x, jnp.float32(next(_salt))),
+                   k_hi=64)
+    return {"cumsum_pallas_n": n, "cumsum_pallas_ms": t * 1e3}
+
+
+def run_all() -> dict:
+    out = {}
+    for name, fn in [("sort", probe_sort_stages),
+                     ("gather", probe_gather),
+                     ("hist_sort", probe_hist_sort),
+                     ("hist_pallas", probe_hist_pallas),
+                     ("compact_sort", probe_compact_sort),
+                     ("cumsum_xla", probe_cumsum_xla),
+                     ("cumsum_pallas", probe_cumsum_pallas)]:
+        try:
+            out.update(fn())
+        except Exception as e:  # keep probing the rest
+            out[name + "_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_all(), indent=1))

@@ -140,6 +140,48 @@ def _unpack_columns_u32(lanes: List[jax.Array], spec: List) -> Dict[str, Any]:
 
 
 
+def _lane_differs(*lanes: jax.Array) -> jax.Array:
+    """Per-row "key differs from previous row" mask over SORTED key lanes
+    (row 0 always True) — the input _segment_flags expects.  The single
+    home of the adjacent-compare; every segment sorter and the
+    boundary-carry aggregator call it."""
+    d = None
+    for l in lanes:
+        dl = l[1:] != l[:-1]
+        d = dl if d is None else (d | dl)
+    return jnp.concatenate([jnp.ones((1,), jnp.bool_), d])
+
+
+def _sentinel_fold(hi: jax.Array, lo: jax.Array, valid: jax.Array):
+    """Fold invalid rows to the all-ones 64-bit hash sentinel so they
+    sort last without an extra invalid lane (collision budget documented
+    on _hash_sort_segments)."""
+    big = jnp.uint32(0xFFFFFFFF)
+    return jnp.where(valid, hi, big), jnp.where(valid, lo, big)
+
+
+def _dense_key_lane(kcol) -> jax.Array:
+    """Order lane of a dense-fast GROUPING key.  Grouping equality
+    canonicalizes signed zero (-0.0 == +0.0, matching hashing._hash_dense
+    and the shuffle partitioner); the order-transform lane would
+    otherwise split them.  Shared by both group_aggregate lowerings."""
+    if jnp.issubdtype(kcol.dtype, jnp.floating):
+        kcol = jnp.where(kcol == 0, jnp.zeros((), kcol.dtype), kcol)
+    return _dense_sort_lanes(kcol, False)[0]
+
+
+def _dense_fast_key(batch: Batch, key_names: Sequence[str]) -> bool:
+    """Single <=32-bit 1-D dense key: group by its EXACT order lane (no
+    hashing, rebuilt from the sorted lane) — shared predicate of the
+    grouping kernels."""
+    if len(key_names) != 1:
+        return False
+    kcol0 = batch.columns[key_names[0]]
+    return (_lanes_reconstructible(kcol0)
+            and not isinstance(kcol0, StringColumn)
+            and len(_dense_sort_lanes(kcol0, False)) == 1)
+
+
 def _segment_flags(differs: jax.Array, n_valid):
     """Shared boundary derivation for the segment sorters: given the
     per-row "key differs from previous row" mask over SORTED rows (row 0
@@ -171,15 +213,11 @@ def _sort_segments_carry(hi: jax.Array, lo: jax.Array, valid: jax.Array,
     sort costs ~2x the unstable one, measured) — safe only when nothing
     downstream observes the order of rows WITHIN a hash segment."""
     cap = hi.shape[0]
-    big = jnp.uint32(0xFFFFFFFF)
-    lo_s = jnp.where(valid, lo, big)
-    hi_s = jnp.where(valid, hi, big)
+    hi_s, lo_s = _sentinel_fold(hi, lo, valid)
     (shi, slo), sorted_vals = _sort_carrying([hi_s, lo_s], value_lanes,
                                              cap, stable=stable)
-    differs = jnp.concatenate([
-        jnp.ones((1,), jnp.bool_),
-        (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])])
-    is_start, is_end, num_groups = _segment_flags(differs, n_valid)
+    is_start, is_end, num_groups = _segment_flags(
+        _lane_differs(shi, slo), n_valid)
     return sorted_vals, is_start, is_end, num_groups
 
 
@@ -198,9 +236,8 @@ def _sort_segments_dense(key_lane: jax.Array, valid: jax.Array, n_valid,
     inv = (~valid).astype(jnp.uint32)
     (sinv, skey), sorted_vals = _sort_carrying(
         [inv, key_lane], value_lanes, cap, stable=False)
-    differs = jnp.concatenate([
-        jnp.ones((1,), jnp.bool_), skey[1:] != skey[:-1]])
-    is_start, is_end, num_groups = _segment_flags(differs, n_valid)
+    is_start, is_end, num_groups = _segment_flags(
+        _lane_differs(skey), n_valid)
     return skey, sorted_vals, is_start, is_end, num_groups
 
 
@@ -498,15 +535,11 @@ def _hash_sort_segments(hi: jax.Array, lo: jax.Array, valid: jax.Array,
     collision-merge budget above.
     """
     n = hi.shape[0]
-    big = jnp.uint32(0xFFFFFFFF)
-    lo = jnp.where(valid, lo, big)
-    hi = jnp.where(valid, hi, big)
+    hi, lo = _sentinel_fold(hi, lo, valid)
     order = jnp.lexsort(tuple(extra_lanes) + (lo, hi))
     shi, slo = jnp.take(hi, order), jnp.take(lo, order)
     svalid = jnp.take(valid, order)
-    differs = jnp.concatenate([
-        jnp.ones((1,), jnp.bool_),
-        (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])])
+    differs = _lane_differs(shi, slo)
     is_start = svalid & differs
     seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
     seg = jnp.where(svalid, seg, n)
@@ -582,6 +615,42 @@ def _neutral_for(kind: str, dtype):
     raise ValueError(kind)
 
 
+def _boundary_eligible(batch: Batch, aggs) -> Tuple[bool, str | None]:
+    """Can this agg set run on the boundary-carry path?  Returns
+    (ok, the single min/max order column or None).  Requirements: sum/
+    mean/any/all columns are 1-D 4-byte dense (native prefix_sum dtypes);
+    all min/max aggregates share ONE 1-D single-lane reconstructible
+    column (it rides as a sort key; its extremes then sit at segment
+    boundaries).  Everything else falls back to the segmented-scan path."""
+    minmax: set = set()
+    for _out, (kind, vname) in aggs.items():
+        if kind == "count":
+            continue
+        col = batch.columns[vname]
+        if isinstance(col, StringColumn) or col.ndim != 1:
+            return False, None
+        if kind in ("sum", "mean"):
+            if col.dtype.itemsize != 4:
+                return False, None
+        elif kind in ("min", "max"):
+            if not _lanes_reconstructible(col) \
+                    or len(_dense_sort_lanes(col, False)) != 1:
+                return False, None
+            minmax.add(vname)
+        elif kind in ("any", "all"):
+            pass
+        else:
+            return False, None
+    if len(minmax) > 1:
+        return False, None
+    return True, (next(iter(minmax)) if minmax else None)
+
+
+def _shift_fwd(a: jax.Array, fill) -> jax.Array:
+    """[fill, a[0], ..., a[-2]] — previous-row view on dense outputs."""
+    return jnp.concatenate([jnp.full((1,), fill, a.dtype), a[:-1]])
+
+
 def group_aggregate(batch: Batch, key_names: Sequence[str],
                     aggs: Dict[str, Tuple[str, str | None]]) -> Batch:
     """GroupBy + decomposable aggregation.
@@ -595,7 +664,222 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     associative, so re-applying the same kernel after a shuffle (with sum for
     count/mean-parts) merges partial aggregates — that is how the distributed
     GroupBy works (planner splits it into local combine -> shuffle -> merge).
+
+    Lowering: the boundary-carry path (below) when the agg set allows it,
+    else the segmented-scan path (_group_aggregate_scan).
+
+    NaN note: the boundary path ranks float min/max by the total order
+    -NaN < -inf < ... < +inf < +NaN (the IEEE totalOrder the sort lanes
+    induce — and the comparer order the reference's LINQ Min/Max uses),
+    while the scan path's jnp.minimum/maximum PROPAGATE any NaN to both
+    extremes.  Groups containing NaN can therefore answer differently
+    across the two lowerings; all other inputs agree exactly.
     """
+    ok, minmax_col = _boundary_eligible(batch, aggs)
+    if ok:
+        return _group_aggregate_boundary(batch, key_names, aggs, minmax_col)
+    return _group_aggregate_scan(batch, key_names, aggs)
+
+
+def _group_aggregate_boundary(batch: Batch, key_names: Sequence[str],
+                              aggs: Dict[str, Tuple[str, str | None]],
+                              minmax_col: str | None) -> Batch:
+    """Boundary-carry group aggregation — scan-free.
+
+    The round-4 profile (scratch probes, re-runnable via
+    benchmarks/pallas_probe.py methodology) showed the segmented-scan
+    lowering spending only 0.11 ms of its 2.55 ms in the segment sort at
+    500k rows: the associative scans (0.80 ms, log-depth HBM passes) and
+    the densify sort's carried aggregate lanes dominated.  This path
+    removes the scans entirely:
+
+      * the min/max order column rides as an extra SORT KEY, so each
+        segment's min sits at its first row and its max at its last —
+        no scan, and the column is rebuilt from its own sorted lane;
+      * sums ride as ONE global prefix_sum (pallas streaming scan,
+        ops/pallas_kernels — 4.5x XLA's cumsum); per-group sums are then
+        ADJACENT DIFFERENCES of the csum lane on the DENSE output rows
+        (integer-exact; f32 inherits the global-prefix cancellation
+        bound documented on _seg_sum_sorted);
+      * counts are adjacent differences of the carried row index —
+        segments tile the valid prefix, so end_idx[g] - end_idx[g-1] is
+        exactly group g's size;
+      * group g's MIN is the order lane of the row AFTER segment g-1's
+        end — carried as a shifted lane and read off the previous dense
+        row (group 0 reads sorted row 0).
+
+    One unstable segment sort + one stable boundary densify + one
+    streamed prefix pass — nothing else touches HBM.
+    """
+    valid = batch.valid_mask()
+    cap = batch.capacity
+    n_valid = batch.count
+    idx = jnp.arange(cap, dtype=jnp.int32)
+
+    kcol0 = batch.columns[key_names[0]]
+    dense_fast = _dense_fast_key(batch, key_names)
+
+    # --- sort keys: grouping lanes (+ the min/max order lane) ----------
+    if dense_fast:
+        klane = _dense_key_lane(kcol0)
+        key_lanes = [(~valid).astype(jnp.uint32), klane]
+        n_group_lanes = 2
+    else:
+        hi, lo = hash_batch_keys(batch, key_names)
+        hi_s, lo_s = _sentinel_fold(hi, lo, valid)
+        key_lanes = [hi_s, lo_s]
+        n_group_lanes = 2
+    if minmax_col is not None:
+        key_lanes.append(_dense_sort_lanes(batch.columns[minmax_col],
+                                           False)[0])
+
+    # --- carries: native-dtype lanes for each summed column ------------
+    def _as_u32(a):
+        return jax.lax.bitcast_convert_type(a, jnp.uint32) \
+            if a.dtype != jnp.uint32 else a
+
+    sum_cols: Dict[str, jax.Array] = {}     # cumsum inputs, native dtype
+    for _out, (kind, vname) in aggs.items():
+        if kind in ("sum", "mean") and vname not in sum_cols:
+            sum_cols[vname] = batch.columns[vname]
+        elif kind in ("any", "all"):
+            ik = "#i:" + vname
+            if ik not in sum_cols:
+                sum_cols[ik] = batch.columns[vname].astype(jnp.int32)
+    # the min/max column's order lane already determines its values
+    # (bijection), so when it is ALSO summed it does not ride as a carry:
+    # the sorted column is rebuilt from the sorted key lane instead —
+    # one fewer sort operand (sort cost is linear in operands, measured)
+    rebuild_sum = (minmax_col is not None and minmax_col in sum_cols)
+    carry = [_as_u32(v) for name, v in sum_cols.items()
+             if not (rebuild_sum and name == minmax_col)]
+    if dense_fast:
+        pack_spec = None
+    else:
+        kp, pack_spec = _pack_columns_u32(
+            {k: batch.columns[k] for k in key_names})
+        carry = kp + carry
+
+    skeys, scarry = _sort_carrying(key_lanes, carry, cap, stable=False)
+    if dense_fast:
+        skey = skeys[1]
+        differs = _lane_differs(skey)
+    else:
+        differs = _lane_differs(skeys[0], skeys[1])
+    _is_start, is_end, num_groups = _segment_flags(differs, n_valid)
+    svord = skeys[n_group_lanes] if minmax_col is not None else None
+
+    # --- streamed prefix sums over the sorted value lanes ---------------
+    # f32 prefixes are COMPENSATED (hi, lo) pairs: the adjacent-difference
+    # group sums below would otherwise carry error proportional to the
+    # GLOBAL prefix magnitude — unbounded relative to a small group's own
+    # sum (pallas_kernels.prefix_sum2).  Integer prefixes are exact under
+    # modular wraparound and ride the plain scan.
+    from dryad_tpu.ops.pallas_kernels import prefix_sum, prefix_sum2
+    n_pack = 0 if dense_fast else sum(s[3] for s in pack_spec)
+    svalid = idx < n_valid
+    csums: Dict[str, Tuple[jax.Array, ...]] = {}
+    j = 0
+    for name, v in sum_cols.items():
+        if rebuild_sum and name == minmax_col:
+            sv = _dense_lanes_invert([svord], v.dtype, False)
+        else:
+            sv = scarry[n_pack + j]
+            j += 1
+            if v.dtype != jnp.uint32:
+                sv = jax.lax.bitcast_convert_type(sv, v.dtype)
+        masked = jnp.where(svalid, sv, jnp.zeros((), v.dtype))
+        if v.dtype == jnp.float32:
+            csums[name] = prefix_sum2(masked)
+        else:
+            csums[name] = (prefix_sum(masked),)
+
+    # --- densify segment-END rows to the front (group order) ------------
+    dlanes: List[jax.Array] = []
+    if dense_fast:
+        dlanes.append(skey)
+    else:
+        dlanes.extend(scarry[:n_pack])
+    if minmax_col is not None:
+        dlanes.append(svord)
+        # order-lane of the row after each end = next segment's min
+        dlanes.append(jnp.concatenate([svord[1:], svord[-1:]]))
+    cs_off: Dict[str, int] = {}
+    for name in sum_cols:
+        cs_off[name] = len(dlanes)
+        dlanes.extend(_as_u32(lane) for lane in csums[name])
+    # UNSTABLE 2-key sort: the row index is both the order tiebreak
+    # (so end-rows keep group order deterministically) and the count
+    # payload — one operand doing double duty vs a stable 1-key sort
+    # (XLA's stable sort pays for an internal iota anyway, measured)
+    dkeys, dl = _sort_carrying(
+        [(~is_end).astype(jnp.uint32), idx.astype(jnp.uint32)],
+        dlanes, cap, stable=False)
+    didx_lane = dkeys[1]
+
+    gmask = idx < num_groups
+    out_cols: Dict[str, Any] = {}
+    if dense_fast:
+        out_cols[key_names[0]] = _mask_rows(
+            _dense_lanes_invert([dl[0]], kcol0.dtype, False), gmask)
+        p = 1
+    else:
+        kcols = _unpack_columns_u32(dl[:n_pack], pack_spec)
+        for k in key_names:
+            out_cols[k] = _mask_rows(kcols[k], gmask)
+        p = n_pack
+    if minmax_col is not None:
+        mm_dtype = batch.columns[minmax_col].dtype
+        vmax = _dense_lanes_invert([dl[p]], mm_dtype, False)
+        minfeed = _shift_fwd(dl[p + 1], 0)
+        vmin = _dense_lanes_invert([minfeed], mm_dtype, False)
+        # group 0's min = the very first sorted row's order lane
+        v0 = _dense_lanes_invert([svord[0:1]], mm_dtype, False)[0]
+        vmin = jnp.where(idx == 0, v0, vmin)
+        p += 2
+    dcs: Dict[str, jax.Array] = {}
+    for name, v in sum_cols.items():
+        o = cs_off[name]
+        c = dl[o]
+        if v.dtype != jnp.uint32:
+            c = jax.lax.bitcast_convert_type(c, v.dtype)
+        if v.dtype == jnp.float32:
+            clo = jax.lax.bitcast_convert_type(dl[o + 1], jnp.float32)
+            # difference BOTH compensated lanes: error ~ ulp(group sum)
+            dcs[name] = ((c - _shift_fwd(c, 0))
+                         + (clo - _shift_fwd(clo, 0)))
+        else:
+            dcs[name] = c - _shift_fwd(c, 0)
+    didx = didx_lane.astype(jnp.int32)
+    cnt_g = didx - _shift_fwd(didx, -1)
+
+    for out_name, (kind, vname) in aggs.items():
+        if kind == "count":
+            o = cnt_g
+        elif kind == "sum":
+            o = dcs[vname]
+        elif kind == "mean":
+            s = dcs[vname]
+            c = jnp.maximum(cnt_g, 1)
+            o = s / c.astype(s.dtype) \
+                if jnp.issubdtype(s.dtype, jnp.floating) \
+                else s.astype(jnp.float32) / c
+        elif kind == "min":
+            o = vmin
+        elif kind == "max":
+            o = vmax
+        elif kind == "any":
+            o = dcs["#i:" + vname] > 0
+        elif kind == "all":
+            o = dcs["#i:" + vname] == cnt_g
+        out_cols[out_name] = _mask_rows(o, gmask)
+    return Batch(out_cols, num_groups)
+
+
+def _group_aggregate_scan(batch: Batch, key_names: Sequence[str],
+                          aggs: Dict[str, Tuple[str, str | None]]) -> Batch:
+    """Segmented-scan group aggregation — the general path (2-D value
+    columns, 8-byte sums, string or multi-column min/max)."""
     # Scatter- and gather-free lowering (TPU: scatters serialize, random
     # gathers cost ~9 ns/row): ONE variadic sort carries the agg value
     # columns as packed words alongside the grouping lanes; segmented
@@ -614,9 +898,7 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     idx = jnp.arange(cap, dtype=jnp.int32)
 
     kcol0 = batch.columns[key_names[0]]
-    dense_fast = (len(key_names) == 1 and _lanes_reconstructible(kcol0)
-                  and not isinstance(kcol0, StringColumn)
-                  and len(_dense_sort_lanes(kcol0, False)) == 1)
+    dense_fast = _dense_fast_key(batch, key_names)
 
     needed_vals = list(dict.fromkeys(
         v for _, v in aggs.values() if v and v not in
@@ -627,13 +909,7 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
         needed = list(dict.fromkeys(list(key_names) + needed_vals))
     lanes, spec = _pack_columns_u32({k: batch.columns[k] for k in needed})
     if dense_fast:
-        kc = kcol0
-        if jnp.issubdtype(kc.dtype, jnp.floating):
-            # grouping equality canonicalizes signed zero (-0.0 == +0.0,
-            # matching hashing._hash_dense and the shuffle partitioner);
-            # the order-transform lane would otherwise split them
-            kc = jnp.where(kc == 0, jnp.zeros((), kc.dtype), kc)
-        key_lane = _dense_sort_lanes(kc, False)[0]
+        key_lane = _dense_key_lane(kcol0)
         skey, slanes, is_start, is_end, num_groups = _sort_segments_dense(
             key_lane, valid, n_valid, lanes)
     else:

@@ -254,11 +254,11 @@ def _stage_details(stages, order, events) -> str:
             rows.append(
                 f"<tr><td>{r.get('attempt', 0)}</td>"
                 f"<td>{r.get('scale', 1)}</td>"
-                f"<td>{r.get('slack', '')}</td>"
-                f"<td>{r.get('need_scale', 0)}/"
-                f"{r.get('need_slack', 0)}</td>"
-                f"<td>{r.get('dispatches', '')}</td>"
-                f"<td>{r.get('compile_s', 0):.3f}</td>"
+                f"<td>{r.get('slack') if r.get('slack') is not None else ''}</td>"
+                f"<td>{r.get('need_scale') or 0}/"
+                f"{r.get('need_slack') or 0}</td>"
+                f"<td>{r.get('dispatches') if r.get('dispatches') is not None else ''}</td>"
+                f"<td>{r.get('compile_s') or 0:.3f}</td>"
                 f"<td>{r['end'] - r['start']:.3f}</td>"
                 f"<td>{' '.join(flags)}</td></tr>")
         rep = "".join(

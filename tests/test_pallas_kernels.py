@@ -1,0 +1,167 @@
+"""ops/pallas_kernels: the hand-written TPU kernels, exercised on CPU in
+interpreter mode (the REAL kernel bodies run, instruction by
+instruction) and via their XLA fallbacks.  The compiled TPU path is
+covered by the bench's device-truth rows (benchmarks/pallas_probe.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dryad_tpu.ops.pallas_kernels import (force_interpret, hist_buckets,
+                                          pallas_active, prefix_sum)
+
+
+def _modes():
+    return ["fallback", "interpret"]
+
+
+def _run(mode, fn):
+    if mode == "interpret":
+        with force_interpret():
+            assert pallas_active() == "interpret"
+            return fn()
+    assert pallas_active() in (None, "compiled")
+    return fn()
+
+
+@pytest.mark.parametrize("mode", _modes())
+def test_hist_matches_bincount(mode):
+    rng = np.random.RandomState(0)
+    bid = jnp.asarray(rng.randint(0, 37, 20_000).astype(np.int32))
+    h = np.asarray(_run(mode, lambda: hist_buckets(bid, 37)))
+    assert (h == np.bincount(np.asarray(bid), minlength=37)).all()
+
+
+@pytest.mark.parametrize("mode", _modes())
+def test_hist_ignores_out_of_range(mode):
+    """The invalid-row sentinel (== n_buckets) and negatives don't count."""
+    rng = np.random.RandomState(1)
+    bid = rng.randint(0, 8, 5_000).astype(np.int32)
+    bid[::7] = 8          # sentinel
+    bid[::11] = -3
+    h = np.asarray(_run(mode, lambda: hist_buckets(jnp.asarray(bid), 8)))
+    ref = np.bincount(bid[(bid >= 0) & (bid < 8)], minlength=8)
+    assert (h == ref).all()
+
+
+@pytest.mark.parametrize("mode", _modes())
+def test_hist_unpadded_sizes(mode):
+    """Sizes that don't divide the kernel tile exercise the pad path."""
+    for n in (1, 127, 129, 16384, 16385):
+        bid = jnp.asarray((np.arange(n) % 5).astype(np.int32))
+        h = np.asarray(_run(mode, lambda: hist_buckets(bid, 5)))
+        assert (h == np.bincount(np.arange(n) % 5, minlength=5)).all(), n
+
+
+def test_hist_wide_bucket_fallback():
+    """n_buckets beyond the VMEM accumulator budget uses bincount."""
+    bid = jnp.asarray((np.arange(4_000) % 600).astype(np.int32))
+    h = np.asarray(hist_buckets(bid, 600))
+    assert (h == np.bincount(np.arange(4_000) % 600, minlength=600)).all()
+
+
+@pytest.mark.parametrize("mode", _modes())
+@pytest.mark.parametrize("dtype", [np.int32, np.uint32, np.float32])
+def test_prefix_sum(mode, dtype):
+    rng = np.random.RandomState(2)
+    if dtype == np.float32:
+        x = rng.rand(40_000).astype(dtype)
+    else:
+        x = rng.randint(0, 100, 40_000).astype(dtype)
+    y = np.asarray(_run(mode, lambda: prefix_sum(jnp.asarray(x))))
+    ref = np.cumsum(x.astype(np.float64 if dtype == np.float32 else
+                             np.int64))
+    if dtype == np.float32:
+        assert np.abs(y - ref).max() < np.abs(ref).max() * 1e-5
+    else:
+        assert (y.astype(np.int64) == (ref & 0xFFFFFFFF if dtype ==
+                np.uint32 else ref)).all() or \
+            (y == ref.astype(dtype)).all()
+
+
+@pytest.mark.parametrize("mode", _modes())
+def test_prefix_sum_unpadded_sizes(mode):
+    for n in (1, 5, 128, 32768, 32769, 70_000):
+        x = jnp.ones((n,), jnp.int32)
+        y = np.asarray(_run(mode, lambda: prefix_sum(x)))
+        assert (y == np.arange(1, n + 1)).all(), n
+
+
+def test_boundary_group_path_used_and_matches_scan():
+    """The boundary-carry group path (which consumes prefix_sum) agrees
+    with the segmented-scan path on the full agg surface."""
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as k
+
+    rng = np.random.RandomState(3)
+    n = 4_000
+    b = Batch({"k": jnp.asarray(rng.randint(0, 97, n).astype(np.int32)),
+               "v": jnp.asarray(rng.randn(n).astype(np.float32)),
+               "w": jnp.asarray(rng.randint(-50, 50, n).astype(np.int32)),
+               "f": jnp.asarray(rng.rand(n) < 0.5)},
+              jnp.asarray(n - 7, jnp.int32))
+    aggs = {"n": ("count", None), "s": ("sum", "v"), "m": ("mean", "v"),
+            "lo": ("min", "v"), "hi": ("max", "v"), "ws": ("sum", "w"),
+            "anyf": ("any", "f"), "allf": ("all", "f")}
+    ok, mm = k._boundary_eligible(b, aggs)
+    assert ok and mm == "v"
+    got = k._group_aggregate_boundary(b, ["k"], aggs, mm)
+    ref = k._group_aggregate_scan(b, ["k"], aggs)
+    ng = int(ref.count)
+    assert int(got.count) == ng
+    go = np.argsort(np.asarray(got.columns["k"])[:ng])
+    ro = np.argsort(np.asarray(ref.columns["k"])[:ng])
+    for c in ("k", "n", "ws", "anyf", "allf"):
+        np.testing.assert_array_equal(np.asarray(got.columns[c])[:ng][go],
+                                      np.asarray(ref.columns[c])[:ng][ro])
+    for c in ("s", "m", "lo", "hi"):
+        np.testing.assert_allclose(np.asarray(got.columns[c])[:ng][go],
+                                   np.asarray(ref.columns[c])[:ng][ro],
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_boundary_group_string_keys():
+    """Hash-path boundary grouping (string keys ride as packed carries)."""
+    from dryad_tpu.data.columnar import batch_from_numpy
+    from dryad_tpu.ops import kernels as k
+
+    rng = np.random.RandomState(4)
+    words = [f"w{i:03d}" for i in range(40)]
+    keys = [words[i] for i in rng.randint(0, 40, 3_000)]
+    vals = rng.rand(3_000).astype(np.float32)
+    b = batch_from_numpy({"t": keys, "v": vals}, str_max_len=8)
+    aggs = {"n": ("count", None), "s": ("sum", "v")}
+    ok, mm = k._boundary_eligible(b, aggs)
+    assert ok and mm is None
+    out = k.group_aggregate(b, ["t"], aggs)
+    ng = int(out.count)
+    assert ng == 40
+    got = {}
+    tc = out.columns["t"]
+    for i in range(ng):
+        L = int(np.asarray(tc.lengths)[i])
+        w = bytes(np.asarray(tc.data)[i, :L]).decode()
+        got[w] = (int(np.asarray(out.columns["n"])[i]),
+                  float(np.asarray(out.columns["s"])[i]))
+    for w in words:
+        mask = np.array([kk == w for kk in keys])
+        assert got[w][0] == mask.sum()
+        np.testing.assert_allclose(got[w][1], vals[mask].sum(), rtol=1e-4)
+
+
+def test_boundary_ineligible_falls_back():
+    """2-D value columns and i64 sums stay on the scan path."""
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as k
+
+    n = 500
+    b = Batch({"k": jnp.asarray(np.arange(n) % 7, dtype=jnp.int32),
+               "x": jnp.ones((n, 3), jnp.float32)},
+              jnp.asarray(n, jnp.int32))
+    ok, _ = k._boundary_eligible(b, {"m": ("mean", "x")})
+    assert not ok
+    out = k.group_aggregate(b, ["k"], {"m": ("mean", "x")})
+    assert int(out.count) == 7
+    np.testing.assert_allclose(
+        np.asarray(out.columns["m"])[:7], np.ones((7, 3)), rtol=1e-6)
