@@ -10,16 +10,19 @@ floor and link-rate weather cancel exactly.  Rates are per-row/sec (and
 nominal bytes-touched GB/s where the r4 bench already defined one), so
 round-over-round deltas are quotable without any tunnel caveat.
 
-Roofline honesty note (measured this round, pallas probe campaign): the
-sort/group kernels are comparison networks — every element crosses
-~log^2(n)/2 compare-exchange stages at a measured ~10 ps/row/stage
-(consistent across XLA's sorter and two hand-written pallas bitonic
-kernels; the VPU is near-saturated).  With no scatter unit (TPU scatters
-serialize) and gathers limited to 128-lane groups (tpu.dynamic_gather),
-radix/bucket placement cannot beat that bound, so the "bytes-touched x 2
-vs HBM rate" roofline is the wrong model for these kernels: their true
-ceiling is stage_volume x per-stage cost, which the device rows here
-track directly.
+Roofline honesty note (measured this round; benchmarks/pallas_probe.py
+reproduces every figure): the sort/group kernels are comparison
+networks — every element crosses ~log^2(n)/2 compare-exchange stages at
+a measured ~3.9 ps/row/stage (XLA's sorter; hand-written pallas bitonic
+kernels tie — the VPU is near-saturated).  With no scatter unit (TPU
+scatters serialize), random gathers at ~10.7 ns/row, and per-DMA issue
+costs that kill fine-grained byte-pumping, radix/bucket placement cannot
+beat that bound, so the "bytes-touched x 2 vs HBM rate" roofline is the
+wrong model for these kernels: their true ceiling is stage_volume x
+per-stage cost, which the device rows here track directly.  Where the
+bound does NOT apply, pallas kernels ship and win (ops/pallas_kernels:
+72x histogram, 4.5x prefix scan; ops/text: gather-free tokenization,
+vocabulary-only byte extraction — wordcount 258 -> 52 ms).
 """
 
 from __future__ import annotations
@@ -90,13 +93,14 @@ def group_slope(pairs: dict, k_hi: int = 64) -> Dict[str, float]:
 
 
 def wordcount_slope(lines, str_max_len: int = 96,
-                    words_per_line: int = 8, k_hi: int = 8
+                    words_per_line: int = 8, k_hi: int = 16
                     ) -> Dict[str, float]:
-    """WordCount fused stage body: tokenize + group-count."""
+    """WordCount fused stage body — the op the executor actually runs
+    (flat_tokens + count-group peephole-fused into
+    ops/text.tokenize_group_count; exec/executor._fuse_stage_ops)."""
     from dryad_tpu.data.columnar import Batch, StringColumn, \
         batch_from_numpy
-    from dryad_tpu.ops import kernels as _k
-    from dryad_tpu.ops.text import lower_ascii, split_tokens
+    from dryad_tpu.ops.text import tokenize_group_count
 
     lb = batch_from_numpy({"line": list(lines)}, str_max_len=str_max_len)
     n_lines = int(np.asarray(lb.count))
@@ -110,15 +114,16 @@ def wordcount_slope(lines, str_max_len: int = 96,
         # the xor salt flips a low bit of every byte: token identities
         # change per call (defeats memoization) but lengths do not
         b = Batch({"line": StringColumn(d ^ jnp.uint8(1), lens)}, cnt)
-        toks, _of = split_tokens(b, "line", out_capacity=tok_cap)
-        toks = Batch({"line": lower_ascii(toks.columns["line"])},
-                     toks.count)
-        out = _k.group_aggregate(toks, ["line"], {"n": ("count", None)})
+        out, _need = tokenize_group_count(
+            b, "line", out_capacity=tok_cap,
+            vocab_capacity=max(1 << 16, tok_cap // 32), count_name="n",
+            lower=True, max_tokens_per_row=24)
         # fold the output into a byte salt so the carry evolves per pass
         # (blocks loop-invariant hoisting and tunnel memoization) while
         # keeping the carry d-shaped
-        fold = (out.columns["line"].lengths.sum() % 251).astype(jnp.uint8)
-        return d ^ (fold | jnp.uint8(1))
+        fold = (out.columns["line"].lengths.sum()
+                + out.columns["n"].sum()) % 251
+        return d ^ (fold.astype(jnp.uint8) | jnp.uint8(1))
 
     t = slope_time(body, lambda j: vary(data,
                                         jnp.uint8(next(_salt) % 251)),

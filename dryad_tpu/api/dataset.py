@@ -523,7 +523,8 @@ class Dataset:
     def split_words(self, column: str, out_capacity: int,
                     max_token_len: int | None = None,
                     delims: bytes | None = None,
-                    lower: bool = False) -> "Dataset":
+                    lower: bool = False,
+                    max_tokens_per_row: int | None = None) -> "Dataset":
         """Tokenizing SelectMany (the WordCount flat-map).  Token length
         and delimiter defaults come from JobConfig (token_max_len,
         token_delims + punctuation)."""
@@ -534,7 +535,8 @@ class Dataset:
             delims = cfg.token_delims
         return Dataset(self.ctx, E.FlatTokens(
             parents=(self.node,), column=column, out_capacity=out_capacity,
-            max_token_len=max_token_len, delims=delims, lower=lower))
+            max_token_len=max_token_len, delims=delims, lower=lower,
+            max_tokens_per_row=max_tokens_per_row))
 
     def apply_per_partition(self, fn, label: str = "apply",
                             preserves_partitioning: bool = False,

@@ -16,9 +16,13 @@ __all__ = ["wordcount_query", "wordcount"]
 
 def wordcount_query(ds: Dataset, column: str = "line",
                     tokens_per_partition: int = 1 << 16,
-                    max_token_len: int = 24, lower: bool = True) -> Dataset:
+                    max_token_len: int = 24, lower: bool = True,
+                    max_tokens_per_row: int | None = 24) -> Dataset:
+    # the per-row token bound shrinks the tokenizer's slot grid ~3x for
+    # prose-shaped lines; pathological rows feed the NEED retry channel
     return (ds.split_words(column, out_capacity=tokens_per_partition,
-                           max_token_len=max_token_len, lower=lower)
+                           max_token_len=max_token_len, lower=lower,
+                           max_tokens_per_row=max_tokens_per_row)
               .group_by([column], {"n": ("count", None)}))
 
 

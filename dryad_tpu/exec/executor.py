@@ -29,7 +29,8 @@ import numpy as np
 from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.exec.data import PData
 from dryad_tpu.ops import kernels
-from dryad_tpu.ops.text import lower_ascii, split_tokens
+from dryad_tpu.ops.text import (lower_ascii, split_tokens,
+                                tokenize_group_count)
 from dryad_tpu.parallel import shuffle
 from dryad_tpu.parallel.mesh import PARTITION_AXIS, partition_spec
 from dryad_tpu.plan.stages import Exchange, Stage, StageGraph, StageOp
@@ -135,13 +136,25 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
     if k == "filter":
         return kernels.compact(b, p["fn"](dict(b.columns))), no
     if k == "flat_tokens":
+        mtr = p.get("max_tokens_per_row")
         out, need_rows = split_tokens(b, p["column"],
                                       out_capacity=p["out_capacity"] * scale,
                                       max_token_len=p["max_token_len"],
-                                      delims=p["delims"])
+                                      delims=p["delims"],
+                                      max_tokens_per_row=(
+                                          mtr * scale if mtr else None))
         if p["lower"]:
             col = out.columns[p["column"]]
             out = Batch({p["column"]: lower_ascii(col)}, out.count)
+        return out, _needs(_scale_need(need_rows, p["out_capacity"]))
+    if k == "tokens_group_count":
+        mtr = p.get("max_tokens_per_row")
+        out, need_rows = tokenize_group_count(
+            b, p["column"], out_capacity=p["out_capacity"] * scale,
+            vocab_capacity=p["vocab_capacity"] * scale,
+            count_name=p["count_name"], max_token_len=p["max_token_len"],
+            delims=p["delims"], lower=p["lower"],
+            max_tokens_per_row=(mtr * scale if mtr else None))
         return out, _needs(_scale_need(need_rows, p["out_capacity"]))
     if k in ("dgroup_local", "dgroup_partial", "dgroup_merge"):
         keys = list(p["keys"])
@@ -320,6 +333,37 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
     raise ValueError(f"unknown op kind {k}")
 
 
+def _fuse_stage_ops(ops):
+    """Executor-side peephole: flat_tokens immediately followed by a
+    count-only group over the token column becomes ONE fused op — the
+    windowed byte extraction (the tokenizer's dominant cost, ~10 ns per
+    gathered word) then runs only for group representatives
+    (ops/text.tokenize_group_count).  Plans ship unfused; fusion is a
+    per-execution rewrite, so workers and driver fuse identically."""
+    out = []
+    i = 0
+    while i < len(ops):
+        op = ops[i]
+        if (op.kind == "flat_tokens" and i + 1 < len(ops)
+                and ops[i + 1].kind == "group"):
+            g = ops[i + 1]
+            aggs = dict(g.params["aggs"])
+            if (list(g.params["keys"]) == [op.params["column"]]
+                    and len(aggs) == 1
+                    and all(kind == "count" and v is None
+                            for kind, v in aggs.values())):
+                p = dict(op.params)
+                p["count_name"] = next(iter(aggs))
+                p["vocab_capacity"] = max(
+                    1 << 16, p["out_capacity"] // 32)
+                out.append(StageOp("tokens_group_count", p))
+                i += 2
+                continue
+        out.append(op)
+        i += 1
+    return out
+
+
 def _apply_exchange(b: Batch, ex: Exchange, scale: int, slack: int, bounds,
                     axes: tuple = (PARTITION_AXIS,)
                     ) -> Tuple[Batch, jax.Array]:
@@ -400,10 +444,10 @@ class Executor:
                 # right replicates its hot rows) — the runtime skew escape
                 # (DrDynamicDistributor.h:79; see shuffle.skew_join_exchange)
                 lb, rb = leg_batches
-                for op in stage.legs[0].ops:
+                for op in _fuse_stage_ops(stage.legs[0].ops):
                     lb, nd = _apply_op(lb, op, scale, [], self.axes, slack)
                     needs = jnp.maximum(needs, nd)
-                for op in stage.legs[1].ops:
+                for op in _fuse_stage_ops(stage.legs[1].ops):
                     rb, nd = _apply_op(rb, op, scale, [], self.axes, slack)
                     needs = jnp.maximum(needs, nd)
                 lex, rex = stage.legs[0].exchange, stage.legs[1].exchange
@@ -422,7 +466,7 @@ class Executor:
                 outs = [lout, rout]
             else:
                 for leg, b in zip(stage.legs, leg_batches):
-                    for op in leg.ops:
+                    for op in _fuse_stage_ops(leg.ops):
                         b, nd = _apply_op(b, op, scale, [], self.axes,
                                           slack)
                         needs = jnp.maximum(needs, nd)
@@ -434,7 +478,7 @@ class Executor:
                     outs.append(b)
             cur = outs[0]
             rest = outs[1:]
-            for op in stage.body:
+            for op in _fuse_stage_ops(stage.body):
                 if op.kind in ("join", "semi_anti", "concat", "apply2",
                                "zip"):
                     cur, nd = _apply_op(cur, op, scale, rest,

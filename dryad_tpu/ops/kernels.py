@@ -665,8 +665,10 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     count/mean-parts) merges partial aggregates — that is how the distributed
     GroupBy works (planner splits it into local combine -> shuffle -> merge).
 
-    Lowering: the boundary-carry path (below) when the agg set allows it,
-    else the segmented-scan path (_group_aggregate_scan).
+    Lowering: small-span integer keys take the one-hot MXU path (a
+    runtime span check, _group_aggregate_smallkey); then the
+    boundary-carry path when the agg set allows it; else the
+    segmented-scan path (_group_aggregate_scan).
 
     NaN note: the boundary path ranks float min/max by the total order
     -NaN < -inf < ... < +inf < +NaN (the IEEE totalOrder the sort lanes
@@ -677,8 +679,152 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     """
     ok, minmax_col = _boundary_eligible(batch, aggs)
     if ok:
-        return _group_aggregate_boundary(batch, key_names, aggs, minmax_col)
-    return _group_aggregate_scan(batch, key_names, aggs)
+        fallback = lambda b: _group_aggregate_boundary(  # noqa: E731
+            b, key_names, aggs, minmax_col)
+    else:
+        fallback = lambda b: _group_aggregate_scan(  # noqa: E731
+            b, key_names, aggs)
+    if _matmul_group_eligible(batch, key_names, aggs):
+        return _group_aggregate_smallkey(batch, key_names, aggs, fallback)
+    return fallback(batch)
+
+
+_SMALLKEY_SLOTS = 512      # one-hot width: span <= this rides the MXU
+_SMALLKEY_CHUNK = 16384    # rows per accumulation step (bounds the
+                           # materialized [chunk, slots] one-hot to 32 MB)
+
+
+def _matmul_group_eligible(batch: Batch, key_names, aggs) -> bool:
+    """Static half of the MXU group gate: single integer dense key,
+    sums/means over float columns only (f32 accumulation is exact for
+    counts below 2^24 but not for wide integers), partition small enough
+    that counts stay exact."""
+    if not _dense_fast_key(batch, key_names):
+        return False
+    kd = batch.columns[key_names[0]].dtype
+    if not jnp.issubdtype(kd, jnp.integer):
+        return False
+    if batch.capacity >= (1 << 24):
+        return False
+    for _out, (kind, vname) in aggs.items():
+        if kind == "count":
+            continue
+        if kind not in ("sum", "mean"):
+            return False
+        col = batch.columns[vname]
+        if isinstance(col, StringColumn) or \
+                not jnp.issubdtype(col.dtype, jnp.floating) or \
+                col.dtype.itemsize != 4:
+            return False
+    return True
+
+
+def _group_aggregate_smallkey(batch: Batch, key_names: Sequence[str],
+                              aggs: Dict[str, Tuple[str, str | None]],
+                              fallback) -> Batch:
+    """One-hot MXU group aggregation for small-span integer keys.
+
+    The sort-based lowerings pay ~log^2(n) compare-exchange stages per
+    row; when the key span fits ``_SMALLKEY_SLOTS``, per-group sums are
+    ONE matmul against the one-hot slot matrix — the systolic array does
+    the scatter-add the chip has no scatter unit for (k-means recenter,
+    reference role: the broadcast/aggregation ML loops of BASELINE
+    config 5).  The span is a runtime property, so the choice is a
+    lax.cond against the sort fallback: wide-key batches pay one extra
+    min/max reduction, nothing else.
+    """
+    kcol = batch.columns[key_names[0]]
+    cap = batch.capacity
+    valid = batch.valid_mask()
+    n_valid = batch.count
+    S = _SMALLKEY_SLOTS
+    kmin = jnp.min(jnp.where(valid, kcol, jnp.iinfo(kcol.dtype).max))
+    kmax = jnp.max(jnp.where(valid, kcol, jnp.iinfo(kcol.dtype).min))
+    # i32 wraparound on huge true spans lands negative -> fallback
+    span = kmax - kmin + 1
+    use = (n_valid > 0) & (kmax >= kmin) & (span > 0) & (span <= S)
+
+    def mm_branch(b: Batch) -> Batch:
+        k = b.columns[key_names[0]]
+        slot = jnp.clip((k - kmin).astype(jnp.int32), 0, S - 1)
+        slot = jnp.where(valid, slot, S)          # padding matches nothing
+        vals: Dict[str, jax.Array] = {}
+        shapes: Dict[str, Tuple] = {}
+        for _o, (kind, vname) in aggs.items():
+            if kind != "count" and vname not in vals:
+                v = b.columns[vname]
+                shapes[vname] = v.shape[1:]
+                # padding rows hold unspecified bytes (inf/NaN included);
+                # a zero one-hot row does NOT neutralize them in the
+                # contraction (0 * NaN = NaN) — zero the values themselves
+                v = _mask_rows(v, valid)
+                vals[vname] = v.reshape(cap, -1)
+        names = list(vals)
+        m_tot = sum(vals[n].shape[1] for n in names) if names else 0
+        pad = (-cap) % _SMALLKEY_CHUNK
+        nb = (cap + pad) // _SMALLKEY_CHUNK
+        slot_p = jnp.pad(slot, (0, pad), constant_values=S) \
+            .reshape(nb, _SMALLKEY_CHUNK)
+        if names:
+            vcat = jnp.concatenate([vals[n] for n in names], axis=1)
+            vcat = jnp.pad(vcat, ((0, pad), (0, 0))) \
+                .reshape(nb, _SMALLKEY_CHUNK, m_tot)
+
+        def step(acc, xs):
+            cnt_acc, sum_acc = acc
+            sl = xs[0]
+            oh = (sl[:, None] ==
+                  jnp.arange(S, dtype=jnp.int32)[None, :]) \
+                .astype(jnp.float32)                      # [chunk, S]
+            cnt_acc = cnt_acc + jnp.sum(oh, axis=0)
+            if names:
+                sum_acc = sum_acc + jax.lax.dot_general(
+                    oh, xs[1], (((0,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST)  # [S, m]
+            return (cnt_acc, sum_acc), None
+
+        init = (jnp.zeros((S,), jnp.float32),
+                jnp.zeros((S, max(m_tot, 1)), jnp.float32))
+        (cnts, sums), _ = jax.lax.scan(
+            step, init, (slot_p, vcat) if names else (slot_p,))
+        nonempty = cnts > 0
+        num_groups = nonempty.sum(dtype=jnp.int32)
+        order = jnp.argsort(~nonempty, stable=True)       # [S], tiny
+        rank = jnp.arange(S, dtype=jnp.int32)
+        gvalid_s = rank < num_groups
+
+        def place(a_s):
+            """[S, ...] slot-ordered -> [cap, ...] group-compacted."""
+            g = jnp.take(a_s, order, axis=0)
+            g = _mask_rows(g, gvalid_s)
+            if cap >= S:
+                padw = ((0, cap - S),) + ((0, 0),) * (g.ndim - 1)
+                return jnp.pad(g, padw)
+            return g[:cap]
+
+        out_cols: Dict[str, Any] = {}
+        out_cols[key_names[0]] = place(
+            (kmin + rank).astype(kcol.dtype))
+        cnt_g = place(cnts).astype(jnp.int32)
+        off = 0
+        col_sums: Dict[str, jax.Array] = {}
+        for n in names:
+            m = vals[n].shape[1]
+            col_sums[n] = place(sums[:, off:off + m]) \
+                .reshape((cap,) + shapes[n])
+            off += m
+        for out_name, (kind, vname) in aggs.items():
+            if kind == "count":
+                out_cols[out_name] = cnt_g
+            elif kind == "sum":
+                out_cols[out_name] = col_sums[vname]
+            else:  # mean
+                c = jnp.maximum(cnt_g, 1).reshape(
+                    (cap,) + (1,) * len(shapes[vname]))
+                out_cols[out_name] = col_sums[vname] / c.astype(jnp.float32)
+        return Batch(out_cols, num_groups)
+
+    return jax.lax.cond(use, mm_branch, fallback, batch)
 
 
 def _group_aggregate_boundary(batch: Batch, key_names: Sequence[str],

@@ -181,6 +181,20 @@ def _scan_kernel_body(R: int, dt):
     return kern
 
 
+def _dd_add(hi1, lo1, hi2, lo2):
+    """Double-single (compensated) f32 add via Knuth TwoSum + Dekker
+    renormalization — the ONE implementation both the pallas kernel body
+    and the XLA fallback scan use (drift here silently changes error
+    bounds)."""
+    s = hi1 + hi2
+    bb = s - hi1
+    err = (hi1 - (s - bb)) + (hi2 - bb)
+    lo = lo1 + lo2 + err
+    hi_n = s + lo
+    lo_n = lo - (hi_n - s)
+    return hi_n, lo_n
+
+
 def _scan2_kernel_body(R: int):
     """Compensated (double-single f32) scan: every partial prefix is an
     unevaluated (hi, lo) pair combined with TwoSum, so the running error
@@ -191,14 +205,7 @@ def _scan2_kernel_body(R: int):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    def add2(hi1, lo1, hi2, lo2):
-        s = hi1 + hi2
-        bb = s - hi1
-        err = (hi1 - (s - bb)) + (hi2 - bb)
-        lo = lo1 + lo2 + err
-        hi_n = s + lo
-        lo_n = lo - (hi_n - s)
-        return hi_n, lo_n
+    add2 = _dd_add
 
     def kern(x_ref, hi_ref, lo_ref, carry):
         @pl.when(pl.program_id(0) == 0)
@@ -288,14 +295,7 @@ def prefix_sum2(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
             return hi, lo
 
         def comb(a, b):
-            hi1, lo1 = a
-            hi2, lo2 = b
-            s = hi1 + hi2
-            bb = s - hi1
-            err = (hi1 - (s - bb)) + (hi2 - bb)
-            lo = lo1 + lo2 + err
-            hi_n = s + lo
-            return hi_n, lo - (hi_n - s)
+            return _dd_add(a[0], a[1], b[0], b[1])
 
         return jax.lax.associative_scan(
             comb, (x, jnp.zeros_like(x)))

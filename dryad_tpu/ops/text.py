@@ -1,21 +1,31 @@
 """Vectorized text ops: tokenization (the WordCount SelectMany kernel).
 
 The reference's WordCount does ``SelectMany(line => line.Split(' '))``
-(reference samples/WordCount.cs.pp) with per-record C# string ops.  On TPU we
-tokenize a whole batch of lines in one fused program: flatten all line bytes
-into one stream (row boundaries act as delimiters), mark token starts with
-elementwise compares, place tokens with a prefix-sum + scatter, and slice
-token bytes with a windowed gather.  No per-row loop, no dynamic shapes.
+(reference samples/WordCount.cs.pp) with per-record C# string ops.  On TPU
+tokens cannot cross row boundaries, so everything is PER-ROW work on the
+[cap, L] byte grid: batched L-wide sort networks cost ~log^2(L)/2
+compare-exchange stages instead of a global byte-stream sort's
+~log^2(cap*L)/2, and NO random gathers appear anywhere before the final
+byte extraction (measured 9-16 ns per gathered element on this chip —
+gathers, not compute, dominated every earlier tokenizer design).
+
+``tokenize_group_count`` is the fused SelectMany+GroupBy+Count: tokens
+are hashed IN PLACE on the grid (two 32-bit polynomial window hashes,
+constant-shift adds only), grouped by hash, and the expensive windowed
+byte extraction runs only for the per-group REPRESENTATIVES — cost
+proportional to the vocabulary, not the token stream.
 """
 
 from __future__ import annotations
+
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
 from dryad_tpu.data.columnar import Batch, StringColumn
 
-__all__ = ["split_tokens", "lower_ascii"]
+__all__ = ["split_tokens", "tokenize_group_count", "lower_ascii"]
 
 
 def lower_ascii(col: StringColumn) -> StringColumn:
@@ -31,65 +41,86 @@ def _is_delim(b: jax.Array, delims: bytes) -> jax.Array:
     return m
 
 
-def split_tokens(batch: Batch, column: str, out_capacity: int,
-                 max_token_len: int = 24,
-                 delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>") -> Batch:
-    """Split a string column into a batch of tokens (one row per token).
+def _lower_grid(g: jax.Array) -> jax.Array:
+    is_upper = (g >= ord("A")) & (g <= ord("Z"))
+    return jnp.where(is_upper, g + 32, g)
 
-    Returns ``(tokens_batch, overflow)``: the batch has a single string
-    column named ``column``; tokens longer than ``max_token_len`` are
-    truncated (semantic); ``overflow`` is True when tokens beyond
-    ``out_capacity`` were dropped (a capacity-planning failure — the
-    executor retries the stage with scaled capacity).
-    """
+
+def _token_grid(batch: Batch, column: str, delims: bytes,
+                max_token_len: int, lower: bool = False):
+    """Per-row token structure on the [cap, L] byte grid: returns
+    (grid, is_start, lenpos, tok_cnt_row).  ``lenpos[r, i]`` is the
+    (clamped) length of the token starting at byte i, meaningful where
+    ``is_start``."""
     col: StringColumn = batch.columns[column]
     cap, L = col.capacity, col.max_len
     valid_row = batch.valid_mask()
-
-    # flatten to one byte stream; bytes past each row's length and rows past
-    # count are forced to delimiter (0x20) so they never join tokens
     pos = jnp.arange(L, dtype=jnp.int32)[None, :]
     in_row = (pos < col.lengths[:, None]) & valid_row[:, None]
-    flat = jnp.where(in_row, col.data, ord(" ")).reshape(-1)  # [cap*L]
-    N = cap * L
+    grid = jnp.where(in_row, col.data, ord(" "))            # [cap, L]
+    # delimiter classification sees the RAW bytes; lowering applies after
+    # (identical to the unfused split -> lower_ascii order, so letter
+    # delimiters classify the same way on both paths)
+    nondelim = ~_is_delim(grid, delims)
+    if lower:
+        grid = _lower_grid(grid)
+    prev_nd = jnp.pad(nondelim[:, :-1], ((0, 0), (1, 0)))
+    is_start = nondelim & ~prev_nd                          # [cap, L]
+    delim_pos = jnp.where(~nondelim, pos, L)
+    next_delim = jnp.flip(jax.lax.cummin(
+        jnp.flip(delim_pos, axis=1), axis=1), axis=1)       # [cap, L]
+    lenpos = jnp.minimum(next_delim - pos, max_token_len)
+    return grid, is_start, lenpos, is_start.sum(axis=1, dtype=jnp.int32)
 
-    nondelim = ~_is_delim(flat, delims)
-    prev_nondelim = jnp.concatenate([jnp.zeros((1,), jnp.bool_), nondelim[:-1]])
-    # row starts break tokens even without explicit delimiters because each
-    # row's tail is padded with spaces; first byte of stream handled by prev=0
-    is_start = nondelim & ~prev_nondelim
 
-    # start positions, compaction by STABLE SORT instead of scatter: the
-    # t-th token's start is the t-th True in is_start, so a stable argsort
-    # of ~is_start lists start positions in order (TPU scatters serialize;
-    # sorts ride the vector units — measured ~2.5x faster at 100M bytes)
-    num_tokens = is_start.sum(dtype=jnp.int32)
-    start_idx = jnp.argsort(~is_start, stable=True).astype(jnp.int32)
-    if N >= out_capacity:
-        start_pos = start_idx[:out_capacity]
-    else:  # fewer byte positions than token slots: pad (masked later)
-        start_pos = jnp.concatenate(
-            [start_idx, jnp.zeros((out_capacity - N,), jnp.int32)])
+def _token_slots(is_start, extra_grids, tok_cnt_row, cap: int, L: int,
+                 out_capacity: int, max_tokens_per_row: int | None):
+    """Compact per-START-cell lanes into flat token-slot order with NO
+    random gathers: (1) a batched stable row sort on ~is_start lands the
+    row's k-th token's lanes at column k; (2) every (row, k) cell knows
+    its output slot base_excl[row] + k ELEMENTWISE, so one value-carry
+    sort by slot id produces the flat order.  Returns (slot lanes
+    [out_capacity] per extra grid, num_tokens, need_row_overflow)."""
+    from dryad_tpu.ops.pallas_kernels import prefix_sum
 
-    # token length = distance from each position to the next delimiter,
-    # via a single reverse cummin primitive (a custom-combine
-    # associative_scan here compiles pathologically at scale on TPU)
-    delim_pos = jnp.where(~nondelim, jnp.arange(N, dtype=jnp.int32), N)
-    next_delim = jnp.flip(jax.lax.cummin(jnp.flip(delim_pos)))
+    K = min(max_tokens_per_row or (L // 2 + 1), L // 2 + 1)
+    srow = jax.lax.sort(
+        ((~is_start).astype(jnp.uint8),) + tuple(extra_grids),
+        dimension=1, num_keys=1, is_stable=True)            # [cap, L]
+    cnt_k = jnp.minimum(tok_cnt_row, K)
+    base_incl = prefix_sum(cnt_k)                           # [cap]
+    num_tokens = base_incl[cap - 1]
+    base_excl = (base_incl - cnt_k).astype(jnp.uint32)
+    kk = jnp.arange(K, dtype=jnp.uint32)[None, :]
+    slot = base_excl[:, None] + kk                          # [cap, K]
+    slot = jnp.where(kk < cnt_k.astype(jnp.uint32)[:, None],
+                     slot, jnp.uint32(0xFFFFFFFF))
+    sorted_out = jax.lax.sort(
+        (slot.reshape(-1),) + tuple(s[:, :K].reshape(-1) for s in srow[1:]),
+        num_keys=1, is_stable=False)
+    M = cap * K
 
-    tok_valid = jnp.arange(out_capacity, dtype=jnp.int32) < jnp.minimum(
-        num_tokens, out_capacity)
-    tok_len = jnp.where(
-        tok_valid,
-        jnp.minimum(jnp.take(next_delim, start_pos) - start_pos,
-                    max_token_len), 0)
+    def _slots(a):
+        if M >= out_capacity:
+            return a[:out_capacity]
+        return jnp.concatenate(
+            [a, jnp.zeros((out_capacity - M,), a.dtype)])
 
-    # token bytes via PACKED u32 gather + byte realignment: gathering one
-    # u32 word moves 4 bytes, so a max_token_len window needs len/4 + 1
-    # word fetches instead of len byte fetches (the windowed byte gather
-    # was the tokenizer's dominant cost).  Little-endian bitcast: byte i
-    # of a word occupies bits [8i, 8i+8), so >> (8*s) realigns a window
-    # starting at sub-offset s.
+    # rows beyond the static per-row token bound lose tokens: a NEED
+    # (the executor retries with scale, like every capacity channel)
+    over_row = jnp.max(tok_cnt_row) > K
+    return [_slots(a) for a in sorted_out[1:]], num_tokens, over_row
+
+
+def _extract_bytes(flat: jax.Array, start_pos, tok_len, T: int,
+                   max_token_len: int):
+    """Token bytes via PACKED u32 gather + byte realignment: gathering
+    one u32 word moves 4 bytes, so a max_token_len window needs len/4 + 1
+    word fetches instead of len byte fetches.  Little-endian bitcast:
+    byte i of a word occupies bits [8i, 8i+8), so >> (8*s) realigns a
+    window starting at sub-offset s.  Cost is ~10 ns per gathered WORD —
+    callers keep T as small as semantics allow."""
+    N = flat.shape[0]
     nw = -(-max_token_len // 4) + 1
     pad4 = (-N) % 4
     flat4 = jnp.concatenate([flat, jnp.zeros((pad4,), flat.dtype)]) \
@@ -106,14 +137,192 @@ def split_tokens(batch: Batch, column: str, out_capacity: int,
     hi = toku32[:, 1:nw] << ((jnp.uint32(32) - sh) & jnp.uint32(31))
     outw = jnp.where(sub == 0, toku32[:, :nw - 1], lo | hi)
     tok_bytes = jax.lax.bitcast_convert_type(outw, jnp.uint8) \
-        .reshape(out_capacity, (nw - 1) * 4)[:, :max_token_len]
+        .reshape(T, (nw - 1) * 4)[:, :max_token_len]
     w = jnp.arange(max_token_len, dtype=jnp.int32)[None, :]
-    tok_bytes = jnp.where(w < tok_len[:, None], tok_bytes, 0)
+    return jnp.where(w < tok_len[:, None], tok_bytes, 0)
 
+
+def _poslen_lanes(abs_pos, lenpos, one_lane: bool):
+    """(abs_pos, len) as slot-sort carry lanes: packed (abs_pos<<5 | len)
+    when positions fit 2^27 and lengths fit 5 bits, else two lanes.  The
+    single home of this bit layout (decode: _poslen_decode)."""
+    if one_lane:
+        return [(abs_pos << 5) | lenpos.astype(jnp.uint32)]
+    return [abs_pos, lenpos.astype(jnp.uint32)]
+
+
+def _poslen_decode(lanes, one_lane: bool, valid):
+    if one_lane:
+        pk = lanes[0]
+        start_pos = (pk >> 5).astype(jnp.int32)
+        tok_len = jnp.where(valid, (pk & 0x1F).astype(jnp.int32), 0)
+    else:
+        start_pos = lanes[0].astype(jnp.int32)
+        tok_len = jnp.where(valid, lanes[1].astype(jnp.int32), 0)
+    return start_pos, tok_len
+
+
+def _one_lane_ok(cap: int, L: int, max_token_len: int) -> bool:
+    return cap * L < (1 << 27) and max_token_len < 32
+
+
+def split_tokens(batch: Batch, column: str, out_capacity: int,
+                 max_token_len: int = 24,
+                 delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>",
+                 max_tokens_per_row: int | None = None
+                 ) -> Tuple[Batch, jax.Array]:
+    """Split a string column into a batch of tokens (one row per token).
+
+    Returns ``(tokens_batch, need)``: the batch has a single string
+    column named ``column``; tokens longer than ``max_token_len`` are
+    truncated (semantic); ``need`` is nonzero when tokens beyond
+    ``out_capacity`` (or rows beyond ``max_tokens_per_row``) were
+    dropped — the executor retries the stage with scaled capacity.
+    """
+    col: StringColumn = batch.columns[column]
+    cap, L = col.capacity, col.max_len
+    grid, is_start, lenpos, tok_cnt_row = _token_grid(
+        batch, column, delims, max_token_len)
+
+    rowbase = (jnp.arange(cap, dtype=jnp.uint32) * jnp.uint32(L))[:, None]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    abs_pos = rowbase + pos.astype(jnp.uint32)
+    one_lane = _one_lane_ok(cap, L, max_token_len)
+    lanes_in = _poslen_lanes(abs_pos, lenpos, one_lane)
+    slots, num_tokens, over_row = _token_slots(
+        is_start, [jnp.broadcast_to(a, (cap, L)) for a in lanes_in],
+        tok_cnt_row, cap, L, out_capacity, max_tokens_per_row)
+
+    t = jnp.arange(out_capacity, dtype=jnp.int32)
+    tok_valid = t < jnp.minimum(num_tokens, out_capacity)
+    start_pos, tok_len = _poslen_decode(slots, one_lane, tok_valid)
+
+    tok_bytes = _extract_bytes(grid.reshape(-1), start_pos, tok_len,
+                               out_capacity, max_token_len)
     out = Batch({column: StringColumn(tok_bytes, tok_len)},
                 jnp.minimum(num_tokens, out_capacity))
-    # second return is the NEED channel: 0 = fits, else the actual row
-    # requirement — lets the executor right-size the retry in one shot
-    # (the dynamic-manager size-feedback idea, DrDynamicDistributor.cpp:388)
+    # the NEED channel: 0 = fits, else the actual row requirement — lets
+    # the executor right-size the retry in one shot (the dynamic-manager
+    # size-feedback idea, DrDynamicDistributor.cpp:388)
     need = jnp.where(num_tokens > out_capacity, num_tokens, 0)
+    need = jnp.where(over_row, jnp.maximum(need, out_capacity * 2), need)
+    return out, need.astype(jnp.int32)
+
+
+# two independent odd bases for the 64-bit-budget polynomial pair
+_HB1 = 0x85EBCA6B
+_HB2 = 0xC2B2AE35
+
+
+def _window_hashes(grid: jax.Array, lenpos: jax.Array, W: int):
+    """Per-CELL polynomial hashes of the token starting at each byte:
+    h(cell) = sum_{d < len} (byte[d]+1) * B^d  (mod 2^32), for two
+    independent odd bases — 24 constant-shift multiply-adds over the
+    grid, no scans, no gathers.  Valid where is_start; garbage elsewhere
+    (harmless — non-start cells never ride the slot sorts)."""
+    cap, L = grid.shape
+    padg = jnp.pad(grid, ((0, 0), (0, W))).astype(jnp.uint32)
+    h1 = jnp.zeros((cap, L), jnp.uint32)
+    h2 = jnp.zeros((cap, L), jnp.uint32)
+    p1 = 1
+    p2 = 1
+    for d in range(W):
+        b = padg[:, d:L + d] + jnp.uint32(1)
+        m = d < lenpos
+        h1 = h1 + jnp.where(m, b * jnp.uint32(p1), 0)
+        h2 = h2 + jnp.where(m, b * jnp.uint32(p2), 0)
+        p1 = (p1 * _HB1) & 0xFFFFFFFF
+        p2 = (p2 * _HB2) & 0xFFFFFFFF
+    # fold the length (cheap extra discrimination for truncated tokens)
+    h2 = h2 ^ (lenpos.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+    return h1, h2
+
+
+def tokenize_group_count(batch: Batch, column: str, out_capacity: int,
+                         vocab_capacity: int, count_name: str,
+                         max_token_len: int = 24,
+                         delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>",
+                         lower: bool = False,
+                         max_tokens_per_row: int | None = None
+                         ) -> Tuple[Batch, jax.Array]:
+    """Fused SelectMany(split) -> GroupBy(token) -> Count.
+
+    Equivalent to split_tokens (+ lower_ascii) + group_aggregate count,
+    but tokens are hashed IN PLACE (_window_hashes) and the windowed
+    byte extraction — the dominant tokenizer cost, ~10 ns per gathered
+    word — runs only for ``vocab_capacity`` group REPRESENTATIVES.
+    Returns (groups batch [vocab_capacity] with columns (column,
+    count_name), need) — need covers token overflow, per-row overflow,
+    AND vocabulary overflow; the executor's scale-retry fixes all three.
+
+    Grouping is by the 64-bit polynomial hash pair without byte
+    verification — the same 2^-64 collision budget every hash-path
+    group in kernels.py documents (_hash_sort_segments).
+
+    Reference role: the WordCount map vertex — SelectMany + hash GroupBy
+    + combiner fused in one pass (samples/WordCount.cs.pp,
+    DryadLinqVertex.cs:510 GroupBy family).
+    """
+    from dryad_tpu.ops.kernels import (_lane_differs, _segment_flags,
+                                       _sort_carrying)
+
+    col: StringColumn = batch.columns[column]
+    cap, L = col.capacity, col.max_len
+    grid, is_start, lenpos, tok_cnt_row = _token_grid(
+        batch, column, delims, max_token_len, lower=lower)
+    h1g, h2g = _window_hashes(grid, lenpos, max_token_len)
+
+    rowbase = (jnp.arange(cap, dtype=jnp.uint32) * jnp.uint32(L))[:, None]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    abs_pos = jnp.broadcast_to(rowbase + pos.astype(jnp.uint32), (cap, L))
+    one_lane = _one_lane_ok(cap, L, max_token_len)
+    extra = [h1g, h2g] + _poslen_lanes(abs_pos, lenpos, one_lane)
+    slots, num_tokens, over_row = _token_slots(
+        is_start, extra, tok_cnt_row, cap, L, out_capacity,
+        max_tokens_per_row)
+
+    # group the token stream by hash pair: ONE unstable sort carrying the
+    # packed position, boundary flags, counts by index difference on the
+    # densified end rows (the kernels.py boundary-carry recipe)
+    t = jnp.arange(out_capacity, dtype=jnp.int32)
+    n_tok = jnp.minimum(num_tokens, out_capacity)
+    tvalid = t < n_tok
+    big = jnp.uint32(0xFFFFFFFF)
+    h1 = jnp.where(tvalid, slots[0], big)
+    h2 = jnp.where(tvalid, slots[1], big)
+    carry = slots[2:]
+    (sh1, sh2), scarry = _sort_carrying([h1, h2], carry, out_capacity,
+                                        stable=False)
+    _is_s, is_end, num_groups = _segment_flags(
+        _lane_differs(sh1, sh2), n_tok)
+    dkeys, dl = _sort_carrying(
+        [(~is_end).astype(jnp.uint32), t.astype(jnp.uint32)],
+        list(scarry), out_capacity, stable=False)
+    didx = dkeys[1].astype(jnp.int32)
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32), didx[:-1]])
+    cnt_g = didx - prev
+
+    # representative byte extraction at VOCABULARY size only
+    V = vocab_capacity
+    gv = jnp.arange(V, dtype=jnp.int32) < jnp.minimum(num_groups, V)
+
+    def _v(a):
+        return a[:V] if a.shape[0] >= V else jnp.concatenate(
+            [a, jnp.zeros((V - a.shape[0],), a.dtype)])
+
+    start_pos, tok_len = _poslen_decode([_v(a) for a in dl], one_lane, gv)
+    tok_bytes = _extract_bytes(grid.reshape(-1), start_pos, tok_len,
+                               V, max_token_len)
+    counts = jnp.where(gv, _v(cnt_g), 0)
+    out = Batch({column: StringColumn(tok_bytes, tok_len),
+                 count_name: counts},
+                jnp.minimum(num_groups, V))
+    need = jnp.where(num_tokens > out_capacity, num_tokens, 0)
+    # ceil-factor FIRST: num_groups * out_capacity overflows int32 in
+    # exactly the regime where this branch fires
+    need = jnp.where(num_groups > V,
+                     jnp.maximum(need, (-(-num_groups // V))
+                                 * out_capacity),
+                     need)
+    need = jnp.where(over_row, jnp.maximum(need, out_capacity * 2), need)
     return out, need.astype(jnp.int32)

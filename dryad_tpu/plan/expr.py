@@ -139,6 +139,10 @@ class FlatTokens(Node):
     max_token_len: int = 24
     delims: bytes = b" \t\r\n.,;:!?\"'()[]{}<>"
     lower: bool = False
+    # static per-row token bound (None = the ceil(L/2) worst case); the
+    # tokenizer's slot grid is cap x bound, so a workload-tuned bound
+    # shrinks its dominant sort; overflow feeds the NEED retry channel
+    max_tokens_per_row: int | None = None
 
     @property
     def partitioning(self) -> Partitioning:

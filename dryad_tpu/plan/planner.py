@@ -291,7 +291,8 @@ class Planner:
             f.ops.append(StageOp("flat_tokens", {
                 "column": n.column, "out_capacity": n.out_capacity,
                 "max_token_len": n.max_token_len, "delims": n.delims,
-                "lower": n.lower}))
+                "lower": n.lower,
+                "max_tokens_per_row": n.max_tokens_per_row}))
             f.capacity = n.out_capacity
             f.partitioning = E.Partitioning.none()
             return f

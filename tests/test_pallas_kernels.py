@@ -165,3 +165,187 @@ def test_boundary_ineligible_falls_back():
     assert int(out.count) == 7
     np.testing.assert_allclose(
         np.asarray(out.columns["m"])[:7], np.ones((7, 3)), rtol=1e-6)
+
+
+def test_smallkey_matmul_group_matches_scan():
+    """The one-hot MXU group path agrees with the sort paths, including
+    the runtime wide-span fallback inside the same compiled fn."""
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as k
+
+    rng = np.random.RandomState(5)
+    n = 3_000
+    aggs = {"n": ("count", None), "m": ("mean", "x"), "s": ("sum", "w")}
+
+    def run(keys):
+        b = Batch({"k": jnp.asarray(keys),
+                   "x": jnp.asarray(rng.rand(n, 4).astype(np.float32)),
+                   "w": jnp.asarray(rng.randn(n).astype(np.float32))},
+                  jnp.asarray(n - 11, jnp.int32))
+        assert k._matmul_group_eligible(b, ["k"], aggs)
+        got = k.group_aggregate(b, ["k"], aggs)
+        ref = k._group_aggregate_scan(b, ["k"], aggs)
+        ng = int(ref.count)
+        assert int(got.count) == ng
+        go = np.argsort(np.asarray(got.columns["k"])[:ng])
+        ro = np.argsort(np.asarray(ref.columns["k"])[:ng])
+        np.testing.assert_array_equal(
+            np.asarray(got.columns["k"])[:ng][go],
+            np.asarray(ref.columns["k"])[:ng][ro])
+        np.testing.assert_array_equal(
+            np.asarray(got.columns["n"])[:ng][go],
+            np.asarray(ref.columns["n"])[:ng][ro])
+        for c in ("m", "s"):
+            np.testing.assert_allclose(
+                np.asarray(got.columns[c])[:ng][go],
+                np.asarray(ref.columns[c])[:ng][ro], rtol=1e-5, atol=1e-5)
+
+    run(rng.randint(-40, 77, n).astype(np.int32))      # small span (MXU)
+    run(rng.randint(-2**30, 2**30, n).astype(np.int32))  # wide (fallback)
+    run(np.full(n, 2**31 - 5, np.int32))               # near-overflow span
+
+
+def test_smallkey_matmul_empty_and_single():
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as k
+
+    aggs = {"n": ("count", None), "s": ("sum", "v")}
+    b = Batch({"k": jnp.zeros((64,), jnp.int32),
+               "v": jnp.ones((64,), jnp.float32)},
+              jnp.asarray(0, jnp.int32))
+    out = k.group_aggregate(b, ["k"], aggs)
+    assert int(out.count) == 0
+    b1 = Batch({"k": jnp.full((64,), 7, jnp.int32),
+                "v": jnp.ones((64,), jnp.float32)},
+               jnp.asarray(5, jnp.int32))
+    o1 = k.group_aggregate(b1, ["k"], aggs)
+    assert int(o1.count) == 1
+    assert int(np.asarray(o1.columns["k"])[0]) == 7
+    assert int(np.asarray(o1.columns["n"])[0]) == 5
+    assert float(np.asarray(o1.columns["s"])[0]) == 5.0
+
+
+def test_smallkey_matmul_nan_padding():
+    """Padding rows holding inf/NaN must not contaminate group sums
+    (0 x NaN = NaN in the one-hot contraction)."""
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as k
+
+    v = np.full(64, np.nan, np.float32)
+    v[:5] = [1.0, 2.0, 3.0, 4.0, 5.0]
+    kk = np.full(64, 9, np.int32)
+    b = Batch({"k": jnp.asarray(kk), "v": jnp.asarray(v)},
+              jnp.asarray(5, jnp.int32))
+    out = k.group_aggregate(b, ["k"], {"s": ("sum", "v")})
+    assert int(out.count) == 1
+    assert float(np.asarray(out.columns["s"])[0]) == 15.0
+
+
+def test_tokenize_group_count_matches_unfused():
+    """The fused SelectMany+GroupBy+Count equals split_tokens + lower +
+    group_aggregate on real text, including the NEED channel."""
+    import collections
+    from dryad_tpu.data.columnar import batch_from_numpy
+    from dryad_tpu.ops.text import tokenize_group_count
+
+    rng = np.random.RandomState(6)
+    words = ["Apple", "fig", "KIWI", "pear-x", "plum", "a"]
+    lines = [" ".join(words[j] for j in rng.randint(0, 6, rng.randint(1, 9)))
+             for _ in range(800)]
+    lines[5] = ""                       # empty line
+    lines[6] = "   "                    # delimiters only
+    b = batch_from_numpy({"line": lines}, str_max_len=64)
+    out, need = tokenize_group_count(b, "line", out_capacity=8192,
+                                     vocab_capacity=256, count_name="n",
+                                     lower=True)
+    assert int(need) == 0
+    ref = collections.Counter(w.lower() for ln in lines for w in ln.split())
+    ng = int(out.count)
+    assert ng == len(ref)
+    got = {}
+    tc = out.columns["line"]
+    for i in range(ng):
+        L = int(np.asarray(tc.lengths)[i])
+        got[bytes(np.asarray(tc.data)[i, :L]).decode()] = \
+            int(np.asarray(out.columns["n"])[i])
+    assert got == dict(ref)
+
+
+def test_tokenize_group_count_vocab_overflow_need():
+    from dryad_tpu.data.columnar import batch_from_numpy
+    from dryad_tpu.ops.text import tokenize_group_count
+
+    lines = [f"w{i}" for i in range(64)]   # 64 distinct tokens
+    b = batch_from_numpy({"line": lines}, str_max_len=8)
+    out, need = tokenize_group_count(b, "line", out_capacity=256,
+                                     vocab_capacity=16, count_name="n")
+    assert int(need) > 0                   # vocabulary didn't fit
+    out2, need2 = tokenize_group_count(b, "line", out_capacity=256,
+                                       vocab_capacity=128, count_name="n")
+    assert int(need2) == 0 and int(out2.count) == 64
+
+
+def test_executor_fuses_tokens_group():
+    """The peephole rewrites [flat_tokens, count-group] and the fused
+    query answers identically through the public API."""
+    import collections
+    from dryad_tpu import Context
+    from dryad_tpu.exec.executor import _fuse_stage_ops
+    from dryad_tpu.plan.stages import StageOp
+
+    ops = [StageOp("flat_tokens", {"column": "line", "out_capacity": 1024,
+                                   "max_token_len": 24, "delims": b" ",
+                                   "lower": True}),
+           StageOp("group", {"keys": ["line"],
+                             "aggs": {"n": ("count", None)}})]
+    fused = _fuse_stage_ops(ops)
+    assert [o.kind for o in fused] == ["tokens_group_count"]
+    # non-matching shapes stay unfused
+    ops2 = [ops[0], StageOp("group", {"keys": ["line"],
+                                      "aggs": {"s": ("sum", "x")}})]
+    assert [o.kind for o in _fuse_stage_ops(ops2)] == \
+        ["flat_tokens", "group"]
+
+    ctx = Context()
+    lines = ["b a a", "c B b", "a"] * 50
+    q = (ctx.from_columns({"line": lines}, str_max_len=16)
+         .split_words("line", out_capacity=2048, lower=True)
+         .group_by(["line"], {"n": ("count", None)}))
+    got = q.collect()
+    ref = collections.Counter(w.lower() for ln in lines for w in ln.split())
+    res = {}
+    for i, w in enumerate(got["line"]):
+        w = w.decode() if isinstance(w, bytes) else str(w)
+        res[w] = int(np.asarray(got["n"])[i])
+    assert res == dict(ref)
+
+
+def test_tokenize_letter_delims_match_unfused():
+    """Letter delimiters + lower: classification must see RAW bytes on
+    both paths (review finding: lowering before classification split
+    'aXb' differently across the fused/unfused lowerings)."""
+    import collections
+    from dryad_tpu.data.columnar import batch_from_numpy
+    from dryad_tpu.ops.text import (lower_ascii, split_tokens,
+                                    tokenize_group_count)
+    from dryad_tpu.data.columnar import Batch
+
+    lines = ["aXb CXd", "eXf", "gh"]
+    b = batch_from_numpy({"line": lines}, str_max_len=16)
+    toks, _ = split_tokens(b, "line", out_capacity=64, delims=b" X")
+    lc = lower_ascii(toks.columns["line"])
+    unfused = collections.Counter()
+    for i in range(int(toks.count)):
+        L = int(np.asarray(lc.lengths)[i])
+        unfused[bytes(np.asarray(lc.data)[i, :L]).decode()] += 1
+    out, need = tokenize_group_count(b, "line", out_capacity=64,
+                                     vocab_capacity=32, count_name="n",
+                                     delims=b" X", lower=True)
+    fused = {}
+    tc = out.columns["line"]
+    for i in range(int(out.count)):
+        L = int(np.asarray(tc.lengths)[i])
+        fused[bytes(np.asarray(tc.data)[i, :L]).decode()] = \
+            int(np.asarray(out.columns["n"])[i])
+    assert fused == dict(unfused)
+    assert int(need) == 0
