@@ -157,7 +157,8 @@ def pagerank_slope(edges: dict, n_nodes: int, k_hi: int = 8
 
     def body(i, rank):
         rb = Batch({"node": nodes, "rank": rank}, ncnt)
-        joined, _need = _k.hash_join(eb, rb, ["src"], ["node"], out_cap)
+        joined, _need = _k.hash_join(eb, rb, ["src"], ["node"], out_cap,
+                                     right_unique=True)
         contrib = Batch({"node": joined.columns["dst"],
                          "c": joined.columns["rank"]
                          / joined.columns["deg"]}, joined.count)

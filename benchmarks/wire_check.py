@@ -75,12 +75,33 @@ def main(n_devices: int = 8, rows_per_part: int = 4096,
         assert (info[:, 0] == 0).all() and (info[:, 1] == 0).all(), info
         return out, info
 
-    # wave 1: structural slack (discovery)
-    out, info = run(None)
-    slot_used = int(info[:, 3].max())
-    C1 = max(1, min(cap, -(-slack * cap // D)))
+    # wave 1: counts-only probe -> measured slots on the FIRST wave too
+    # (the executor's exact-first-wave path for pure repartition legs,
+    # exec/executor._probe_slot_rows; quantized to C_struct/16)
+    from dryad_tpu.ops.hashing import hash_batch_keys
+    from dryad_tpu.ops.pallas_kernels import hist_buckets
+    from dryad_tpu.parallel.shuffle import _canonical_hash_dest
 
-    # wave 2: exact measured slots (steady state)
+    def probe_shard(b):
+        bb = jax.tree.map(lambda x: x[0], b)
+        _, lo = hash_batch_keys(bb, ["k"])
+        dest = jnp.where(bb.valid_mask(),
+                         _canonical_hash_dest(lo, axes), D)
+        m = jnp.max(hist_buckets(dest, D)).astype(jnp.int32)
+        return jax.lax.pmax(m, axes)[None]
+
+    probe = jax.jit(jax.shard_map(probe_shard, mesh=mesh,
+                                  in_specs=P(axes), out_specs=P(axes),
+                                  check_vma=False))
+    slot_probe = int(np.asarray(probe(batch)).max())
+    C_struct = max(1, min(cap, -(-slack * cap // D)))
+    q = max(16, C_struct // 16)
+    C1 = max(1, min(C_struct, -(-slot_probe // q) * q))
+    out, info = run(C1)
+    slot_used = int(info[:, 3].max())
+
+    # wave 2: exact measured slots from the exchange's own feedback
+    # (steady state of repeated waves)
     C2 = max(16, -(-slot_used // 16) * 16)
     out, info = run(C2)
 
@@ -118,7 +139,10 @@ def main(n_devices: int = 8, rows_per_part: int = 4096,
         "send_slack": slack,
         "discovery_wave": {
             "slot_rows_on_wire": D * C1 * D,
+            "probe_slot_rows": slot_probe,
             "utilization_pct_slack": round(100.0 * util1, 1),
+            "structural_slack_pct": round(
+                100.0 * useful / (D * C_struct * D), 1),
         },
         "measured_slot_rows": slot_used,
         "slot_rows_on_wire": D * C2 * D,
@@ -126,8 +150,10 @@ def main(n_devices: int = 8, rows_per_part: int = 4096,
         "wire_utilization_pct": round(100.0 * util2, 1),
         "useful_bytes": useful * row_bytes,
         "wire_bytes": D * C2 * D * row_bytes,
-        "note": "wave 1 pays the structural slack once (discovery); "
-                "every later wave ships measured exact slots "
+        "note": "wave 1 ships MEASURED slots too (counts-only probe, "
+                "executor exact-first-wave path; structural_slack_pct is "
+                "what the slack-sized wave would have shipped); later "
+                "waves ride the exchange's own slot feedback "
                 "(runtime/stream_plan.py right-sizing)",
     }
     return result

@@ -735,7 +735,8 @@ class Dataset:
     def join(self, other: "Dataset", left_keys: Sequence[str],
              right_keys: Sequence[str] | None = None,
              expansion: float | None = None,
-             broadcast: bool = False, how: str = "inner") -> "Dataset":
+             broadcast: bool = False, how: str = "inner",
+             right_unique: bool = False) -> "Dataset":
         """Equi-join.  ``how`` in inner/left/right/full: "left" keeps
         unmatched left rows with right columns zero-filled; "right" keeps
         unmatched right rows (left non-key columns zero-filled, left key
@@ -746,7 +747,8 @@ class Dataset:
             parents=(self.node, other.node), left_keys=tuple(left_keys),
             right_keys=tuple(right_keys or left_keys),
             expansion=expansion or self.ctx.config.join_expansion,
-            broadcast_right=broadcast, how=how))
+            broadcast_right=broadcast, how=how,
+            right_unique=right_unique))
 
     def group_join(self, other: "Dataset", left_keys: Sequence[str],
                    aggs: Dict[str, Any],

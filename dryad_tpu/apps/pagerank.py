@@ -34,7 +34,8 @@ def pagerank(ctx: Context, edges: dict, n_nodes: int, n_iters: int = 10,
     deg = edges_ds.group_by(["src"], {"deg": ("count", None)})
     # edges joined with out-degree ONCE, materialized outside the loop —
     # without .cache() the do_while body re-runs this join every superstep
-    edges_deg = edges_ds.join(deg, ["src"], ["src"], expansion=2.0).cache()
+    edges_deg = edges_ds.join(deg, ["src"], ["src"], expansion=2.0,
+                              right_unique=True).cache()
 
     nodes = {"node": np.arange(n_nodes, dtype=np.int32),
              "rank": np.full(n_nodes, 1.0 / n_nodes, np.float32)}
@@ -44,7 +45,10 @@ def pagerank(ctx: Context, edges: dict, n_nodes: int, n_iters: int = 10,
     rank_cap = min(n_nodes, 4 * (-(-n_nodes // ctx.nparts)) + 8)
 
     def body(ranks: Dataset) -> Dataset:
-        contribs = edges_deg.join(ranks, ["src"], ["node"], expansion=2.0)
+        # the ranks table is keyed by node (unique): the gather-free
+        # lookup-join path applies (kernels._lookup_join)
+        contribs = edges_deg.join(ranks, ["src"], ["node"], expansion=2.0,
+                                  right_unique=True)
         sums = (contribs
                 .select(lambda c: {"node": c["dst"],
                                    "c": c["rank"] / c["deg"]})

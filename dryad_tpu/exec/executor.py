@@ -319,7 +319,8 @@ def _apply_op(b, op: StageOp, scale: int, others: List[Batch],
         out, need_rows = kernels.hash_join(
             b, right, list(p["left_keys"]), list(p["right_keys"]),
             out_capacity=p["out_capacity"] * scale,
-            how=p.get("how", "inner"))
+            how=p.get("how", "inner"),
+            right_unique=p.get("right_unique", False))
         return out, _needs(_scale_need(need_rows, p["out_capacity"]))
     if k == "semi_anti":
         # canonical (sorted) column order on BOTH sides: the two legs may
@@ -365,7 +366,8 @@ def _fuse_stage_ops(ops):
 
 
 def _apply_exchange(b: Batch, ex: Exchange, scale: int, slack: int, bounds,
-                    axes: tuple = (PARTITION_AXIS,)
+                    axes: tuple = (PARTITION_AXIS,),
+                    slot_rows: int | None = None
                     ) -> Tuple[Batch, jax.Array]:
     """Returns (batch, needs[2]) — see _apply_op."""
     cap = ex.out_capacity * scale
@@ -373,7 +375,8 @@ def _apply_exchange(b: Batch, ex: Exchange, scale: int, slack: int, bounds,
         # empty keys = whole row; sorted so both legs of a set op agree
         keys = list(ex.keys) or sorted(b.names)
         out, nr, nsl, _slot = shuffle.hash_exchange(
-            b, keys, cap, send_slack=slack, axes=axes, axis=ex.axis)
+            b, keys, cap, send_slack=slack, axes=axes, axis=ex.axis,
+            slot_rows=slot_rows)
     elif ex.kind == "range":
         out, nr, nsl, _slot = shuffle.range_exchange(
             b, ex.bounds_key, bounds, cap, descending=ex.descending,
@@ -427,7 +430,8 @@ class Executor:
 
     def _build_stage_fn(self, stage: Stage, scale: int, slack: int,
                         n_legs: int, has_bounds: bool,
-                        salted: bool = False):
+                        salted: bool = False,
+                        slot_hints: tuple = ()):
         def per_shard(*args):
             leg_batches = [
                 _squeeze(b) for b in args[:n_legs]]
@@ -465,14 +469,18 @@ class Executor:
                 exch_need = jnp.maximum(exch_need, nd[0])
                 outs = [lout, rout]
             else:
-                for leg, b in zip(stage.legs, leg_batches):
+                for li, (leg, b) in enumerate(zip(stage.legs,
+                                                  leg_batches)):
                     for op in _fuse_stage_ops(leg.ops):
                         b, nd = _apply_op(b, op, scale, [], self.axes,
                                           slack)
                         needs = jnp.maximum(needs, nd)
                     if leg.exchange is not None:
+                        hint = (slot_hints[li]
+                                if li < len(slot_hints) else None)
                         b, nd = _apply_exchange(b, leg.exchange, scale,
-                                                slack, bounds, self.axes)
+                                                slack, bounds, self.axes,
+                                                slot_rows=hint)
                         needs = jnp.maximum(needs, nd)
                         exch_need = jnp.maximum(exch_need, nd[0])
                     outs.append(b)
@@ -612,6 +620,74 @@ class Executor:
         return ("retry", max(scale, need_scale),
                 max(slack, min(need_slack, self.nparts)), salted)
 
+    def _probe_slot_rows(self, pd: PData, keys, slack: int) -> int:
+        """Counts-only pre-hop for an EXACT first exchange wave: one tiny
+        cached program (hash -> per-destination histogram -> max, pmax'd)
+        and one scalar fetch tell the stage compiler the measured slot
+        need BEFORE the exchange ships — wave 1 then sends measured slots
+        instead of the structural slack (the reference's pull shuffle
+        reads real file sizes, kernel/DrCluster.cpp:553-569; static SPMD
+        shapes force the measurement OUT of the exchange program).  Only
+        meaningful for pure repartition legs, whose input IS the exchange
+        input.  Quantized to C_struct/16 so the per-exchange compile-
+        cache variants stay bounded."""
+        from jax.sharding import PartitionSpec as P
+
+        from dryad_tpu.ops.hashing import hash_batch_keys
+        from dryad_tpu.ops.pallas_kernels import hist_buckets
+        from dryad_tpu.parallel.shuffle import _canonical_hash_dest
+
+        b0 = pd.batch
+        cap = next(iter(jax.tree.leaves(b0))).shape[1]
+        D = self.nparts
+        sig = tuple(sorted((k, str(jnp.shape(v)),
+                            str(getattr(v, "dtype", "str")))
+                           for k, v in b0.columns.items()))
+        key = ("slot_probe", tuple(keys), sig)
+        fn = self._compile_cache.get(key)
+        if fn is None:
+            axes = self.axes
+
+            def per_shard(batch):
+                b = _squeeze(batch)
+                _, lo = hash_batch_keys(b, list(keys))
+                dest = _canonical_hash_dest(lo, axes)
+                dest = jnp.where(b.valid_mask(), dest, D)
+                counts = hist_buckets(dest, D)
+                m = jnp.max(counts).astype(jnp.int32)
+                return jax.lax.pmax(m, axes)[None]
+
+            fn = jax.jit(jax.shard_map(
+                per_shard, mesh=self.mesh, in_specs=P(self.axes),
+                out_specs=P(self.axes[0]), check_vma=False))
+            self._compile_cache[key] = fn
+        slot = int(np.asarray(fn(b0)).max())
+        c_struct = max(1, -(-slack * cap // D))
+        q = max(16, c_struct // 16)
+        return max(1, min(c_struct, -(-slot // q) * q))
+
+    def _slot_hints(self, stage: Stage, inputs, slack: int,
+                    salted: bool) -> tuple:
+        thresh = getattr(self.config, "exchange_probe_min_mb", -1)
+        if (thresh < 0 or salted or len(self.axes) != 1
+                or self.nparts < 2 or self._multiproc):
+            # multi-process gangs fetch through replicate_tree; the probe
+            # fetch would add a cross-host sync — structural slack there
+            return ()
+        hints = []
+        for leg, inp in zip(stage.legs, inputs):
+            hint = None
+            ex = leg.exchange
+            if (ex is not None and ex.kind == "hash" and not leg.ops
+                    and ex.axis is None):
+                mb = sum(x.size * x.dtype.itemsize
+                         for x in jax.tree.leaves(inp.batch)) / (1 << 20)
+                if mb >= thresh:
+                    keys = list(ex.keys) or sorted(inp.batch.names)
+                    hint = self._probe_slot_rows(inp, keys, slack)
+            hints.append(hint)
+        return tuple(hints) if any(h is not None for h in hints) else ()
+
     def _run_stage(self, stage: Stage, results, bindings,
                    defer: Optional[list] = None) -> PData:
         inputs = [self._leg_input(leg, results, bindings)
@@ -631,7 +707,9 @@ class Executor:
             # must key the cache or a re-configured job reuses stale code
             salt_cfg = ((self.config.salt_hot_factor,
                          self.config.salt_topk) if salted else None)
+            slot_hints = self._slot_hints(stage, inputs, slack, salted)
             key = (stage.fingerprint(), scale, slack, salted, salt_cfg,
+                   slot_hints,
                    tuple(str(jax.tree.map(lambda x: (jnp.shape(x), x.dtype),
                                           i.batch)) for i in inputs))
             args = [i.batch for i in inputs]
@@ -646,7 +724,8 @@ class Executor:
                 t0 = time.time()
                 fn = self._build_stage_fn(stage, scale, slack, len(inputs),
                                           bounds is not None,
-                                          salted=salted
+                                          salted=salted,
+                                          slot_hints=slot_hints
                                           ).lower(*args).compile()
                 compile_s = time.time() - t0
                 self._compile_cache[key] = fn

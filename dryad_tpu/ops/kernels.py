@@ -1632,10 +1632,112 @@ def _keys_equal(a: Batch, a_idx, a_names, b: Batch, b_idx, b_names) -> jax.Array
     return eq
 
 
+def _join_out_names(left: Batch, right: Batch, right_keys, suffix: str):
+    """Output column name plan shared by both join lowerings (the
+    lax.cond pair must produce identical pytrees)."""
+    names = list(left.names)
+    rkeyset = set(right_keys)
+    rmap = []
+    for k in right.names:
+        if k in rkeyset:
+            continue
+        name = k if k not in names else k + suffix
+        rmap.append((k, name))
+        names.append(name)
+    return rmap
+
+
+def _lookup_join(left: Batch, right: Batch, left_keys: Sequence[str],
+                 right_keys: Sequence[str], out_capacity: int,
+                 suffix: str, how: str) -> Tuple[Batch, jax.Array]:
+    """Gather-free join for a UNIQUE-keyed right side (lookup/dimension
+    table — the PageRank ranks join, the star-schema shape).
+
+    The general hash_join materializes every output column by random
+    gather (~10.7 ns/row x columns x out_capacity, measured — the
+    dominant join cost).  With at most ONE right row per key, each left
+    row is its own output row, so the join is a merge: sort the union of
+    both sides by 64-bit key hash with rights first in each run, forward-
+    fill the right payload by segmented max (a single fused multi-scan —
+    at most one right per segment, everything else contributes zero), and
+    compact the left rows.  Zero gathers.
+
+    Match verification is the 64-bit hash pair itself (two distinct keys
+    colliding in all 64 bits mis-join — the same ~n^2/2^64 budget every
+    hash group documents); the caller-facing ``right_unique`` path
+    RUNTIME-verifies uniqueness and falls back to the general kernel,
+    which also covers hash-collision-induced apparent duplicates.
+    """
+    lhi, llo = hash_batch_keys(left, left_keys)
+    rhi, rlo = hash_batch_keys(right, right_keys)
+    lvalid = left.valid_mask()
+    rvalid = right.valid_mask()
+    lhi, llo = _sentinel_fold(lhi, llo, lvalid)
+    rhi, rlo = _sentinel_fold(rhi, rlo, rvalid)
+    cl, cr = left.capacity, right.capacity
+    n = cl + cr
+
+    hi = jnp.concatenate([lhi, rhi])
+    lo = jnp.concatenate([llo, rlo])
+    # rights sort BEFORE lefts within a key run, so a forward fill sees
+    # the payload
+    side = jnp.concatenate([jnp.ones((cl,), jnp.uint32),
+                            jnp.zeros((cr,), jnp.uint32)])
+
+    lpack, lspec = _pack_columns_u32(dict(left.columns))
+    rmap = _join_out_names(left, right, right_keys, suffix)
+    rpack, rspec = _pack_columns_u32(
+        {name: right.columns[k] for k, name in rmap})
+    zl = jnp.zeros((cr,), jnp.uint32)
+    zr = jnp.zeros((cl,), jnp.uint32)
+    lanes = [jnp.concatenate([l, zl]) for l in lpack]
+    nr = len(rpack)
+    lanes += [jnp.concatenate([zr, r]) for r in rpack]
+    lanes.append(jnp.concatenate([zr, rvalid.astype(jnp.uint32)]))
+
+    skeys, sl = _sort_carrying([hi, lo, side], lanes, n, stable=False)
+    shi, slo, sside = skeys
+    n_valid = left.count + right.count
+    is_start, _is_end, _ng = _segment_flags(
+        _lane_differs(shi, slo), n_valid)
+
+    # forward-fill the right payload + presence within each key segment:
+    # one fused multi-scan of max ops (<=1 right per segment, zeros
+    # elsewhere, so max IS the fill)
+    fill_in = [(sl[len(lpack) + j], jnp.maximum) for j in range(nr + 1)]
+    filled = _seg_scan_multi(fill_in, is_start) if fill_in else []
+    present = filled[-1] > 0
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_left = (sside == 1) & (idx < n_valid)
+    keep = is_left & present if how == "inner" else is_left
+    total = keep.sum(dtype=jnp.int32)
+
+    out_lanes = list(sl[:len(lpack)])
+    for j in range(nr):
+        # unmatched left rows (how="left") zero-fill the right columns
+        out_lanes.append(jnp.where(present, filled[j], 0))
+    _, dl = _sort_carrying([(~keep).astype(jnp.uint32)], out_lanes, n)
+
+    def _fit(a):
+        return a[:out_capacity] if n >= out_capacity else jnp.concatenate(
+            [a, jnp.zeros((out_capacity - n,), a.dtype)])
+
+    dl = [_fit(a) for a in dl]
+    cols = _unpack_columns_u32(dl[:len(lpack)], lspec)
+    rcols = _unpack_columns_u32(dl[len(lpack):], rspec)
+    cols.update(rcols)
+    cnt = jnp.minimum(total, out_capacity)
+    gmask = jnp.arange(out_capacity) < cnt
+    cols = {k: _mask_rows(v, gmask) for k, v in cols.items()}
+    need = jnp.where(total > out_capacity, total, 0).astype(jnp.int32)
+    return Batch(cols, cnt), need
+
+
 def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
               right_keys: Sequence[str], out_capacity: int,
-              suffix: str = "_r", how: str = "inner"
-              ) -> Tuple[Batch, jax.Array]:
+              suffix: str = "_r", how: str = "inner",
+              right_unique: bool = False) -> Tuple[Batch, jax.Array]:
     """Equi-join; output columns = left columns + right non-key columns
     (right name suffixed on collision).  Returns ``(batch, overflow)``.
 
@@ -1661,7 +1763,28 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     the candidate count, which is rare and only costs a re-plan.
 
     Reference semantics: DryadLinqVertex hash join (DryadLinqVertex.cs:942).
+
+    ``right_unique=True`` (inner/left only) declares the right side a
+    lookup table: after a cheap runtime duplicate check on the right's
+    64-bit hashes, the gather-free merge-fill path (_lookup_join) runs;
+    duplicates (or hash collisions that look like them) fall back to this
+    general kernel inside the same compiled program (lax.cond).
     """
+    if right_unique and how in ("inner", "left"):
+        rhi0, rlo0 = hash_batch_keys(right, right_keys)
+        rv = right.valid_mask()
+        rhi0, rlo0 = _sentinel_fold(rhi0, rlo0, rv)
+        shi0, slo0 = jax.lax.sort((rhi0, rlo0), num_keys=2,
+                                  is_stable=False)
+        dup = jnp.any((shi0[1:] == shi0[:-1]) & (slo0[1:] == slo0[:-1])
+                      & (jnp.arange(1, right.capacity) < right.count))
+        return jax.lax.cond(
+            ~dup,
+            lambda lr: _lookup_join(lr[0], lr[1], left_keys, right_keys,
+                                    out_capacity, suffix, how),
+            lambda lr: hash_join(lr[0], lr[1], left_keys, right_keys,
+                                 out_capacity, suffix, how),
+            (left, right))
     # TPUs have no fast uint64, so candidate ranges are found on a single
     # 32-bit hash lane; real-key verification below removes the (rare)
     # collision-induced false candidates.  (A collision only widens a
@@ -1740,9 +1863,10 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
                     synth_slot.shape + (1,) * (g.ndim - 1))
                 g = jnp.where(z, 0, g)
             out_cols[name] = g
-    joined = Batch(out_cols, keep.sum(dtype=jnp.int32))
-    perm = jnp.argsort(~keep, stable=True)
-    out = joined.gather(perm)
+    # compaction by value-carry sort, not argsort+gather: the full-batch
+    # gather alone measured ~22 ms at 400k rows x 5 columns
+    joined = Batch(out_cols, jnp.asarray(out_capacity, jnp.int32))
+    out = compact(joined, keep)
     # conservative: candidate pairs dropped for capacity might have been real.
     # NEED channel: 0 = fits, else actual candidate-pair count so the
     # executor can right-size the retry in one shot

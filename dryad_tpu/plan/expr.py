@@ -268,6 +268,10 @@ class Join(Node):
     expansion: float = 1.0  # out_capacity multiplier over left capacity
     broadcast_right: bool = False
     how: str = "inner"
+    # caller hint: right keys are unique (a lookup/dimension table) —
+    # enables the gather-free merge-fill join path, VERIFIED at runtime
+    # (falls back to the general path when duplicates appear)
+    right_unique: bool = False
 
     @property
     def npartitions(self) -> int:

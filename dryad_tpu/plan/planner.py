@@ -501,7 +501,9 @@ class Planner:
                 [Leg(lf.src, lf.ops, lex), Leg(rf.src, rf.ops, rex)],
                 [StageOp("join", {"left_keys": lkeys, "right_keys": rkeys,
                                   "out_capacity": out_cap,
-                                  "how": n.how})], "join")
+                                  "how": n.how,
+                                  "right_unique": n.right_unique})],
+                "join")
             # the executor may salt this stage's exchanges on hot-key skew
             # — only the 2-hash-exchange inner/left shape, and plan() later
             # clears it where downstream elimination assumed the placement

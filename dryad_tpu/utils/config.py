@@ -32,6 +32,13 @@ class JobConfig:
     max_capacity_retries: int = 3
     # initial send-slot slack factor for exchanges (C = ceil(slack*cap/D))
     initial_send_slack: int = 2
+    # exact-first-wave exchanges: pure repartition legs (no ops) whose
+    # input exceeds this many MB run a counts-only probe (one tiny
+    # program + one scalar fetch) so even the FIRST wave ships measured
+    # slots instead of the structural slack (the reference's pull
+    # shuffle ships exact file sizes, kernel/DrCluster.cpp:553-569).
+    # -1 disables; 0 probes always (wire_check/tests)
+    exchange_probe_min_mb: float = 8.0
     # on-device sample lanes per partition for range bounds
     # (DryadLinqSampler.cs:38 samples 0.1%; we take a fixed per-part cap)
     range_samples_per_partition: int = 4096
@@ -187,6 +194,8 @@ class JobConfig:
              "ooc_group_bucket_rows > 0"),
             (self.max_capacity_retries >= 0, "max_capacity_retries >= 0"),
             (self.initial_send_slack >= 1, "initial_send_slack >= 1"),
+            (self.exchange_probe_min_mb >= -1,
+             "exchange_probe_min_mb >= -1"),
             (self.range_samples_per_partition >= 2,
              "range_samples_per_partition >= 2"),
             (self.compile_cache_size >= 1, "compile_cache_size >= 1"),

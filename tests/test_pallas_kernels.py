@@ -349,3 +349,93 @@ def test_tokenize_letter_delims_match_unfused():
             int(np.asarray(out.columns["n"])[i])
     assert fused == dict(unfused)
     assert int(need) == 0
+
+
+def test_lookup_join_matches_general():
+    """right_unique joins (merge-fill path) equal the general hash_join
+    for inner and left, including unmatched-left zero fill; a duplicated
+    right side runtime-falls-back to the general path."""
+    from dryad_tpu.data.columnar import Batch, batch_from_numpy
+    from dryad_tpu.ops import kernels as k
+
+    rng = np.random.RandomState(7)
+    nl, nr = 3_000, 400
+    lk = rng.randint(0, 500, nl).astype(np.int32)   # some keys unmatched
+    left = Batch({"k": jnp.asarray(lk),
+                  "a": jnp.asarray(rng.randn(nl).astype(np.float32))},
+                 jnp.asarray(nl - 9, jnp.int32))
+    right = Batch({"k": jnp.asarray(np.arange(nr, dtype=np.int32)),
+                   "lab": jnp.asarray(rng.randint(0, 99, nr)
+                                      .astype(np.int32))},
+                  jnp.asarray(nr, jnp.int32))
+
+    def rows(b):
+        n = int(b.count)
+        return sorted(
+            (int(np.asarray(b.columns["k"])[i]),
+             round(float(np.asarray(b.columns["a"])[i]), 5),
+             int(np.asarray(b.columns["lab"])[i])) for i in range(n))
+
+    for how in ("inner", "left"):
+        gen, gneed = k.hash_join(left, right, ["k"], ["k"], 6000, how=how)
+        fast, fneed = k.hash_join(left, right, ["k"], ["k"], 6000,
+                                  how=how, right_unique=True)
+        assert rows(gen) == rows(fast), how
+        assert int(gneed) == int(fneed) == 0
+
+    # duplicate right keys: hint present, runtime falls back — result
+    # must still match the general path (with its multi-match expansion)
+    rdup = Batch({"k": jnp.asarray((np.arange(nr) // 2).astype(np.int32)),
+                  "lab": jnp.asarray(np.arange(nr, dtype=np.int32))},
+                 jnp.asarray(nr, jnp.int32))
+    gen, _ = k.hash_join(left, rdup, ["k"], ["k"], 12_000)
+    fast, _ = k.hash_join(left, rdup, ["k"], ["k"], 12_000,
+                          right_unique=True)
+    assert rows(gen) == rows(fast)
+
+
+def test_lookup_join_string_payload():
+    from dryad_tpu.data.columnar import batch_from_numpy
+    from dryad_tpu.ops import kernels as k
+
+    left = batch_from_numpy({"k": np.array([3, 1, 2, 1], np.int32),
+                             "v": np.array([10, 20, 30, 40], np.int32)})
+    right = batch_from_numpy({"k": np.array([1, 2, 3], np.int32),
+                              "name": ["one", "two", "three"]},
+                             str_max_len=8)
+    out, need = k.hash_join(left, right, ["k"], ["k"], 16,
+                            right_unique=True)
+    assert int(need) == 0 and int(out.count) == 4
+    got = {}
+    nc = out.columns["name"]
+    for i in range(4):
+        L = int(np.asarray(nc.lengths)[i])
+        got[int(np.asarray(out.columns["v"])[i])] = \
+            bytes(np.asarray(nc.data)[i, :L]).decode()
+    assert got == {10: "three", 20: "one", 30: "two", 40: "one"}
+
+
+def test_exact_first_wave_probe_equivalence():
+    """A pure repartition with the counts probe forced on (min_mb=0)
+    equals the structural-slack run (-1 disables), on the 8-device
+    mesh — the exact-first-wave path changes wire sizing only."""
+    from dryad_tpu import Context
+    from dryad_tpu.utils.config import JobConfig
+
+    rng = np.random.RandomState(8)
+    k = rng.randint(0, 5_000, 20_000).astype(np.int32)
+    v = rng.randint(0, 1 << 30, 20_000).astype(np.int32)
+
+    def run(min_mb):
+        ctx = Context(config=JobConfig(exchange_probe_min_mb=min_mb))
+        q = (ctx.from_columns({"k": k, "v": v})
+             .hash_partition(["k"])
+             .group_by(["k"], {"n": ("count", None), "s": ("sum", "v")}))
+        out = q.collect()
+        order = np.argsort(np.asarray(out["k"]))
+        return {c: np.asarray(out[c])[order] for c in ("k", "n", "s")}
+
+    a = run(-1.0)
+    b = run(0.0)
+    for c in ("k", "n", "s"):
+        np.testing.assert_array_equal(a[c], b[c])
