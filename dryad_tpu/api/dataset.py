@@ -264,9 +264,10 @@ class Context:
             # driver-side generator cannot execute on workers, so the
             # client SPOOLS the stream into a store the workers can
             # reach (JobConfig.cluster_stream_spool_dir — shared fs or
-            # s3://; default: a driver temp dir, valid for
-            # single-machine clusters) and the gang streams the store
-            # through the full planned surface (runtime/stream_plan.py).
+            # hdfs://; s3:// is rejected, no atomic chunk-stream commit;
+            # default: a driver temp dir, valid for single-machine
+            # clusters) and the gang streams the store through the full
+            # planned surface (runtime/stream_plan.py).
             import tempfile
             import uuid
 
@@ -347,7 +348,9 @@ class Context:
 
     def read(self, uri: str, **kw) -> "Dataset":
         """URI-scheme dispatch (DataProvider.cs / concreterchannel.cpp:44-49):
-        ``file://`` text, ``store://`` partitioned store, plus any scheme
+        ``file://`` text, ``store://`` partitioned store, ``http://``
+        ranged reads, ``s3://`` objects, ``hdfs://`` WebHDFS
+        (io/webhdfs.py — DrHdfsClient.cpp role), plus any scheme
         registered via io.providers.register_provider."""
         from dryad_tpu.io.providers import open_uri
         return open_uri(self, uri, **kw)
@@ -355,7 +358,10 @@ class Context:
     def from_store(self, path: str, capacity: int | None = None) -> "Dataset":
         """Load a persisted dataset (FromStore, DryadLinqContext.cs:1176).
         Persisted partitioning metadata is honored for shuffle elimination
-        (AssumeHashPartition parity, DryadLinqQueryable.cs:3408)."""
+        (AssumeHashPartition parity, DryadLinqQueryable.cs:3408).
+        ``path`` may be local, ``s3://``, or ``hdfs://`` (io/store.py
+        scheme dispatch); the same goes for ``read_store_stream`` and
+        ``to_store``."""
         from dryad_tpu.io.store import read_store, store_meta
         meta = store_meta(path)
         auto = self.config.ooc_auto_stream_rows
@@ -671,7 +677,16 @@ class Dataset:
 
         An agg value may also be a ``Decomposable(seed, merge, finalize)``
         for user-defined aggregation (IDecomposable.cs:34 parity) — see
-        ``dryad_tpu.Decomposable``."""
+        ``dryad_tpu.Decomposable``.
+
+        NaN caveat: ``min``/``max`` over float columns containing NaN are
+        LOWERING-DEPENDENT.  The segmented-scan path accumulates with
+        jnp.minimum/jnp.maximum (NaN propagates into the group result);
+        the boundary-carry fast path rides the value through a sort lane
+        ordered by IEEE totalOrder (-NaN below -inf, +NaN above +inf),
+        so a NaN may or may not surface depending on its sign bit.
+        Neither matches a NaN-IGNORING host nanmin/nanmax — filter NaNs
+        first when their handling matters."""
         return Dataset(self.ctx, E.GroupByAgg(
             parents=(self.node,), keys=tuple(keys), aggs=dict(aggs)))
 
@@ -742,7 +757,18 @@ class Dataset:
         unmatched right rows (left non-key columns zero-filled, left key
         columns carrying the right key values); "full" keeps both.
         Broadcast is only honored for inner/left (a replicated right side
-        cannot detect its unmatched rows without duplication)."""
+        cannot detect its unmatched rows without duplication).
+
+        ``right_unique=True`` (inner/left only) declares the right side
+        unique-keyed (lookup/dimension table) and routes matching through
+        the gather-free merge-fill kernel (ops/kernels._lookup_join).
+        Uniqueness itself is runtime-verified (duplicates fall back to
+        the general kernel in the same compiled program), but MATCHING on
+        that path is by 64-bit key hash ONLY — two distinct keys
+        agreeing in all 64 hash bits would mis-join, a ~n^2/2^-64
+        probability budget (the same one group_by/distinct document).
+        The default path compares true key bytes; keep right_unique off
+        for adversarially constructed keys."""
         return Dataset(self.ctx, E.Join(
             parents=(self.node, other.node), left_keys=tuple(left_keys),
             right_keys=tuple(right_keys or left_keys),

@@ -414,6 +414,16 @@ class Executor:
         from collections import OrderedDict
         self._compile_cache: "OrderedDict[Any, Callable]" = OrderedDict()
         self._compile_cache_max = self.config.compile_cache_size
+        # measured slot-probe RESULTS keyed by (keys, slack, schema, the
+        # input's device buffer identities): an iterative job re-running
+        # the same stage over the SAME buffers (do_while loop state that
+        # a body leg reads unchanged) skips the probe's blocking
+        # device->host scalar fetch on every superstep.  Entries carry
+        # WEAKREFS to the probed buffers: an id() is only recycled after
+        # its original object died, so "all referents alive" proves the
+        # keyed ids still name the probed arrays — a dead ref evicts the
+        # entry instead of replaying a stale hint for different data.
+        self._slot_probe_cache: "OrderedDict[Any, tuple]" = OrderedDict()
 
     def apply_config(self, config) -> None:
         """Re-point a persistent executor at a new job's JobConfig (worker
@@ -643,6 +653,18 @@ class Executor:
         sig = tuple(sorted((k, str(jnp.shape(v)),
                             str(getattr(v, "dtype", "str")))
                            for k, v in b0.columns.items()))
+        # result cache: same keys + slack over the same live device
+        # buffers -> same measured slots, no device->host sync
+        import weakref
+        leaves = jax.tree.leaves(b0)
+        rkey = (tuple(keys), slack, sig, tuple(id(x) for x in leaves))
+        hit = self._slot_probe_cache.get(rkey)
+        if hit is not None:
+            rows, refs = hit
+            if all(r() is not None for r in refs):
+                self._slot_probe_cache.move_to_end(rkey)
+                return rows
+            del self._slot_probe_cache[rkey]   # recycled id: not a hit
         key = ("slot_probe", tuple(keys), sig)
         fn = self._compile_cache.get(key)
         if fn is None:
@@ -664,7 +686,15 @@ class Executor:
         slot = int(np.asarray(fn(b0)).max())
         c_struct = max(1, -(-slack * cap // D))
         q = max(16, c_struct // 16)
-        return max(1, min(c_struct, -(-slot // q) * q))
+        rows = max(1, min(c_struct, -(-slot // q) * q))
+        try:
+            refs = tuple(weakref.ref(x) for x in leaves)
+        except TypeError:
+            return rows   # unexpected non-weakreffable leaf: don't cache
+        self._slot_probe_cache[rkey] = (rows, refs)
+        while len(self._slot_probe_cache) > 256:
+            self._slot_probe_cache.popitem(last=False)
+        return rows
 
     def _slot_hints(self, stage: Stage, inputs, slack: int,
                     salted: bool) -> tuple:

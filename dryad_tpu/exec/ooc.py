@@ -179,6 +179,12 @@ class ChunkSource:
     iterator per call.
     """
 
+    # uncompressed hdfs:// partitions at least this big stream via
+    # unverifiable ranged reads instead of the whole-part verified read
+    # (see from_store) — sized so anything comfortably holdable in host
+    # RAM keeps its checksum protection
+    RANGED_STREAM_MIN_BYTES = 256 << 20
+
     def __init__(self, make_iter: Callable[[], Iterator[HChunk]],
                  schema: Dict[str, Any], chunk_rows: int):
         self._make_iter = make_iter
@@ -234,10 +240,20 @@ class ChunkSource:
                    ) -> "ChunkSource":
         """Stream a persisted store (io/store.py layout) partition by
         partition, slicing each into chunks.  Individual partitions must fit
-        host RAM; the dataset as a whole need not.  ``partitions`` restricts
-        to the listed store partitions (the per-worker subset of a cluster
+        host RAM; the dataset as a whole need not — EXCEPT uncompressed
+        ``hdfs://`` partitions past ``RANGED_STREAM_MIN_BYTES``, which
+        stream through bounded ranged reads (one HTTP range per column
+        segment per chunk), so even a single partition larger than host
+        RAM flows chunk-wise (channelbufferhdfs.cpp:69-97 block-read
+        role).  Per-partition checksums cannot be verified on that ranged
+        path — they cover whole segments the stream never materializes —
+        so partitions BELOW the threshold take the whole-part verified
+        read like every other store.  ``partitions`` restricts to the
+        listed store partitions (the per-worker subset of a cluster
         streamed job)."""
         from dryad_tpu.io.store import (_alloc_part_views, _part_path,
+                                        is_remote_store,
+                                        remote_read_part_views,
                                         store_meta, verify_checksums)
         from dryad_tpu import native
 
@@ -246,12 +262,36 @@ class ChunkSource:
         part_ids = (list(range(meta["npartitions"]))
                     if partitions is None else list(partitions))
 
+        ranged_parts: set = set()
+        if (path.startswith("hdfs://")
+                and meta.get("compression") != "gzip"):
+            row_bytes = 0
+            for spec in schema.values():
+                if spec["kind"] == "str":
+                    row_bytes += int(spec["max_len"]) + 4
+                else:
+                    n_el = 1
+                    for d in spec.get("shape", ()):
+                        n_el *= int(d)
+                    row_bytes += np.dtype(spec["dtype"]).itemsize * n_el
+            ranged_parts = {
+                p for p in part_ids
+                if meta["counts"][p] * row_bytes
+                >= ChunkSource.RANGED_STREAM_MIN_BYTES}
+
         def it():
             for p in part_ids:
                 cnt = meta["counts"][p]
-                if path.startswith("s3://"):
-                    from dryad_tpu.io.s3_store import s3_read_part_views
-                    segs, cols = s3_read_part_views(path, meta, p)
+                if p in ranged_parts:
+                    # integrity trade documented above: too big to hold,
+                    # so stream unverified ranged chunks
+                    from dryad_tpu.io.webhdfs import hdfs_part_chunks
+                    for cols, n in hdfs_part_chunks(path, meta, p,
+                                                    chunk_rows):
+                        yield HChunk(cols, n)
+                    continue
+                if is_remote_store(path):
+                    segs, cols = remote_read_part_views(path, meta, p)
                 else:
                     segs, cols = _alloc_part_views(schema, cnt)
                     native.read_files(
@@ -1092,13 +1132,12 @@ def write_chunks_to_store(path: str, chunks: Iterable[HChunk],
                           compression: Optional[str] = None
                           ) -> Dict[str, Any]:
     """Stream chunks to a store directory (io/store.py layout), one
-    partition file per chunk, committed atomically via temp-dir rename."""
+    partition file per chunk, committed atomically via temp-dir rename
+    (``hdfs://`` targets commit the same way through the WebHDFS
+    adapter's rename; each chunk uploads as soon as it is drained, so
+    host memory stays O(chunk_rows) on the write side too)."""
     from dryad_tpu import native
 
-    tmp = path + ".tmp"
-    os.makedirs(tmp, exist_ok=True)
-    counts: List[int] = []
-    checksums: List[str] = []
     store_schema: Dict[str, Any] = {}
     for k, spec in schema.items():
         if spec["kind"] == "str":
@@ -1106,16 +1145,25 @@ def write_chunks_to_store(path: str, chunks: Iterable[HChunk],
         else:
             store_schema[k] = {"kind": "dense", "dtype": spec["dtype"],
                                "shape": list(spec.get("shape", ()))}
+    if path.startswith("hdfs://"):
+        from dryad_tpu.io.webhdfs import _write_chunks_hdfs
+        return _write_chunks_hdfs(path, chunks, store_schema,
+                                  partitioning=partitioning,
+                                  compression=compression)
+    if path.startswith("s3://"):
+        raise OOCError(
+            "streamed writes to s3:// are not supported (no atomic "
+            "multi-object commit for an unbounded chunk stream); "
+            "to_store to a local or hdfs:// path instead")
+    from dryad_tpu.io.store import chunk_segments
+
+    tmp = path + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    counts: List[int] = []
+    checksums: List[str] = []
     p = 0
     for chunk in chunks:
-        segs: List[np.ndarray] = []
-        for k in sorted(store_schema):
-            v = chunk.cols[k]
-            if store_schema[k]["kind"] == "str":
-                segs.append(np.ascontiguousarray(v[0]))
-                segs.append(np.ascontiguousarray(v[1]))
-            else:
-                segs.append(np.ascontiguousarray(v))
+        segs = chunk_segments(store_schema, chunk.cols)
         native.write_files([os.path.join(tmp, f"part-{p:05d}.bin")], [segs],
                            compress=(compression == "gzip"))
         checksums.append("%016x" % native.checksum_segments(segs))

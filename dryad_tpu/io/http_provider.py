@@ -118,38 +118,12 @@ def http_provider(ctx, rest: str, column: str = "line",
                   block: int = _DEFAULT_BLOCK):
     """io.providers entry: ``ctx.read("http://host/path")``.  A trailing
     ``/`` enumerates partition files; bodies arrive via ranged GETs,
-    partitions fetched in parallel (per-channel IO thread role, as the
-    local read_text_files pool)."""
-    import concurrent.futures
-
-    import numpy as np
-
-    from dryad_tpu import native
+    partitions fetched in parallel (per-channel IO thread role, the
+    shared remote-provider tail)."""
+    from dryad_tpu.io.providers import text_dataset_from_fetches
 
     url = "http://" + rest
-    max_line_len = max_line_len or ctx.config.text_max_line_len
     urls = enumerate_http(url)   # raises on an empty listing
-
-    def fetch_pack(u: str):
-        return native.pack_lines(read_url_bytes(u, block=block),
-                                 max_line_len)
-
-    if len(urls) == 1:
-        packed = [fetch_pack(urls[0])]
-    else:
-        with concurrent.futures.ThreadPoolExecutor(
-                max_workers=min(8, len(urls))) as pool:
-            packed = list(pool.map(fetch_pack, urls))
-    data = np.concatenate([d for d, _ in packed], axis=0)
-    lens = np.concatenate([l for _, l in packed])
-    if ctx.cluster is not None:
-        # cluster mode: the driver fetched the bytes; ship them as an
-        # ordinary columns source
-        rows = [bytes(r[:n]) for r, n in zip(data, lens)]
-        return ctx.from_columns({column: rows},
-                                str_max_len=max_line_len)
-    from dryad_tpu.exec.data import pdata_from_packed_strings
-    pdata = pdata_from_packed_strings(data, lens, ctx.mesh, column=column)
-    host = ({column: [bytes(r[:n]) for r, n in zip(data, lens)]}
-            if ctx.local_debug else None)
-    return ctx.from_pdata(pdata, host=host)
+    return text_dataset_from_fetches(
+        ctx, [lambda u=u: read_url_bytes(u, block=block) for u in urls],
+        column, max_line_len)

@@ -68,23 +68,15 @@ def s3_write_store(url: str, pd, partitioning=None, compression=None,
                    client: Optional[S3Client] = None) -> None:
     """write_store for s3:// paths (same segments, checksums, meta)."""
     from dryad_tpu import native
-    from dryad_tpu.data.columnar import StringColumn
-    from dryad_tpu.io.store import (_col_order, _part_segments_for_write,
-                                    build_meta)
+    from dryad_tpu.io.store import (_part_segments_for_write, build_meta,
+                                    pdata_schema, segments_blob)
 
     if compression not in (None, "gzip"):
         raise ValueError(f"unknown compression {compression!r}")
     c = client or s3_client()
     bucket, prefix = parse_s3_url(url)
     counts = np.asarray(pd.counts)
-    schema: Dict[str, Any] = {}
-    for k, v in pd.batch.columns.items():
-        if isinstance(v, StringColumn):
-            schema[k] = {"kind": "str", "max_len": int(v.data.shape[2])}
-        else:
-            arr_dtype = np.dtype(str(np.asarray(v[0, :1]).dtype))
-            schema[k] = {"kind": "dense", "dtype": arr_dtype.name,
-                         "shape": list(v.shape[2:])}
+    schema = pdata_schema(pd)
     import uuid
     gen = uuid.uuid4().hex[:12]
     checksums: List[str] = []
@@ -92,10 +84,8 @@ def s3_write_store(url: str, pd, partitioning=None, compression=None,
         segs = _part_segments_for_write(pd.batch, schema, p,
                                         int(counts[p]))
         checksums.append("%016x" % native.checksum_segments(segs))
-        blob = b"".join(np.ascontiguousarray(s).tobytes() for s in segs)
-        if compression == "gzip":
-            blob = gzip.compress(blob, compresslevel=1)
-        c.put_object(bucket, _part_key(prefix, p, gen), blob)
+        c.put_object(bucket, _part_key(prefix, p, gen),
+                     segments_blob(segs, compression))
     meta = build_meta(schema, counts.tolist(), checksums,
                       partitioning=partitioning, compression=compression,
                       capacity=pd.capacity)
@@ -148,15 +138,8 @@ def write_partition_objects(url: str, schema, blobs: List[bytes],
 
 
 def _fill_segments(segs: List[np.ndarray], data: bytes) -> None:
-    off = 0
-    for s in segs:
-        nb = s.nbytes
-        flat = np.frombuffer(data[off:off + nb], dtype=s.dtype)
-        s.reshape(-1)[:] = flat
-        off += nb
-    if off != len(data):
-        raise IOError(f"s3 partition size mismatch: expected {off} bytes, "
-                      f"object has {len(data)}")
+    from dryad_tpu.io.store import fill_segments
+    fill_segments(segs, data, "s3 object")
 
 
 def s3_read_part_segments(url: str, meta: Dict[str, Any], p: int,

@@ -29,9 +29,29 @@ from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.exec.data import PData
 
 __all__ = ["write_store", "read_store", "store_meta", "build_meta",
-           "StoreIntegrityError"]
+           "StoreIntegrityError", "is_remote_store",
+           "remote_read_part_views"]
 
 _FORMAT_VERSION = 3
+
+_REMOTE_SCHEMES = ("s3://", "hdfs://")
+
+
+def is_remote_store(path: str) -> bool:
+    """True for store paths served by a remote-storage adapter (s3://
+    object stores, hdfs:// WebHDFS) rather than the local filesystem."""
+    return path.startswith(_REMOTE_SCHEMES)
+
+
+def remote_read_part_views(path: str, meta: Dict[str, Any], p: int):
+    """(segments, column views) of one remote partition — the shared
+    building block of read_store and ooc.ChunkSource.from_store
+    (DataProvider.cs scheme dispatch, read side)."""
+    if path.startswith("s3://"):
+        from dryad_tpu.io.s3_store import s3_read_part_views
+        return s3_read_part_views(path, meta, p)
+    from dryad_tpu.io.webhdfs import hdfs_read_part_views
+    return hdfs_read_part_views(path, meta, p)
 
 
 class StoreIntegrityError(RuntimeError):
@@ -72,6 +92,65 @@ def _col_order(schema: Dict[str, Any]) -> List[str]:
     return sorted(schema.keys())
 
 
+def pdata_schema(pd: "PData") -> Dict[str, Any]:
+    """Store schema of a PData's columns — the ONE schema-inference
+    point shared by every store writer (local, s3://, hdfs://), so a
+    new column kind cannot diverge between adapters."""
+    schema: Dict[str, Any] = {}
+    for k, v in pd.batch.columns.items():
+        if isinstance(v, StringColumn):
+            schema[k] = {"kind": "str", "max_len": int(v.data.shape[2])}
+        else:
+            arr_dtype = np.dtype(str(np.asarray(v[0, :1]).dtype))
+            schema[k] = {"kind": "dense", "dtype": arr_dtype.name,
+                         "shape": list(v.shape[2:])}
+    return schema
+
+
+def chunk_segments(schema: Dict[str, Any],
+                   cols: Dict[str, Any]) -> List[np.ndarray]:
+    """One host chunk's column segments in file order (sorted columns,
+    strings as data+lengths) — the write-side counterpart of
+    ``_alloc_part_views``, shared by every chunk writer."""
+    segs: List[np.ndarray] = []
+    for k in _col_order(schema):
+        v = cols[k]
+        if schema[k]["kind"] == "str":
+            segs.append(np.ascontiguousarray(v[0]))
+            segs.append(np.ascontiguousarray(v[1]))
+        else:
+            segs.append(np.ascontiguousarray(v))
+    return segs
+
+
+def segments_blob(segs: List[np.ndarray],
+                  compression: Optional[str]) -> bytes:
+    """Serialize part segments to the single on-wire blob encoding every
+    remote writer ships (and verify_checksums' layout assumes)."""
+    import gzip
+    blob = b"".join(np.ascontiguousarray(s).tobytes() for s in segs)
+    if compression == "gzip":
+        blob = gzip.compress(blob, compresslevel=1)
+    return blob
+
+
+def fill_segments(segs: List[np.ndarray], data: bytes, what: str) -> None:
+    """Fill preallocated part segments from one (decompressed) blob —
+    the read-side inverse of ``segments_blob``, shared by the remote
+    adapters.  Size check FIRST: short (truncated/corrupt) data would
+    otherwise crash inside np.frombuffer with an error naming no file;
+    ``what`` names the part in the diagnostic."""
+    expected = sum(s.nbytes for s in segs)
+    if expected != len(data):
+        raise IOError(f"partition size mismatch: expected {expected} "
+                      f"bytes, {what} holds {len(data)}")
+    off = 0
+    for s in segs:
+        nb = s.nbytes
+        s.reshape(-1)[:] = np.frombuffer(data[off:off + nb], dtype=s.dtype)
+        off += nb
+
+
 def _part_segments_for_write(batch: Batch, schema, p: int, n: int
                              ) -> List[np.ndarray]:
     """Column blobs of partition p, valid rows only, in sorted-column order."""
@@ -104,17 +183,15 @@ def write_store(path: str, pd: PData,
         from dryad_tpu.io.s3_store import s3_write_store
         return s3_write_store(path, pd, partitioning=partitioning,
                               compression=compression)
+    if path.startswith("hdfs://"):
+        # hdfs adapter: same layout as files, temp-dir rename commit
+        from dryad_tpu.io.webhdfs import hdfs_write_store
+        return hdfs_write_store(path, pd, partitioning=partitioning,
+                                compression=compression)
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     counts = np.asarray(pd.counts)
-    schema: Dict[str, Any] = {}
-    for k, v in pd.batch.columns.items():
-        if isinstance(v, StringColumn):
-            schema[k] = {"kind": "str", "max_len": int(v.data.shape[2])}
-        else:
-            arr_dtype = np.dtype(str(np.asarray(v[0, :1]).dtype))
-            schema[k] = {"kind": "dense", "dtype": arr_dtype.name,
-                         "shape": list(v.shape[2:])}
+    schema = pdata_schema(pd)
     paths, segments = [], []
     for p in range(pd.nparts):
         paths.append(_part_path(tmp, p))
@@ -139,6 +216,9 @@ def store_meta(path: str) -> Dict[str, Any]:
     if path.startswith("s3://"):
         from dryad_tpu.io.s3_store import s3_store_meta
         return s3_store_meta(path)
+    if path.startswith("hdfs://"):
+        from dryad_tpu.io.webhdfs import hdfs_store_meta
+        return hdfs_store_meta(path)
     with open(os.path.join(path, "meta.json")) as f:
         return json.load(f)
 
@@ -207,10 +287,9 @@ def read_store(path: str, mesh, capacity: Optional[int] = None,
     nparts = mesh.devices.size
 
     paths, segments, partviews = [], [], []
-    if path.startswith("s3://"):
-        from dryad_tpu.io.s3_store import s3_read_part_views
+    if is_remote_store(path):
         for p in part_ids:
-            segs, cols = s3_read_part_views(path, meta, p)
+            segs, cols = remote_read_part_views(path, meta, p)
             segments.append(segs)
             partviews.append(cols)
     else:

@@ -319,10 +319,14 @@ def tokenize_group_count(batch: Batch, column: str, out_capacity: int,
                 jnp.minimum(num_groups, V))
     need = jnp.where(num_tokens > out_capacity, num_tokens, 0)
     # ceil-factor FIRST: num_groups * out_capacity overflows int32 in
-    # exactly the regime where this branch fires
-    need = jnp.where(num_groups > V,
-                     jnp.maximum(need, (-(-num_groups // V))
-                                 * out_capacity),
-                     need)
+    # exactly the regime where this branch fires — and even the factored
+    # product can wrap for extreme group counts, so the multiply is
+    # clamped to int32 max (a saturated NEED still tells the caller "far
+    # too small"; a wrapped NEGATIVE need would read as "fits")
+    imax = jnp.int32(jnp.iinfo(jnp.int32).max)
+    factor = -(-num_groups // V)
+    vocab_need = jnp.where(factor > imax // out_capacity, imax,
+                           factor * out_capacity)
+    need = jnp.where(num_groups > V, jnp.maximum(need, vocab_need), need)
     need = jnp.where(over_row, jnp.maximum(need, out_capacity * 2), need)
     return out, need.astype(jnp.int32)

@@ -293,6 +293,15 @@ class LocalCluster(ClusterBackend):
         out.update(self._elastic_procs)
         return out
 
+    def worker_hosts(self) -> Dict[int, str]:
+        """pid -> machine name, for block->host locality hints (the
+        reference's computer table feeding affinity resolution,
+        Interfaces.cs:98-152).  Every LocalCluster worker runs on this
+        machine; SshCluster overrides with the per-worker remote host."""
+        import socket as _socket
+        host = _socket.gethostname()
+        return {pid: host for pid in self._socks}
+
     def _check_deaths(self, during_startup: bool = False) -> None:
         for pid, proc in enumerate(self._procs):
             if proc.poll() is not None:

@@ -32,23 +32,43 @@ class FarmError(RuntimeError):
     pass
 
 
+def _norm_host(h: str) -> str:
+    """Case-insensitive, FQDN-insensitive host matching: block reports
+    may say ``dn0.cluster.local`` while the worker registered ``dn0``.
+    IP addresses keep all their dots (stripping ``10.0.0.4`` to ``10``
+    would collide every same-subnet worker)."""
+    h = h.strip().lower()
+    first = h.split(".", 1)[0]
+    return h if first.isdigit() else first
+
+
 class _Task:
     __slots__ = ("idx", "sources", "runs", "delays", "result", "duplicated",
-                 "preferred")
+                 "pref")
 
-    def __init__(self, idx: int, sources: Dict[str, Dict[str, Any]]):
+    def __init__(self, idx: int, sources: Dict[str, Dict[str, Any]],
+                 host_pids: Dict[str, set]):
         self.idx = idx
         self.sources = sources
         self.runs: Dict[int, float] = {}   # worker -> dispatch time
         self.delays: Dict[int, float] = {}  # worker -> commanded test delay
         self.result: Optional[Dict[str, Any]] = None
         self.duplicated = False
-        # soft locality hint from the task's source specs (the worker that
-        # holds the store partitions; Interfaces.cs:98-152 affinity role)
-        self.preferred: Optional[int] = next(
-            (s["preferred_worker"] for s in sources.values()
-             if isinstance(s, dict)
-             and s.get("preferred_worker") is not None), None)
+        # soft locality hints from the task's source specs: an explicit
+        # worker pid (the worker that wrote/holds the store partitions)
+        # and/or block-holding HOST names (hdfs GETFILEBLOCKLOCATIONS
+        # metadata) resolved to worker pids through the cluster's
+        # worker->host map (Interfaces.cs:98-152 affinity-list role).
+        # Unknown hosts resolve to nothing — a hint can never make a
+        # task undispatchable.
+        self.pref: set = set()
+        for s in sources.values():
+            if not isinstance(s, dict):
+                continue
+            if s.get("preferred_worker") is not None:
+                self.pref.add(s["preferred_worker"])
+            for h in (s.get("preferred_hosts") or ()):
+                self.pref |= host_pids.get(_norm_host(h), set())
 
 
 class TaskFarm:
@@ -70,7 +90,8 @@ class TaskFarm:
                  rel_margin: Optional[float] = None,
                  abs_margin_s: Optional[float] = None,
                  config=None,
-                 delay_hook: Optional[Callable[[int, int], float]] = None):
+                 delay_hook: Optional[Callable[[int, int], float]] = None,
+                 worker_hosts: Optional[Dict[int, str]] = None):
         from dryad_tpu.utils.config import JobConfig
         cfg = config or JobConfig()
         self.config = cfg
@@ -92,6 +113,11 @@ class TaskFarm:
         # test hook: delay_hook(task_idx, worker_id) -> seconds the worker
         # should sleep before executing (simulates a slow machine)
         self.delay_hook = delay_hook
+        # worker pid -> machine name, for resolving block->host locality
+        # hints (source spec ``preferred_hosts``) to dispatchable workers;
+        # defaults to the cluster's own map (LocalCluster: every worker on
+        # this machine; SshCluster: the per-worker remote host)
+        self.worker_hosts = worker_hosts
         self.events: List[dict] = []
 
     def _emit(self, e: dict) -> None:
@@ -122,7 +148,14 @@ class TaskFarm:
         if not cl.alive():
             cl.restart()
         job = cl.next_job_id()
-        tasks = [_Task(i, s) for i, s in enumerate(per_task_sources)]
+        hosts = (self.worker_hosts if self.worker_hosts is not None
+                 else (cl.worker_hosts()
+                       if hasattr(cl, "worker_hosts") else {}))
+        host_pids: Dict[str, set] = {}
+        for pid, h in hosts.items():
+            host_pids.setdefault(_norm_host(h), set()).add(pid)
+        tasks = [_Task(i, s, host_pids)
+                 for i, s in enumerate(per_task_sources)]
         todo: List[_Task] = list(tasks)
         n_done = 0
         durations: List[float] = []
@@ -228,17 +261,22 @@ class TaskFarm:
             # reassigned by worker-loss/timeout may since have finished via
             # a surviving duplicate — skip those.  Locality-aware matching:
             # an idle worker takes a task that PREFERS it when one exists
-            # (data it already holds), but preference never blocks — an
+            # (an explicit worker hint, or a block->host hint resolving to
+            # that worker's machine), but preference never blocks — an
             # idle worker with no preferring task takes the queue head
             # (fall back freely; reference weighted affinity,
             # Interfaces.cs:98-152)
             while todo and idle:
-                pair = next(((t for t in todo
-                              if t.result is None and t.preferred in idle)),
+                pair = next((t for t in todo
+                             if t.result is None and t.pref & idle),
                             None)
                 if pair is not None:
                     todo.remove(pair)
-                    if not dispatch(pair, pair.preferred):
+                    pid = min(pair.pref & idle)
+                    if dispatch(pair, pid):
+                        self._emit({"event": "task_locality_dispatch",
+                                    "task": pair.idx, "worker": pid})
+                    else:
                         todo.insert(0, pair)
                     continue
                 t = todo.pop(0)

@@ -12,7 +12,8 @@ from __future__ import annotations
 from typing import Any, Dict, Mapping
 
 __all__ = ["DeferredSource", "columns_spec", "text_spec", "store_spec",
-           "preferred_worker_for_partitions", "build_source", "count_lines",
+           "preferred_worker_for_partitions", "locality_hints_for_store",
+           "farm_store_tasks", "build_source", "count_lines",
            "MissingResidentToken"]
 
 
@@ -106,13 +107,17 @@ def text_spec(path, nparts: int, column: str = "line",
 def store_spec(path: str, nparts: int, meta: Dict[str, Any],
                capacity: int | None = None,
                partitions: list | None = None,
-               preferred_worker: int | None = None) -> Dict[str, Any]:
+               preferred_worker: int | None = None,
+               preferred_hosts: list | None = None) -> Dict[str, Any]:
     """``partitions`` restricts to the listed store partitions — the
     per-task input granularity for farming a big store (one task per
     partition group, DrPartitionFile.cpp:607 role).  ``preferred_worker``
-    is a soft locality hint the task farm honors when that worker is
-    available (the reference's weighted affinity lists from block
-    locations, ClusterInterface/Interfaces.cs:98-152)."""
+    (a worker pid) and ``preferred_hosts`` (machine names holding the
+    partitions' blocks, e.g. from hdfs GETFILEBLOCKLOCATIONS via
+    ``locality_hints_for_store``) are soft locality hints the task farm
+    honors when a matching worker is available (the reference's weighted
+    affinity lists from block locations,
+    ClusterInterface/Interfaces.cs:98-152)."""
     counts = meta.get("counts", [])
     if partitions is not None:
         counts = [counts[p] for p in partitions]
@@ -123,7 +128,65 @@ def store_spec(path: str, nparts: int, meta: Dict[str, Any],
         cap = capacity or _block_capacity(sum(counts), nparts)
     return {"kind": "store", "path": path, "capacity": cap,
             "partitions": partitions,
-            "preferred_worker": preferred_worker}
+            "preferred_worker": preferred_worker,
+            "preferred_hosts": (list(preferred_hosts)
+                                if preferred_hosts else None)}
+
+
+def farm_store_tasks(path: str, src_key: str, nparts_local: int,
+                     meta: Dict[str, Any] | None = None,
+                     group_size: int = 1,
+                     n_processes: int | None = None) -> list:
+    """Per-task source specs for farming a partitioned store over a
+    TaskFarm: one task per ``group_size`` store partitions (the
+    reference's one-vertex-per-partition-file model,
+    DrPartitionFile.cpp:607), each spec carrying the best available
+    locality hint — block->host hints for ``hdfs://`` stores
+    (GETFILEBLOCKLOCATIONS via ``locality_hints_for_store``), writer
+    affinity for local parallel-output stores (pass ``n_processes``).
+    This is the production entry of the locality chain:
+    ``TaskFarm(cl).run(plan_json, farm_store_tasks(...))``.
+
+    ``src_key`` is the plan's source binding key (from
+    shiplan.serialize_for_cluster); ``nparts_local`` the per-worker
+    partition count (cluster.devices_per_process for local worker
+    meshes)."""
+    import concurrent.futures
+
+    from dryad_tpu.io.store import store_meta
+    meta = meta or store_meta(path)
+    nparts = meta["npartitions"]
+    groups = [list(range(i, min(i + group_size, nparts)))
+              for i in range(0, nparts, group_size)]
+    # hint lookups hit the namenode once per partition — prefetch all
+    # groups concurrently so a 1000-partition store's farm setup isn't
+    # serialized on HTTP round trips
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, max(len(groups), 1))) as pool:
+        all_hints = list(pool.map(
+            lambda g: locality_hints_for_store(path, g, meta), groups))
+    tasks = []
+    for g, hosts in zip(groups, all_hints):
+        w = (preferred_worker_for_partitions(g, nparts, n_processes)
+             if n_processes else None)
+        tasks.append({src_key: store_spec(
+            path, nparts_local, meta, partitions=g,
+            preferred_worker=w, preferred_hosts=hosts or None)})
+    return tasks
+
+
+def locality_hints_for_store(path: str, partitions,
+                             meta: Dict[str, Any] | None = None
+                             ) -> list:
+    """Block->host locality hints for the given store partitions, for
+    ``store_spec(..., preferred_hosts=)``.  Real for ``hdfs://`` stores
+    (GETFILEBLOCKLOCATIONS block->host metadata, DrHdfsClient.cpp role);
+    empty for stores without host-addressed blocks (local fs, s3) —
+    locality is always a HINT, never a requirement."""
+    if path.startswith("hdfs://"):
+        from dryad_tpu.io.webhdfs import hdfs_preferred_hosts
+        return hdfs_preferred_hosts(path, partitions)
+    return []
 
 
 def preferred_worker_for_partitions(partitions, npartitions: int,

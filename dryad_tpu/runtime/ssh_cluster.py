@@ -149,6 +149,16 @@ class SshCluster(LocalCluster):
         super().__init__(n_processes=len(self.hosts),
                          devices_per_process=devices_per_process, **kw)
 
+    def worker_hosts(self):
+        """pid -> remote host: gang workers map onto their ssh target,
+        elastic joiners (add_worker, local) onto this machine — the map
+        block->host locality hints resolve against (runtime/farm.py;
+        Interfaces.cs:98-152 affinity role)."""
+        import socket as _socket
+        local = _socket.gethostname()
+        return {pid: (self.hosts[pid] if pid < len(self.hosts) else local)
+                for pid in self._socks}
+
     # -- staging (PeloponneseJobSubmission.cs:111-147 role) ----------------
 
     def _stage(self, host: str) -> None:

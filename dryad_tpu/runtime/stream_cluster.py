@@ -192,29 +192,59 @@ def _write_partitions(out_path: str, schema, part_chunks, part_ids,
     and checksums are allgathered; process 0 merges meta.json and commits
     the rename (parallel output — DrOutputVertex per-vertex writers,
     DrVertex.h:325-351 — instead of funneling through one process).
-    Checksums cover the UNCOMPRESSED segments (store read contract)."""
+    Checksums cover the UNCOMPRESSED segments (store read contract).
+
+    ``hdfs://`` targets write the same way — every worker uploads ITS
+    OWN partitions through the WebHDFS adapter into the shared temp
+    directory, process 0 commits meta + the (atomic) HDFS rename — the
+    reference's per-vertex HDFS output writers (DrHdfsClient.cpp write
+    side, channelbufferhdfs.cpp)."""
     import jax
     from dryad_tpu import native
     from dryad_tpu.exec import ooc
 
     if compression not in (None, "gzip"):
         raise StreamJobError(f"unknown compression {compression!r}")
-    tmp = out_path + ".tmp"
-    os.makedirs(tmp, exist_ok=True)
+    hdfs = out_path.startswith("hdfs://")
+    if out_path.startswith("s3://"):
+        raise StreamJobError(
+            "cluster parallel output to s3:// is not supported (no "
+            "atomic multi-object commit across writers); use a shared "
+            "filesystem or hdfs:// target")
+    from dryad_tpu.io.store import chunk_segments, segments_blob
+    if hdfs:
+        from dryad_tpu.io.webhdfs import hdfs_client, hdfs_part_path
+        hc, hpath = hdfs_client(out_path)
+        hpath = hpath.rstrip("/")
+        tmp = hpath + ".tmp"
+    else:
+        tmp = out_path + ".tmp"
+    # clear any stale temp dir from a crashed previous job BEFORE anyone
+    # uploads, behind a barrier — a leftover part-NNNNN.bin from a dead
+    # run with more partitions would otherwise ride the rename into the
+    # committed store.  Process 0 clears; the allgather is the fence.
+    if jax.process_index() == 0:
+        if hdfs:
+            hc.delete(tmp, recursive=True)
+        elif os.path.exists(tmp):
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+    _host_allgather(np.zeros((1,), np.int32), mesh)
+    if hdfs:
+        hc.mkdirs(tmp)   # idempotent; every writer may race to create it
+    else:
+        os.makedirs(tmp, exist_ok=True)
     my_counts: List[int] = []
     my_sums: List[int] = []
     for g, chunks in zip(part_ids, part_chunks):
         merged = ooc._concat_hchunks(schema, list(chunks))
-        segs: List[np.ndarray] = []
-        for k in sorted(schema):
-            v = merged.cols[k]
-            if schema[k]["kind"] == "str":
-                segs.append(np.ascontiguousarray(v[0]))
-                segs.append(np.ascontiguousarray(v[1]))
-            else:
-                segs.append(np.ascontiguousarray(v))
-        native.write_files([os.path.join(tmp, f"part-{g:05d}.bin")],
-                           [segs], compress=(compression == "gzip"))
+        segs = chunk_segments(schema, merged.cols)
+        if hdfs:
+            hc.create(hdfs_part_path(tmp, g),
+                      segments_blob(segs, compression))
+        else:
+            native.write_files([os.path.join(tmp, f"part-{g:05d}.bin")],
+                               [segs], compress=(compression == "gzip"))
         my_counts.append(merged.n)
         my_sums.append(native.checksum_segments(segs))
 
@@ -243,12 +273,18 @@ def _write_partitions(out_path: str, schema, part_chunks, part_ids,
         meta = build_meta(store_schema, counts, checksums,
                           partitioning=partitioning,
                           compression=compression, capacity=capacity)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=1)
-        if os.path.exists(out_path):
-            import shutil
-            shutil.rmtree(out_path)
-        os.rename(tmp, out_path)
+        if hdfs:
+            hc.create(tmp + "/meta.json",
+                      json.dumps(meta, indent=1).encode())
+            hc.delete(hpath, recursive=True)
+            hc.rename(tmp, hpath)
+        else:
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1)
+            if os.path.exists(out_path):
+                import shutil
+                shutil.rmtree(out_path)
+            os.rename(tmp, out_path)
     # post-commit barrier so no worker reports success (or starts the next
     # job's waves) before the rename happened
     _host_allgather(np.zeros((1,), np.int32), mesh)

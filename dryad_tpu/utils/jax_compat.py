@@ -1,0 +1,63 @@
+"""JAX version compatibility shims.
+
+The framework targets the current ``jax.shard_map`` API (top-level,
+``check_vma=`` keyword) and ``jax.lax.axis_size``.  The late-0.4.x
+band (0.4.36/0.4.37 — the jaxlib this image bakes in) only ships
+``jax.experimental.shard_map.shard_map`` with the ``check_rep=``
+keyword, and exposes the static named-axis size as
+``jax.core.axis_frame(name)`` (an int on this band; EARLIER 0.4.x
+returned a frame object — such builds are rejected loudly below
+rather than silently miscomputing shapes).  Importing this module
+installs top-level aliases translating the new spellings onto what
+the installed jax provides, so every jit(shard_map(...)) stage
+program compiles on either version.
+
+Imported from ``dryad_tpu/__init__.py`` before anything traces a stage.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["install"]
+
+
+def install() -> None:
+    if not hasattr(jax.lax, "axis_size"):
+        import jax.core as _core
+
+        def _frame_size(a) -> int:
+            sz = _core.axis_frame(a)
+            if not isinstance(sz, int):
+                raise RuntimeError(
+                    f"this jax build's core.axis_frame({a!r}) returns "
+                    f"{type(sz).__name__}, not the axis size — the "
+                    f"compat shim supports jax >= 0.4.36; upgrade jax")
+            return sz
+
+        def axis_size(name):
+            if isinstance(name, (tuple, list)):
+                n = 1
+                for a in name:
+                    n *= _frame_size(a)
+                return n
+            return _frame_size(name)
+
+        jax.lax.axis_size = axis_size
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kw):
+        # new-API ``check_vma`` maps onto the old ``check_rep`` (both
+        # gate the replication/varying-manual-axes checker; the default
+        # is "on" in both APIs)
+        check = True if check_vma is None else bool(check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check, **kw)
+
+    jax.shard_map = shard_map
+
+
+install()

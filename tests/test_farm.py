@@ -182,6 +182,120 @@ def test_farm_locality_preference(cluster, tmp_path):
         f"only {on_pref}/{len(groups)} tasks ran on their preferred worker"
 
 
+def test_farm_block_host_locality(cluster):
+    """Block->host hints steer tasks to the worker on the holding host:
+    the hdfs locality chain (GETFILEBLOCKLOCATIONS -> store_spec
+    preferred_hosts -> worker_hosts resolution -> dispatch), with the
+    host map injected so the two local workers model two machines.
+    Host matching is FQDN- and case-insensitive (block reports say
+    ``rack1-a.example.com``, the hint says ``rack1-a``)."""
+    if not cluster.alive():
+        cluster.restart()
+    plan_json, src_key = _farm_plan(cluster)
+    TaskFarm(cluster).run(plan_json, _tasks(cluster, src_key, 4)[1])  # warm
+    cluster.wait_quiescent()
+    vals, per_task = _tasks(cluster, src_key, n_tasks=12)
+    hosts = {0: "rack1-a.example.com", 1: "rack1-b.example.com"}
+    prefs = []
+    for i, spec in enumerate(per_task):
+        prefs.append(i % 2)
+        spec[src_key]["preferred_hosts"] = ["RACK1-A" if i % 2 == 0
+                                            else "rack1-b"]
+    # uniform per-task delay so durations dominate scheduling noise
+    # (test_farm_locality_preference rationale)
+    farm = TaskFarm(cluster, worker_hosts=hosts,
+                    delay_hook=lambda t, p: 0.2)
+    results = farm.run(plan_json, per_task)
+    _check(vals, results)
+    done = {e["task"]: e["worker"] for e in farm.events
+            if e["event"] == "task_done"}
+    on_pref = sum(1 for t, w in done.items() if prefs[t] == w)
+    assert on_pref >= 0.8 * len(per_task), \
+        f"only {on_pref}/{len(per_task)} tasks ran on their block host"
+    assert any(e["event"] == "task_locality_dispatch"
+               for e in farm.events)
+
+
+def test_farm_locality_fallback(cluster):
+    """Dispatch succeeds when hints are absent, name an UNKNOWN host, or
+    the farm has no worker->host map at all — locality is a hint, never
+    a scheduling requirement."""
+    if not cluster.alive():
+        cluster.restart()
+    plan_json, src_key = _farm_plan(cluster)
+    # hints naming a host no worker runs on
+    vals, per_task = _tasks(cluster, src_key, n_tasks=6)
+    for spec in per_task:
+        spec[src_key]["preferred_hosts"] = ["no-such-host.example.com"]
+    farm = TaskFarm(cluster, worker_hosts={0: "rack1-a", 1: "rack1-b"})
+    _check(vals, farm.run(plan_json, per_task))
+    assert not any(e["event"] == "task_locality_dispatch"
+                   for e in farm.events)
+    # hints present but NO host map (cluster default covers every pid
+    # with this machine's name — steering is uniform, dispatch still ok)
+    vals, per_task = _tasks(cluster, src_key, n_tasks=6)
+    for spec in per_task:
+        spec[src_key]["preferred_hosts"] = ["rack1-b"]
+    _check(vals, TaskFarm(cluster).run(plan_json, per_task))
+
+
+def test_farm_hdfs_store_locality_end_to_end(cluster):
+    """The WHOLE locality chain, no hand-injected hints: a store written
+    to the fake WebHDFS server whose per-block host metadata maps even
+    partitions to rack1-a and odd to rack1-b; farm_store_tasks reads the
+    block locations (GETFILEBLOCKLOCATIONS) into per-task
+    preferred_hosts; the farm resolves them against the worker->host map
+    and dispatches accordingly; the WORKERS then read their hdfs
+    partitions over ranged WebHDFS reads (DrHdfsClient.cpp +
+    Interfaces.cs:98-152 end-to-end)."""
+    from webhdfs_fake import FakeWebHdfs
+
+    from dryad_tpu.runtime.sources import farm_store_tasks
+
+    if not cluster.alive():
+        cluster.restart()
+
+    def hosts_of(path, _block):
+        p = int(path.rsplit("part-", 1)[1][:5])
+        return ["rack1-a"] if p % 2 == 0 else ["rack1-b"]
+
+    srv = FakeWebHdfs(block_hosts=hosts_of)
+    try:
+        vals = np.arange(400, dtype=np.int32) - 200
+        Context().from_columns({"v": vals}).to_store(srv.url + "/farm/in")
+        plan_json, src_key = _farm_plan(cluster)
+        TaskFarm(cluster).run(plan_json,
+                              _tasks(cluster, src_key, 4)[1])  # warm
+        cluster.wait_quiescent()
+        per_task = farm_store_tasks(srv.url + "/farm/in", src_key,
+                                    cluster.devices_per_process)
+        prefs = [{"rack1-a": 0, "rack1-b": 1}[
+            t[src_key]["preferred_hosts"][0]] for t in per_task]
+        farm = TaskFarm(cluster,
+                        worker_hosts={0: "rack1-a", 1: "rack1-b"},
+                        delay_hook=lambda t, p: 0.2)
+        results = farm.run(plan_json, per_task)
+        got = np.concatenate([np.asarray(r["v"]) for r in results])
+        exp = (vals * 2)[vals * 2 > 0]
+        assert sorted(got.tolist()) == sorted(exp.tolist())
+        done = {e["task"]: e["worker"] for e in farm.events
+                if e["event"] == "task_done"}
+        on_pref = sum(1 for t, w in done.items() if prefs[t] == w)
+        assert on_pref >= 0.8 * len(per_task), \
+            f"only {on_pref}/{len(per_task)} tasks ran on the block host"
+    finally:
+        srv.close()
+
+
+def test_locality_hints_helper(tmp_path):
+    """sources.locality_hints_for_store: real hosts for hdfs:// paths,
+    empty for local stores (never an error)."""
+    from dryad_tpu.runtime.sources import locality_hints_for_store
+
+    assert locality_hints_for_store(str(tmp_path / "x"), [0]) == []
+    assert locality_hints_for_store("s3://bkt/x", [0, 1]) == []
+
+
 def test_elastic_worker_joins_farm(cluster):
     """Elastic membership (reference dynamic computer registration,
     LocalScheduler/Queues.cs:104-137): a standalone worker registered

@@ -62,8 +62,10 @@ def test_uri_dispatch_store_roundtrip(tmp_path):
 
 def test_unknown_scheme_and_registration(tmp_path):
     ctx = Context()
-    with pytest.raises(UnknownSchemeError, match="hdfs"):
-        ctx.read("hdfs://nn/path")
+    # hdfs:// is a REAL provider now (io/webhdfs.py) — azure blob is the
+    # remaining unregistered reference scheme
+    with pytest.raises(UnknownSchemeError, match="abfs"):
+        ctx.read("abfs://container/path")
 
     def mem_provider(c, rest, **kw):
         return c.from_columns({"v": np.arange(int(rest), dtype=np.int32)})

@@ -1,0 +1,244 @@
+"""hdfs:// storage subsystem (WebHDFS dialect) against the hermetic fake
+server (tests/webhdfs_fake.py): client protocol semantics (namenode ->
+datanode redirects, ranged reads, retries), partitioned-store roundtrip
+with rename commit, streamed (>HBM-shaped) reads via per-segment ranged
+requests, block->host locality metadata, and the streamed-TeraSort
+acceptance path.
+
+Reference parity: DrHdfsClient.cpp:1-676 (GM-side HDFS client),
+channelbufferhdfs.cpp:69-97 (block-ranged channel reads),
+ClusterInterface/Interfaces.cs:98-152 (block locations -> scheduler
+affinity)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from webhdfs_fake import FakeWebHdfs  # noqa: E402
+
+from dryad_tpu import Context  # noqa: E402
+from dryad_tpu.io.webhdfs import (WebHdfsClient, WebHdfsError,  # noqa: E402
+                                  hdfs_preferred_hosts, parse_hdfs_url)
+
+
+@pytest.fixture()
+def srv():
+    s = FakeWebHdfs(block_size=4096)
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def client(srv):
+    return WebHdfsClient(parse_hdfs_url(srv.url + "/")[0])
+
+
+# -- client protocol ---------------------------------------------------------
+
+
+def test_parse_hdfs_url():
+    assert parse_hdfs_url("hdfs://nn:9870/a/b") == ("http://nn:9870",
+                                                    "/a/b")
+    assert parse_hdfs_url("hdfs://nn:9870") == ("http://nn:9870", "/")
+    with pytest.raises(ValueError):
+        parse_hdfs_url("s3://bucket/key")
+
+
+def test_client_file_ops(srv, client):
+    client.create("/d/a.bin", b"0123456789" * 10)
+    assert client.read_all("/d/a.bin", block=17) == b"0123456789" * 10
+    assert client.open("/d/a.bin", offset=3, length=4) == b"3456"
+    client.append("/d/a.bin", b"TAIL")
+    assert client.read_all("/d/a.bin").endswith(b"TAIL")
+    st = client.status("/d/a.bin")
+    assert st["type"] == "FILE" and st["length"] == 104
+    assert [e["pathSuffix"] for e in client.list_status("/d")] == ["a.bin"]
+    client.mkdirs("/d/sub")
+    assert client.status("/d/sub")["type"] == "DIRECTORY"
+    client.rename("/d", "/moved")
+    assert client.read_all("/moved/a.bin").startswith(b"0123")
+    assert client.delete("/moved", recursive=True)
+    assert not client.exists("/moved/a.bin")
+
+
+def test_data_ships_only_to_datanode(srv, client):
+    """Redirect protocol: CREATE/OPEN bytes move on the datanode hop
+    only (the WebHDFS two-step the real namenode enforces)."""
+    client.create("/p/x", b"payload")
+    assert client.open("/p/x", 0, 7) == b"payload"
+    ops = [(m, q.get("op")) for m, _p, q in srv.datanode_hits]
+    assert ("PUT", "CREATE") in ops and ("GET", "OPEN") in ops
+
+
+def test_client_retries_transient_5xx(srv, client):
+    client.create("/r/x", b"abc")
+    srv.fail_next["/r/x"] = 2          # two 500s, then success
+    assert client.open("/r/x", 0, 3) == b"abc"
+
+
+def test_client_errors_carry_remote_exception(client):
+    with pytest.raises(WebHdfsError) as ei:
+        client.status("/missing/file")
+    assert ei.value.status == 404
+    assert "FileNotFoundException" in str(ei.value)
+
+
+def test_block_locations_per_block_hosts():
+    srv = FakeWebHdfs(block_size=10,
+                      block_hosts=lambda p, i: [f"dn{i}", "dn-common"])
+    try:
+        c = WebHdfsClient(parse_hdfs_url(srv.url)[0])
+        c.create("/b/f", b"x" * 25)
+        blocks = c.block_locations("/b/f")
+        assert [b["offset"] for b in blocks] == [0, 10, 20]
+        assert [b["length"] for b in blocks] == [10, 10, 5]
+        assert blocks[1]["hosts"] == ["dn1", "dn-common"]
+        # missing file -> empty hints, not an error (locality is a hint)
+        assert c.block_locations("/b/nope") == []
+    finally:
+        srv.close()
+
+
+# -- partitioned store -------------------------------------------------------
+
+
+def _table(n=500):
+    return {"k": (np.arange(n, dtype=np.int32) % 7),
+            "v": np.arange(n, dtype=np.int32),
+            "s": [f"row{i:04d}" for i in range(n)]}
+
+
+def test_store_roundtrip(srv):
+    data = _table()
+    Context().from_columns(data).to_store(srv.url + "/stores/t1")
+    back = Context().from_store(srv.url + "/stores/t1").collect()
+    assert sorted(np.asarray(back["v"]).tolist()) == list(range(500))
+    assert sorted(b.decode() for b in back["s"]) == sorted(data["s"])
+
+
+def test_store_roundtrip_gzip(srv):
+    Context().from_columns(_table()).to_store(srv.url + "/z/c1",
+                                              compression="gzip")
+    back = Context().from_store(srv.url + "/z/c1").collect()
+    assert sorted(np.asarray(back["v"]).tolist()) == list(range(500))
+
+
+def test_store_overwrite_is_atomic_commit(srv, client):
+    ctx = Context()
+    ctx.from_columns({"v": np.arange(10, dtype=np.int32)}).to_store(
+        srv.url + "/o/s")
+    ctx.from_columns({"v": np.arange(20, dtype=np.int32)}).to_store(
+        srv.url + "/o/s")
+    back = Context().from_store(srv.url + "/o/s").collect()
+    assert sorted(np.asarray(back["v"]).tolist()) == list(range(20))
+    # the rename commit leaves no temp dirs behind
+    names = [e["pathSuffix"] for e in client.list_status("/o")]
+    assert names == ["s"]
+
+
+@pytest.fixture()
+def force_ranged(monkeypatch):
+    """Every hdfs partition takes the >RAM ranged-streaming path (the
+    production threshold keeps small partitions on the verified
+    whole-part read)."""
+    from dryad_tpu.exec.ooc import ChunkSource
+    monkeypatch.setattr(ChunkSource, "RANGED_STREAM_MIN_BYTES", 0)
+
+
+def test_read_store_stream_ranged(srv, force_ranged):
+    """Streamed hdfs reads fetch bounded ranges (many datanode OPENs),
+    never one whole-partition GET, and reproduce the data exactly."""
+    Context().from_columns(_table()).to_store(srv.url + "/stores/t2")
+    before = len(srv.datanode_hits)
+    out = (Context().read_store_stream(srv.url + "/stores/t2",
+                                       chunk_rows=64)
+           .where(lambda c: c["v"] % 2 == 0).collect())
+    assert sorted(np.asarray(out["v"]).tolist()) == list(range(0, 500, 2))
+    opens = [q for m, _p, q in srv.datanode_hits[before:]
+             if q.get("op") == "OPEN"]
+    assert len(opens) > 8        # per-segment per-chunk ranges, not 1/part
+    assert all("length" in q for q in opens)
+
+
+def test_read_store_stream_small_parts_verified(srv, client):
+    """Below the ranged-streaming threshold, hdfs streamed reads keep
+    their checksum protection: a flipped byte raises StoreIntegrityError
+    instead of returning corrupt rows."""
+    from dryad_tpu.io.store import StoreIntegrityError
+
+    Context().from_columns(_table()).to_store(srv.url + "/stores/t4")
+    part = "/stores/t4/part-00000.bin"
+    body = bytearray(srv.files[part])
+    body[0] ^= 0xFF
+    srv.files[part] = bytes(body)
+    with pytest.raises(StoreIntegrityError):
+        Context().read_store_stream(srv.url + "/stores/t4",
+                                    chunk_rows=64).collect()
+
+
+def test_streamed_write_to_hdfs(srv):
+    Context().from_columns(_table()).to_store(srv.url + "/stores/t3")
+    (Context().read_store_stream(srv.url + "/stores/t3", chunk_rows=64)
+     .where(lambda c: c["v"] < 100).to_store(srv.url + "/stores/small"))
+    back = Context().from_store(srv.url + "/stores/small").collect()
+    assert sorted(np.asarray(back["v"]).tolist()) == list(range(100))
+
+
+def test_text_provider(srv, client):
+    for i in range(3):
+        body = "\n".join(f"alpha beta w{i}l{j}" for j in range(10)) + "\n"
+        client.create(f"/texts/f{i}.txt", body.encode())
+    ds = Context().read(srv.url + "/texts/")
+    assert ds.count() == 30
+    wc = (ds.split_words("line", out_capacity=256)
+          .group_by(["line"], {"n": ("count", None)}).collect())
+    got = dict(zip((b.decode() for b in wc["line"]),
+                   np.asarray(wc["n"]).tolist()))
+    assert got["alpha"] == 30 and got["beta"] == 30 and got["w1l3"] == 1
+
+
+def test_preferred_hosts_weighted(srv, client):
+    """hdfs_preferred_hosts orders hosts by block bytes held (the
+    weighted affinity list of Interfaces.cs:98-152)."""
+    srv.block_size = 100
+    srv.block_hosts = lambda p, i: (["heavy"] if i < 3 else ["light"])
+    client.create("/w/part-00000.bin", b"x" * 350)   # 3 heavy + 1 light
+    hosts = hdfs_preferred_hosts(srv.url + "/w", [0])
+    assert hosts == ["heavy", "light"]
+    # partitions without block info contribute nothing (hint, not error)
+    assert hdfs_preferred_hosts(srv.url + "/nope", [0]) == []
+
+
+# -- acceptance: streamed TeraSort over hdfs:// ------------------------------
+
+
+def test_streamed_terasort_from_hdfs(srv, force_ranged):
+    """ISSUE acceptance: TeraSort reading hdfs:// input through the
+    streamed engine matches the oracle exactly, with the input arriving
+    as ranged chunk reads (>HBM shape)."""
+    from dryad_tpu.apps import terasort
+    from dryad_tpu.utils.config import JobConfig
+
+    n, chunk = 3000, 256
+    recs = terasort.gen_records(n, seed=7)
+    Context().from_columns(recs, str_max_len=10).to_store(
+        srv.url + "/tera/in")
+
+    sctx = Context(config=JobConfig(ooc_chunk_rows=chunk,
+                                    ooc_incore_bytes=0, ooc_inflight=2))
+    ds = sctx.read_store_stream(srv.url + "/tera/in", chunk_rows=chunk)
+    out = terasort.terasort_query(ds).collect()
+
+    keys = [bytes(k) for k in out["key"]]
+    assert keys == sorted(recs["key"])                   # oracle order
+    # payloads travel with their keys: (key, payload) multiset preserved
+    got = sorted(zip(keys, np.asarray(out["payload"]).tolist()))
+    exp = sorted(zip(recs["key"], recs["payload"].tolist()))
+    assert got == exp
+    # the input genuinely streamed: many bounded ranged reads
+    opens = [q for _m, p, q in srv.datanode_hits
+             if q.get("op") == "OPEN" and "/tera/in/" in p]
+    assert len(opens) >= n // chunk

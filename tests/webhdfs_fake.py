@@ -1,0 +1,320 @@
+"""Self-contained fake WebHDFS server (stdlib http.server only).
+
+Implements the protocol surface dryad_tpu.io.webhdfs speaks, with REAL
+namenode/datanode split semantics so redirect handling is exercised, not
+mocked: data ops (OPEN/CREATE/APPEND) hit the "namenode" endpoint
+(``/webhdfs/v1/...``) and are 307-redirected to the "datanode" endpoint
+(``/dn/webhdfs/v1/...``), which is the only place bytes are served or
+accepted — a client that skipped the redirect protocol would fail.
+Metadata ops (LISTSTATUS/GETFILESTATUS/GETFILEBLOCKLOCATIONS/MKDIRS/
+RENAME/DELETE) answer at the namenode directly, like real HDFS.
+
+``GETFILEBLOCKLOCATIONS`` carves files into ``block_size`` blocks and
+reports hosts from the injectable ``block_hosts(path, block_index)``
+mapping — the per-block host metadata the locality-aware task farm
+consumes (tests/test_farm.py, tests/test_webhdfs.py).
+
+``fail_next[path] = n`` makes the next n namenode requests for that path
+serve 500s (retry-path testing).  ``datanode_hits`` records every
+datanode request as (method, path, query) for redirect-semantics
+assertions.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["FakeWebHdfs"]
+
+_V1 = "/webhdfs/v1"
+
+
+class FakeWebHdfs:
+    def __init__(self, block_size: int = 256 << 10,
+                 block_hosts: Optional[Callable[[str, int], List[str]]]
+                 = None):
+        self.files: Dict[str, bytes] = {}
+        self.dirs = {"/"}
+        self.block_size = block_size
+        self.block_hosts = (block_hosts
+                            or (lambda path, i: [f"datanode-{i % 3}"]))
+        self.datanode_hits: List[Tuple[str, str, Dict[str, str]]] = []
+        self.fail_next: Dict[str, int] = {}
+        srv = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            # -- plumbing --------------------------------------------------
+            def _reply(self, status: int, body: bytes = b"",
+                       headers: Tuple[Tuple[str, str], ...] = ()):
+                self.send_response(status)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _exc(self, status: int, exc: str, msg: str):
+                self._reply(status, json.dumps({"RemoteException": {
+                    "exception": exc, "javaClassName": "org." + exc,
+                    "message": msg}}).encode())
+
+            def _parse(self):
+                parts = urllib.parse.urlsplit(self.path)
+                p = parts.path
+                dn = p.startswith("/dn" + _V1)
+                p = p[len("/dn"):] if dn else p
+                if not p.startswith(_V1):
+                    self._exc(404, "FileNotFoundException",
+                              f"not a webhdfs path: {self.path}")
+                    return None
+                fspath = urllib.parse.unquote(p[len(_V1):]) or "/"
+                if len(fspath) > 1:
+                    fspath = fspath.rstrip("/")
+                qs = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parts.query).items()}
+                if dn:
+                    srv.datanode_hits.append((self.command, fspath,
+                                              dict(qs)))
+                elif srv.fail_next.get(fspath, 0) > 0:
+                    srv.fail_next[fspath] -= 1
+                    self._exc(500, "RetriableException",
+                              "injected transient failure")
+                    return None
+                return dn, fspath, qs
+
+            def _redirect(self, fspath: str, qs: Dict[str, str]):
+                host, port = self.server.server_address[:2]
+                loc = (f"http://{host}:{port}/dn{_V1}"
+                       + urllib.parse.quote(fspath, safe="/")
+                       + "?" + urllib.parse.urlencode(qs))
+                self._reply(307, headers=(("Location", loc),))
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            # -- namespace helpers ----------------------------------------
+            def _is_dir(self, p: str) -> bool:
+                return (p in srv.dirs
+                        or any(f.startswith(p + "/") for f in srv.files)
+                        or any(d.startswith(p + "/") for d in srv.dirs))
+
+            def _add_parents(self, p: str):
+                while p and p != "/":
+                    p = p.rsplit("/", 1)[0] or "/"
+                    srv.dirs.add(p)
+
+            def _children(self, p: str):
+                base = "" if p == "/" else p
+                names: Dict[str, dict] = {}
+                for f, data in srv.files.items():
+                    if f.startswith(base + "/"):
+                        rel = f[len(base) + 1:]
+                        name = rel.split("/", 1)[0]
+                        if "/" in rel:
+                            names.setdefault(name, self._stat_dir(name))
+                        else:
+                            names[name] = {"pathSuffix": name,
+                                           "type": "FILE",
+                                           "length": len(data),
+                                           "blockSize": srv.block_size,
+                                           "replication": 1}
+                for d in srv.dirs:
+                    if d.startswith(base + "/"):
+                        rel = d[len(base) + 1:]
+                        name = rel.split("/", 1)[0]
+                        names.setdefault(name, self._stat_dir(name))
+                return [names[k] for k in sorted(names)]
+
+            @staticmethod
+            def _stat_dir(name: str) -> dict:
+                return {"pathSuffix": name, "type": "DIRECTORY",
+                        "length": 0, "blockSize": 0, "replication": 0}
+
+            # -- GET: OPEN / LISTSTATUS / GETFILESTATUS / block locs ------
+            def do_GET(self):
+                parsed = self._parse()
+                if parsed is None:
+                    return
+                dn, fspath, qs = parsed
+                op = qs.get("op", "").upper()
+                if op == "OPEN":
+                    if fspath not in srv.files:
+                        return self._exc(404, "FileNotFoundException",
+                                         fspath)
+                    if not dn:
+                        return self._redirect(fspath, qs)
+                    data = srv.files[fspath]
+                    off = int(qs.get("offset", 0))
+                    ln = qs.get("length")
+                    end = len(data) if ln is None else off + int(ln)
+                    body = data[off:end]
+                    return self._reply(200, body)
+                if op == "GETFILESTATUS":
+                    if fspath in srv.files:
+                        st = {"pathSuffix": "", "type": "FILE",
+                              "length": len(srv.files[fspath]),
+                              "blockSize": srv.block_size,
+                              "replication": 1}
+                    elif self._is_dir(fspath):
+                        st = self._stat_dir("")
+                    else:
+                        return self._exc(404, "FileNotFoundException",
+                                         fspath)
+                    return self._reply(200, json.dumps(
+                        {"FileStatus": st}).encode())
+                if op == "LISTSTATUS":
+                    if fspath in srv.files:
+                        entries = [{"pathSuffix": "", "type": "FILE",
+                                    "length": len(srv.files[fspath])}]
+                    elif self._is_dir(fspath):
+                        entries = self._children(fspath)
+                    else:
+                        return self._exc(404, "FileNotFoundException",
+                                         fspath)
+                    return self._reply(200, json.dumps({"FileStatuses": {
+                        "FileStatus": entries}}).encode())
+                if op == "GETFILEBLOCKLOCATIONS":
+                    if fspath not in srv.files:
+                        return self._exc(404, "FileNotFoundException",
+                                         fspath)
+                    size = len(srv.files[fspath])
+                    blocks = []
+                    off = 0
+                    i = 0
+                    while off < size:
+                        ln = min(srv.block_size, size - off)
+                        hosts = list(srv.block_hosts(fspath, i))
+                        blocks.append({
+                            "offset": off, "length": ln, "hosts": hosts,
+                            "names": [h + ":9866" for h in hosts],
+                            "corrupt": False})
+                        off += ln
+                        i += 1
+                    return self._reply(200, json.dumps({"BlockLocations": {
+                        "BlockLocation": blocks}}).encode())
+                self._exc(400, "IllegalArgumentException",
+                          f"unsupported GET op {op!r}")
+
+            # -- PUT: CREATE / MKDIRS / RENAME ----------------------------
+            def do_PUT(self):
+                parsed = self._parse()
+                if parsed is None:
+                    return
+                dn, fspath, qs = parsed
+                op = qs.get("op", "").upper()
+                if op == "CREATE":
+                    if not dn:
+                        # the namenode NEVER takes bytes (real HDFS
+                        # drops them); redirect to the datanode
+                        self._body()
+                        return self._redirect(fspath, qs)
+                    if (qs.get("overwrite", "true").lower() == "false"
+                            and fspath in srv.files):
+                        return self._exc(403, "FileAlreadyExistsException",
+                                         fspath)
+                    srv.files[fspath] = self._body()
+                    self._add_parents(fspath)
+                    return self._reply(201, headers=(
+                        ("Location", "hdfs://fake" + fspath),))
+                if op == "MKDIRS":
+                    srv.dirs.add(fspath)
+                    self._add_parents(fspath)
+                    return self._reply(200, b'{"boolean": true}')
+                if op == "RENAME":
+                    dst = qs.get("destination", "")
+                    ok = self._rename(fspath, dst)
+                    return self._reply(200, json.dumps(
+                        {"boolean": ok}).encode())
+                self._exc(400, "IllegalArgumentException",
+                          f"unsupported PUT op {op!r}")
+
+            def _rename(self, src: str, dst: str) -> bool:
+                if not dst or dst in srv.files or (dst in srv.dirs):
+                    return False
+                if src in srv.files:
+                    srv.files[dst] = srv.files.pop(src)
+                    self._add_parents(dst)
+                    return True
+                if self._is_dir(src):
+                    for f in [f for f in srv.files
+                              if f.startswith(src + "/")]:
+                        srv.files[dst + f[len(src):]] = srv.files.pop(f)
+                    for d in [d for d in srv.dirs
+                              if d == src or d.startswith(src + "/")]:
+                        srv.dirs.discard(d)
+                        srv.dirs.add(dst + d[len(src):])
+                    self._add_parents(dst)
+                    return True
+                return False
+
+            # -- POST: APPEND ---------------------------------------------
+            def do_POST(self):
+                parsed = self._parse()
+                if parsed is None:
+                    return
+                dn, fspath, qs = parsed
+                op = qs.get("op", "").upper()
+                if op == "APPEND":
+                    if fspath not in srv.files:
+                        return self._exc(404, "FileNotFoundException",
+                                         fspath)
+                    if not dn:
+                        self._body()
+                        return self._redirect(fspath, qs)
+                    srv.files[fspath] = srv.files[fspath] + self._body()
+                    return self._reply(200)
+                self._exc(400, "IllegalArgumentException",
+                          f"unsupported POST op {op!r}")
+
+            # -- DELETE ----------------------------------------------------
+            def do_DELETE(self):
+                parsed = self._parse()
+                if parsed is None:
+                    return
+                _dn, fspath, qs = parsed
+                if qs.get("op", "").upper() != "DELETE":
+                    return self._exc(400, "IllegalArgumentException",
+                                     "unsupported DELETE op")
+                recursive = qs.get("recursive", "false") == "true"
+                if fspath in srv.files:
+                    del srv.files[fspath]
+                    return self._reply(200, b'{"boolean": true}')
+                if self._is_dir(fspath) and fspath != "/":
+                    under = [f for f in srv.files
+                             if f.startswith(fspath + "/")]
+                    if under and not recursive:
+                        return self._exc(403, "PathIsNotEmptyDirectory"
+                                         "Exception", fspath)
+                    for f in under:
+                        del srv.files[f]
+                    for d in [d for d in srv.dirs if d == fspath
+                              or d.startswith(fspath + "/")]:
+                        srv.dirs.discard(d)
+                    return self._reply(200, b'{"boolean": true}')
+                return self._reply(200, b'{"boolean": false}')
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        """hdfs:// base URL addressing this fake's WebHDFS endpoint."""
+        return f"hdfs://127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
