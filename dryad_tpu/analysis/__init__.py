@@ -1,0 +1,102 @@
+"""Pre-submit static analysis: plan verifier + UDF determinism lint.
+
+The reference validates the expression tree statically in phase 1 of query
+generation (DryadLinqQueryGen.cs `DryadLinqQueryGen` — serializability of
+closures, operator applicability) before touching the cluster; dryad_tpu's
+equivalent lives here.  Entry points:
+
+* ``Dataset.check()`` / ``Dataset.explain(verify=True)`` — interactive
+* ``JobConfig.lint = "warn" | "error"`` — pre-submit gate on every
+  executor/cluster/stream submission (findings land in the EventLog and
+  the viewer's Diagnostics section; "error" blocks the job)
+* ``python -m dryad_tpu.analysis plan.json`` — lint serialized plans
+  offline (CI over committed plan artifacts)
+"""
+
+from dryad_tpu.analysis.diagnostics import (  # noqa: F401
+    CODES, RUNTIME_ONLY_CODES, Diagnostic, DiagnosticError,
+    DiagnosticReport, LintError, Span)
+from dryad_tpu.analysis.plan_rules import (  # noqa: F401
+    RULES, STATIC_RULE_CODES, PlanCheck, check_plan)
+from dryad_tpu.analysis.udf_lint import (  # noqa: F401
+    fn_def_site, lint_udf, shippability_of)
+
+__all__ = [
+    "CODES", "RUNTIME_ONLY_CODES", "Diagnostic", "DiagnosticError",
+    "DiagnosticReport", "LintError", "Span",
+    "RULES", "STATIC_RULE_CODES", "PlanCheck", "check_plan",
+    "fn_def_site", "lint_udf", "shippability_of", "check_plan_json",
+]
+
+
+def check_plan_json(plan_json: str, stream: bool = False
+                    ) -> DiagnosticReport:
+    """Lint a SERIALIZED plan (plan/serialize.graph_to_json output)
+    offline — no callables, no sources, no jax.  Covers the structural
+    subset: stream-incompatible ops (with ``stream=True``), placeholder
+    legs, and callable refs a worker could never resolve (anonymous
+    ``fn_...`` names and opaque params, shiplan's DTA905 deploy
+    failure).  Op spans recorded by the planner make findings point at
+    the query line that created the op."""
+    import json
+    import re
+
+    report = DiagnosticReport()
+    d = json.loads(plan_json)
+
+    def walk_params(v, found):
+        if isinstance(v, dict):
+            if "__fn__" in v:
+                found.append(("fn", v["__fn__"]))
+            if "__opaque__" in v:
+                found.append(("opaque", v["__opaque__"]))
+            for x in v.values():
+                walk_params(x, found)
+        elif isinstance(v, list):
+            for x in v:
+                walk_params(x, found)
+
+    for st in d.get("stages", []):
+        legs = st.get("legs", [])
+        for leg in legs:
+            if "placeholder" in leg.get("src", {}) and stream:
+                report.add("DTA002", "error",
+                           f"stage {st['id']}: placeholder leg in a "
+                           f"streamed cluster plan", node="placeholder")
+        ops = [(o, "leg") for leg in legs for o in leg.get("ops", [])] \
+            + [(o, "body") for o in st.get("body", [])]
+        for op, where in ops:
+            span = op.get("span")
+            if stream and op["kind"] == "take" \
+                    and op.get("params", {}).get("global"):
+                report.add("DTA001", "error",
+                           f"stage {st['id']}: global take() is not "
+                           f"supported over cluster streams", span=span,
+                           node=op["kind"])
+            found = []
+            walk_params(op.get("params", {}), found)
+            for kind, name in found:
+                if kind == "fn" and ":" not in name:
+                    # anonymous fn_<id> refs (graph_to_json fallback for
+                    # unregistered callables) can NEVER resolve on a
+                    # worker; a registered shipping name resolves when a
+                    # --fn-module exports it — deploy requirement, not
+                    # an error
+                    anonymous = bool(re.fullmatch(r"fn_[0-9a-f]+", name))
+                    report.add(
+                        "DTA905", "error" if anonymous else "warn",
+                        f"stage {st['id']} {where} op {op['kind']!r} "
+                        f"references callable {name!r} with no "
+                        f"importable module:qualname — "
+                        + ("it was never registered for shipping and no "
+                           "worker can resolve it" if anonymous else
+                           "workers need a --fn-module exporting that "
+                           "name"), span=span, node=op["kind"])
+                elif kind == "opaque":
+                    report.add(
+                        "DTA016", "error",
+                        f"stage {st['id']} {where} op {op['kind']!r} "
+                        f"carries opaque param {name!r} — not "
+                        f"serializable for cluster execution", span=span,
+                        node=op["kind"])
+    return report

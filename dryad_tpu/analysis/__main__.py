@@ -1,0 +1,40 @@
+"""CLI: lint a serialized plan offline.
+
+``python -m dryad_tpu.analysis plan.json`` — run the structural subset of
+the plan verifier over a plan JSON artifact (plan/serialize.graph_to_json
+output, the artifact ``runtime/shiplan.serialize_for_cluster`` ships to
+workers).  Exit code 1 when error-severity findings exist, so CI can gate
+committed plan artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dryad_tpu.analysis import check_plan_json
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dryad_tpu.analysis",
+        description="statically lint a serialized dryad_tpu plan "
+                    "(graph_to_json / shiplan output)")
+    ap.add_argument("plan", help="plan JSON path ('-' for stdin)")
+    ap.add_argument("--stream", action="store_true",
+                    help="the plan will execute over cluster streams "
+                         "(store_stream sources): apply the streamed-"
+                         "mode op rules")
+    args = ap.parse_args(argv)
+    if args.plan == "-":
+        plan_json = sys.stdin.read()
+    else:
+        with open(args.plan) as f:
+            plan_json = f.read()
+    report = check_plan_json(plan_json, stream=args.stream)
+    print(report.render())
+    return 1 if report.errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

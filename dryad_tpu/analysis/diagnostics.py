@@ -1,0 +1,188 @@
+"""Diagnostics engine: stable codes, severities, source provenance.
+
+The counterpart of the reference's phase-1 static validation errors
+(DryadLinqQueryGen.cs raises on non-serializable expressions / inapplicable
+operators BEFORE any cluster resource is touched).  Every rule in
+dryad_tpu/analysis emits ``Diagnostic`` records with a stable ``DTAxxx``
+code so tooling (CI gates, the viewer, tests) can key off them; runtime
+errors that mirror a static rule carry the SAME code (DiagnosticError), so
+the two surfaces cannot drift apart silently — tests/test_analysis.py
+asserts the mapping.
+
+Code space:
+* DTA0xx — plan verifier (structural rules over the logical Node DAG)
+* DTA1xx — UDF lint (determinism / shippability of user callables)
+* DTA9xx — runtime-only conditions (data-dependent overflows, internal
+  invariants, worker-side deploy errors) that no static rule can predict
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, List, Optional
+
+__all__ = [
+    "Span", "Diagnostic", "DiagnosticReport", "DiagnosticError",
+    "LintError", "SEVERITIES", "CODES", "RUNTIME_ONLY_CODES",
+]
+
+# severity rank for sorting/gating (error first)
+SEVERITIES = {"error": 0, "warn": 1, "info": 2}
+
+# every stable code with its one-line meaning — the single registry both
+# the static rules and the runtime raise sites draw from
+CODES = {
+    # -- plan verifier (DTA0xx) -------------------------------------------
+    "DTA001": "global take() is not supported over cluster streams",
+    "DTA002": "placeholder (do_while loop input) in a streamed cluster "
+              "plan",
+    "DTA003": "operator not supported over cluster streams",
+    "DTA010": "capacity hazard: fan-out op without a with_capacity bound",
+    "DTA011": "redundant repartition: placement already satisfied",
+    "DTA012": "fan-out (Tee) consumer without cache()",
+    "DTA013": "unsound assume_* placement claim",
+    "DTA014": "UDF is not cluster-shippable (lambda/closure)",
+    "DTA015": "source is not cluster-shippable (non-deferred)",
+    "DTA016": "op param is not serializable for cluster execution",
+    # -- UDF lint (DTA1xx) -------------------------------------------------
+    "DTA101": "nondeterministic call in UDF (time/random/uuid/urandom)",
+    "DTA102": "object-identity dependence in UDF (id()/salted hash())",
+    "DTA103": "set-iteration-order dependence in UDF",
+    "DTA104": "UDF mutates captured state",
+    # -- runtime-only (DTA9xx) ---------------------------------------------
+    "DTA901": "internal: op kind cannot ride a wave program",
+    "DTA902": "internal: unknown exchange kind in streamed plan",
+    "DTA903": "bucket capacity overflow during wave exchange",
+    "DTA904": "wave exchange still overflowing after capacity retries",
+    "DTA905": "worker cannot resolve a plan callable (missing --fn-module)",
+}
+
+# codes that have NO static-analyzer rule, by design: data-dependent
+# overflows, internal invariants, and worker-side deploy failures.  The
+# drift test asserts every runtime raise site uses a code that is either
+# carried by a static rule or listed here.
+RUNTIME_ONLY_CODES = frozenset({"DTA901", "DTA902", "DTA903", "DTA904",
+                                "DTA905"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """Source provenance: where the user wrote the offending construct."""
+
+    file: str
+    line: int
+    func: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}"
+
+    @staticmethod
+    def of(v: Any) -> Optional["Span"]:
+        """Coerce a (file, line[, func]) tuple / Span / None."""
+        if v is None or isinstance(v, Span):
+            return v
+        if isinstance(v, (tuple, list)) and len(v) >= 2:
+            return Span(str(v[0]), int(v[1]), str(v[2]) if len(v) > 2
+                        else "")
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: stable code, severity, message, provenance."""
+
+    code: str
+    severity: str  # "error" | "warn" | "info"
+    message: str
+    span: Optional[Span] = None
+    node: str = ""  # logical node / op the finding anchors to
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    def render(self) -> str:
+        where = f"{self.span}: " if self.span else ""
+        at = f" [{self.node}]" if self.node else ""
+        return f"{where}{self.severity} {self.code}: {self.message}{at}"
+
+
+class DiagnosticReport:
+    """All findings of one check() pass, reported at once (the whole
+    point: every contract violation in ONE diagnostic sweep instead of
+    one runtime failure at a time)."""
+
+    def __init__(self, diagnostics: Iterable[Diagnostic] = ()):
+        self.diagnostics: List[Diagnostic] = list(diagnostics)
+
+    def add(self, code: str, severity: str, message: str,
+            span: Any = None, node: str = "") -> None:
+        self.diagnostics.append(Diagnostic(code, severity, message,
+                                           Span.of(span), node))
+
+    def __iter__(self):
+        return iter(self.sorted())
+
+    def __len__(self) -> int:
+        return len(self.diagnostics)
+
+    def sorted(self) -> List[Diagnostic]:
+        return sorted(self.diagnostics,
+                      key=lambda d: (SEVERITIES[d.severity], d.code,
+                                     str(d.span or "")))
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warn"]
+
+    @property
+    def clean(self) -> bool:
+        """No error/warn findings (info notes do not dirty a plan)."""
+        return not self.errors and not self.warnings
+
+    def codes(self) -> set:
+        return {d.code for d in self.diagnostics}
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def render(self) -> str:
+        if not self.diagnostics:
+            return "no findings"
+        lines = [d.render() for d in self.sorted()]
+        n_e, n_w = len(self.errors), len(self.warnings)
+        n_i = len(self.diagnostics) - n_e - n_w
+        lines.append(f"{n_e} error(s), {n_w} warning(s), {n_i} info")
+        return "\n".join(lines)
+
+
+class DiagnosticError(RuntimeError):
+    """Base for runtime errors that mirror a static diagnostic: carries
+    the stable ``code`` and the offending construct's ``span`` so the
+    failure message points at the user's query line, and tooling can map
+    the raise back to the analyzer rule that would have caught it."""
+
+    def __init__(self, message: str, code: Optional[str] = None,
+                 span: Any = None):
+        self.code = code
+        self.span = Span.of(span)
+        full = f"[{code}] {message}" if code else message
+        if self.span is not None:
+            full += f" (at {self.span})"
+        super().__init__(full)
+
+
+class LintError(RuntimeError):
+    """Raised by the pre-submit gate (JobConfig.lint="error") when the
+    static analyzer reports error-severity findings — the job never
+    reaches the executor/cluster."""
+
+    def __init__(self, report: DiagnosticReport):
+        self.report = report
+        super().__init__(
+            "static analysis found error-severity diagnostics "
+            "(JobConfig.lint='error'):\n" + report.render())

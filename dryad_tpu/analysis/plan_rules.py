@@ -1,0 +1,381 @@
+"""Plan verifier: structural rules over the logical Node DAG.
+
+DryadLINQ's phase-1 query generation statically validates the expression
+tree (operator applicability, closure serializability) before any cluster
+resource is touched (DryadLinqQueryGen.cs phase1).  This is the dryad_tpu
+counterpart: ``check_plan`` walks the ``plan/expr.py`` DAG pre-trace and
+reports ALL findings in one DiagnosticReport — the errors the runtime
+would otherwise raise one at a time mid-job (runtime/stream_plan.py,
+runtime/shiplan.py) plus hazards it never catches at all (redundant
+exchanges, unsound assume_* claims, nondeterministic UDFs).
+
+Each rule carries the stable code of the runtime raise site it mirrors
+(diagnostics.CODES); tests/test_analysis.py asserts the mapping has no
+drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from dryad_tpu.analysis.diagnostics import (Diagnostic, DiagnosticReport,
+                                            Span)
+from dryad_tpu.analysis.udf_lint import (fn_def_site, lint_udf,
+                                         shippability_of)
+from dryad_tpu.plan import expr as E
+
+__all__ = ["check_plan", "RULES", "STATIC_RULE_CODES", "PlanCheck"]
+
+
+def _is_stream_source(data: Any) -> bool:
+    """Stream sources by duck type (no jax import): a StreamSource wraps
+    a ChunkSource as ``.cs``; a cluster stream is a DeferredSource whose
+    spec kind is "store_stream"."""
+    spec = getattr(data, "spec", None)
+    if isinstance(spec, dict) and spec.get("kind") == "store_stream":
+        return True
+    return getattr(data, "cs", None) is not None
+
+
+def _is_deferred_source(data: Any) -> bool:
+    return isinstance(getattr(data, "spec", None), dict)
+
+
+def _node_label(n: E.Node) -> str:
+    label = getattr(n, "label", "")
+    t = type(n).__name__
+    return f"{t}:{label}" if label and label != t.lower() else t
+
+
+class PlanCheck:
+    """Shared state one check pass's rules read: the walked DAG, consumer
+    counts, stream-source presence, and the cluster-target flag."""
+
+    def __init__(self, root: E.Node, cluster: bool = False,
+                 fn_table: Optional[Dict[str, Any]] = None):
+        self.root = root
+        self.nodes: List[E.Node] = E.walk(root)
+        self.cluster = bool(cluster)
+        self.fn_table = dict(fn_table or {})
+        self.registered_ids: Set[int] = {id(v)
+                                         for v in self.fn_table.values()}
+        # shiplan's process-global registry ships too (register_fn_table)
+        # — the static view must match what serialize_for_cluster accepts
+        # (lazy import: shiplan imports analysis.diagnostics)
+        from dryad_tpu.runtime.shiplan import _GLOBAL_FN_TABLE
+        self.registered_ids |= {id(v) for v in _GLOBAL_FN_TABLE.values()}
+        self.consumers: Dict[int, int] = {}
+        for n in self.nodes:
+            for p in n.parents:
+                self.consumers[p.id] = self.consumers.get(p.id, 0) + 1
+        self.has_stream = any(
+            isinstance(n, E.Source) and _is_stream_source(n.data)
+            for n in self.nodes)
+        # nodes with a WithCapacity descendant (transitive) — the
+        # capacity-hazard rule keys off this
+        self.capped: Set[int] = set()
+        capped_frontier = [n for n in self.nodes
+                           if isinstance(n, E.WithCapacity)]
+        seen: Set[int] = set()
+        while capped_frontier:
+            n = capped_frontier.pop()
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            self.capped.add(n.id)
+            capped_frontier.extend(n.parents)
+
+    def udf_fields(self) -> List[Tuple[E.Node, str, Callable, bool]]:
+        """(node, role, callable, ships) for every user callable reachable
+        from the DAG.  ``ships`` marks the ones runtime/shiplan.py must
+        ship by reference: ``host_fn`` (oracle-only) and Decomposable
+        members (shipped via their registered parent object) never do."""
+        out: List[Tuple[E.Node, str, Callable, bool]] = []
+        for n in self.nodes:
+            fn = getattr(n, "fn", None)
+            if callable(fn):
+                out.append((n, f"{_node_label(n)}.fn", fn, True))
+            host_fn = getattr(n, "host_fn", None)
+            if callable(host_fn):
+                out.append((n, f"{_node_label(n)}.host_fn", host_fn,
+                            False))
+            if isinstance(n, E.GroupByAgg):
+                for name, spec in n.aggs.items():
+                    if isinstance(spec, E.Decomposable):
+                        for part in ("seed", "merge", "finalize"):
+                            pfn = getattr(spec, part)
+                            if callable(pfn):
+                                out.append((n, f"agg {name}.{part}", pfn,
+                                            False))
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    fn: Callable[[PlanCheck], List[Diagnostic]]
+
+
+def _span(n: E.Node) -> Optional[Span]:
+    return Span.of(getattr(n, "span", None))
+
+
+# ---------------------------------------------------------------------------
+# stream-mode rules — mirror every StreamPlanError raise site
+
+
+def _rule_stream_take(c: PlanCheck) -> List[Diagnostic]:
+    if not (c.cluster and c.has_stream):
+        return []
+    out = []
+    for n in c.nodes:
+        if isinstance(n, E.Take):
+            out.append(Diagnostic(
+                "DTA001", "error",
+                "global take() is not supported over cluster streams — "
+                "collect() then slice, or take() before streaming",
+                _span(n), _node_label(n)))
+    return out
+
+
+def _rule_stream_placeholder(c: PlanCheck) -> List[Diagnostic]:
+    if not (c.cluster and c.has_stream):
+        return []
+    out = []
+    for n in c.nodes:
+        if isinstance(n, E.Placeholder):
+            out.append(Diagnostic(
+                "DTA002", "error",
+                f"placeholder {n.name!r} in a streamed cluster plan — "
+                f"do_while ships loop state as residents; a streamed "
+                f"pipeline cannot be a loop body input",
+                _span(n), _node_label(n)))
+    return out
+
+
+# logical node types -> the physical op kind their lowering emits (the
+# kind runtime/stream_plan checks against its _UNSUPPORTED map)
+_NODE_OP_KINDS = {
+    E.Map: "fn", E.Filter: "filter", E.FlatTokens: "flat_tokens",
+    E.FlatMap: "flat_map", E.ApplyPerPartition: "apply",
+    E.GroupByAgg: "group", E.GroupApply: "group_apply",
+    E.GroupTopK: "group_top_k", E.GroupRankSelect: "group_rank",
+    E.Join: "join", E.OrderBy: "sort", E.Distinct: "distinct",
+    E.Concat: "concat", E.Zip: "zip", E.SlidingWindow: "sliding_window",
+    E.WithRowIndex: "row_index", E.WithCapacity: "recap",
+    E.CrossApply: "apply2",
+}
+
+
+def _rule_stream_unsupported(c: PlanCheck) -> List[Diagnostic]:
+    """Mirror runtime/stream_plan._UNSUPPORTED (currently empty — every
+    operator streams, channelinterface.h:212 parity — but the rule stays
+    so a future entry there is caught statically the same day)."""
+    if not (c.cluster and c.has_stream):
+        return []
+    from dryad_tpu.runtime.stream_plan import _UNSUPPORTED
+    if not _UNSUPPORTED:
+        return []
+    out = []
+    for n in c.nodes:
+        kind = _NODE_OP_KINDS.get(type(n))
+        if kind in _UNSUPPORTED:
+            out.append(Diagnostic(
+                "DTA003", "error",
+                f"op {kind!r} is not supported over cluster streams: "
+                f"{_UNSUPPORTED[kind]}", _span(n), _node_label(n)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hazard rules — contracts the runtime never checks
+
+
+def _rule_capacity_hazard(c: PlanCheck) -> List[Diagnostic]:
+    out = []
+    for n in c.nodes:
+        if not isinstance(n, (E.FlatMap, E.CrossApply, E.Join)):
+            continue
+        if n.id in c.capped:
+            continue
+        what = {E.FlatMap: "flat_map output capacity is a static guess",
+                E.CrossApply: "cross_apply output rides the left "
+                              "capacity",
+                E.Join: "join output capacity is expansion x left "
+                        "capacity"}[type(n)]
+        out.append(Diagnostic(
+            "DTA010", "info",
+            f"{what}; overflow triggers measured capacity retries — "
+            f"bound it with .with_capacity() when the fan-out is known "
+            f"(required inside do_while bodies)",
+            _span(n), _node_label(n)))
+    return out
+
+
+def _rule_redundant_exchange(c: PlanCheck) -> List[Diagnostic]:
+    out = []
+    for n in c.nodes:
+        if isinstance(n, E.HashRepartition):
+            want = E.Partitioning("hash", tuple(n.keys))
+        elif isinstance(n, E.RangeRepartition):
+            want = E.Partitioning("range", tuple(n.keys))
+        else:
+            continue
+        have = n.parents[0].partitioning
+        if have == want:
+            out.append(Diagnostic(
+                "DTA011", "warn",
+                f"redundant {have.kind} repartition on "
+                f"{', '.join(want.keys)}: the input already carries this "
+                f"placement — the exchange moves every row for nothing",
+                _span(n), _node_label(n)))
+    return out
+
+
+def _rule_tee_without_cache(c: PlanCheck) -> List[Diagnostic]:
+    out = []
+    for n in c.nodes:
+        if c.consumers.get(n.id, 0) <= 1 or isinstance(n, E.Source):
+            continue
+        out.append(Diagnostic(
+            "DTA012", "info",
+            f"consumed by {c.consumers[n.id]} downstream branches: the "
+            f"planner materializes it once per query (Tee), but separate "
+            f"queries recompute it — .cache() if reused across terminals "
+            f"or do_while iterations", _span(n), _node_label(n)))
+    return out
+
+
+def _rule_unsound_assume(c: PlanCheck) -> List[Diagnostic]:
+    out = []
+    for n in c.nodes:
+        if not isinstance(n, E.AssumePartitioning):
+            continue
+        have = n.parents[0].partitioning
+        claim = E.Partitioning(n.kind, tuple(n.keys))
+        if have.kind != "none" and have != claim:
+            out.append(Diagnostic(
+                "DTA013", "warn",
+                f"assume_{n.kind}_partition({', '.join(n.keys)}) "
+                f"contradicts the input's known placement "
+                f"{have.kind}({', '.join(have.keys)}) — downstream "
+                f"shuffle elimination will trust the claim and silently "
+                f"mis-group if it is wrong", _span(n), _node_label(n)))
+        if not n.keys and n.kind in ("hash", "range"):
+            out.append(Diagnostic(
+                "DTA013", "warn",
+                f"assume_{n.kind}_partition with no keys claims nothing "
+                f"a lowering can use", _span(n), _node_label(n)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shippability rules — mirror every PlanShipError raise site
+
+
+def _rule_ship_udfs(c: PlanCheck) -> List[Diagnostic]:
+    if not c.cluster:
+        return []
+    out = []
+    seen: Set[int] = set()
+    for n, role, fn, ships in c.udf_fields():
+        if not ships or id(fn) in c.registered_ids or id(fn) in seen:
+            continue
+        why = shippability_of(fn)
+        if why is None:
+            continue
+        seen.add(id(fn))
+        site = fn_def_site(fn)
+        out.append(Diagnostic(
+            "DTA014", "error", f"{role}: {why}",
+            site or _span(n), _node_label(n)))
+    return out
+
+
+def _rule_ship_sources(c: PlanCheck) -> List[Diagnostic]:
+    if not c.cluster:
+        return []
+    out = []
+    for n in c.nodes:
+        if isinstance(n, E.Source) and n.data is not None \
+                and not _is_deferred_source(n.data):
+            out.append(Diagnostic(
+                "DTA015", "error",
+                "cluster execution needs deferred sources — create "
+                "datasets through a Context constructed with cluster=...",
+                _span(n), _node_label(n)))
+    return out
+
+
+def _rule_ship_params(c: PlanCheck) -> List[Diagnostic]:
+    """Non-callable op params that cannot ship: user Decomposable
+    aggregates must be registered by name (shiplan's 'not serializable'
+    raise)."""
+    if not c.cluster:
+        return []
+    out = []
+    for n in c.nodes:
+        if not isinstance(n, E.GroupByAgg):
+            continue
+        for name, spec in n.aggs.items():
+            if isinstance(spec, E.Decomposable) \
+                    and id(spec) not in c.registered_ids:
+                out.append(Diagnostic(
+                    "DTA016", "error",
+                    f"agg {name!r}: Decomposable is not serializable for "
+                    f"cluster execution — register it by name in "
+                    f"Context(fn_table=...) and export it from a worker "
+                    f"--fn-module FN_TABLE", _span(n), _node_label(n)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# UDF determinism lint (DTA10x) — applied to every reachable callable
+
+
+def _rule_udf_determinism(c: PlanCheck) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    linted: Set[int] = set()
+    for n, role, fn, _ships in c.udf_fields():
+        if id(fn) in linted:
+            continue
+        linted.add(id(fn))
+        out.extend(lint_udf(fn, role=role))
+    return out
+
+
+RULES: List[Rule] = [
+    Rule("DTA001", "stream-global-take", _rule_stream_take),
+    Rule("DTA002", "stream-placeholder", _rule_stream_placeholder),
+    Rule("DTA003", "stream-unsupported-op", _rule_stream_unsupported),
+    Rule("DTA010", "capacity-hazard", _rule_capacity_hazard),
+    Rule("DTA011", "redundant-exchange", _rule_redundant_exchange),
+    Rule("DTA012", "tee-without-cache", _rule_tee_without_cache),
+    Rule("DTA013", "unsound-assume", _rule_unsound_assume),
+    Rule("DTA014", "udf-not-shippable", _rule_ship_udfs),
+    Rule("DTA015", "source-not-shippable", _rule_ship_sources),
+    Rule("DTA016", "param-not-serializable", _rule_ship_params),
+    # the UDF determinism rule fans out to DTA101..DTA104
+    Rule("DTA101", "udf-determinism", _rule_udf_determinism),
+]
+
+# codes a static rule can emit (the drift test checks runtime raise sites
+# against this set ∪ RUNTIME_ONLY_CODES)
+STATIC_RULE_CODES = frozenset(
+    {r.code for r in RULES} | {"DTA102", "DTA103", "DTA104"})
+
+
+def check_plan(root: E.Node, cluster: bool = False,
+               fn_table: Optional[Dict[str, Any]] = None
+               ) -> DiagnosticReport:
+    """Run every rule over the DAG rooted at ``root``; returns ALL
+    findings at once.  ``cluster`` turns on the shippability family and
+    hardens stream rules to the cluster-stream contract; ``fn_table``
+    names callables that are pre-registered for shipping."""
+    check = PlanCheck(root, cluster=cluster, fn_table=fn_table)
+    report = DiagnosticReport()
+    for rule in RULES:
+        report.diagnostics.extend(rule.fn(check))
+    return report

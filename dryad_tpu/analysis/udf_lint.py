@@ -1,0 +1,248 @@
+"""AST-based determinism + shippability lint for user UDFs.
+
+Replay-based fault tolerance (ARCHITECTURE.md "Determinism") is only sound
+when UDFs are deterministic: a replayed stage must recompute byte-identical
+output, and the reference's whole recovery model (re-run the vertex from
+its inputs, DrVertex replay) carries the same silent assumption.  Nothing
+enforced it until now — this module walks the UDF's AST and flags the
+constructs that break replay:
+
+* wall-clock / RNG / uuid / os.urandom calls without a fixed seed (DTA101)
+* ``id()`` and builtin ``hash()`` — interpreter/object-identity dependent
+  (``hash`` of str/bytes is salted per process) (DTA102)
+* iteration over sets — order varies across processes (DTA103)
+* mutation of captured (closure/global) state — replays observe
+  different values (DTA104)
+
+Shippability (the reference's serializable-expression constraint,
+QueryParser.cs:100 `assembly!class.method` entries) is checked by
+``shippability_of``: the same importability test runtime/shiplan.py applies
+at submit, surfaced pre-submit with the UDF's definition site.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List, Optional, Tuple
+
+from dryad_tpu.analysis.diagnostics import Diagnostic, Span
+
+__all__ = ["lint_udf", "fn_def_site", "shippability_of"]
+
+# dotted-call prefixes that are nondeterministic across replays
+# (jax.random is NOT here: it is functionally pure — explicit keys)
+_NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "secrets.", "uuid.")
+# exact dotted calls that are nondeterministic
+_NONDET_CALLS = {"os.urandom", "os.getpid", "datetime.datetime.now",
+                 "datetime.datetime.utcnow", "datetime.date.today"}
+# seeded-constructor suffixes: a constant argument fixes the stream, so
+# the call IS deterministic (np.random.RandomState(0), random.Random(7),
+# jax.random.PRNGKey(0), np.random.default_rng(3))
+_SEEDED_CTORS = (".RandomState", ".default_rng", ".Random", ".PRNGKey",
+                 ".key", ".seed")
+# methods that mutate their receiver in place
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "sort",
+             "reverse"}
+
+
+def fn_def_site(fn: Callable) -> Optional[Span]:
+    """Definition site (file:line) of a Python callable, if knowable."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    return Span(code.co_filename, code.co_firstlineno,
+                getattr(fn, "__qualname__", ""))
+
+
+def shippability_of(fn: Callable) -> Optional[str]:
+    """None if ``fn`` ships to a cluster (importable as module:qualname),
+    else a human explanation mirroring runtime/shiplan's rejection."""
+    from dryad_tpu.runtime.shiplan import _import_ref
+    if _import_ref(fn) is not None:
+        return None
+    qual = getattr(fn, "__qualname__", repr(fn))
+    kind = "lambda" if "<lambda>" in str(qual) else \
+        "closure/non-importable callable"
+    return (f"{kind} {qual!r} cannot ship to workers — move it to module "
+            f"level, or register it by name via register_fn_table "
+            f"(runtime/shiplan.py) / Context(fn_table=...)")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """a.b.c attribute chain as a dotted string (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _fn_source(fn: Callable) -> Optional[Tuple[ast.AST, str, int]]:
+    """(parsed AST, filename, first source line) or None when the source
+    is unavailable (builtins, C extensions, exec'd code)."""
+    try:
+        lines, start = inspect.getsourcelines(fn)
+    except (OSError, TypeError):
+        return None
+    src = textwrap.dedent("".join(lines))
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        # inline lambdas may yield an unparsable statement fragment; the
+        # shippability check still covers them via the code object
+        return None
+    fname = getattr(fn, "__code__", None)
+    return tree, (fname.co_filename if fname else "<unknown>"), start
+
+
+class _UdfVisitor(ast.NodeVisitor):
+    def __init__(self, fn: Callable):
+        self.findings: List[Tuple[str, str, int]] = []  # (code, msg, line)
+        code = getattr(fn, "__code__", None)
+        self.freevars = set(code.co_freevars) if code else set()
+        # captured globals that are MUTABLE containers: mutating them in a
+        # UDF leaks state across replays/partitions
+        self.mutable_globals = {
+            name for name, v in getattr(fn, "__globals__", {}).items()
+            if isinstance(v, (list, dict, set, bytearray))}
+
+    def _flag(self, code: str, msg: str, node: ast.AST) -> None:
+        self.findings.append((code, msg, getattr(node, "lineno", 1)))
+
+    # -- nondeterministic calls -------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        if dotted is not None:
+            if dotted == "id":
+                self._flag("DTA102",
+                           "id() depends on interpreter object placement "
+                           "— never stable across replays", node)
+            elif dotted == "hash":
+                self._flag("DTA102",
+                           "builtin hash() is salted per process for "
+                           "str/bytes — use ops.hashing for stable keys",
+                           node)
+            elif dotted in ("set", "frozenset"):
+                pass  # construction is fine; iteration is flagged below
+            elif self._is_nondet(dotted, node):
+                self._flag("DTA101",
+                           f"call to {dotted}() is nondeterministic "
+                           f"across replays — seed it explicitly or hoist "
+                           f"it out of the query", node)
+        # mutating a captured container: captured.append(...), including
+        # subscripted receivers like state["k"].append(...) (whose dotted
+        # form is None) — outside the dotted guard on purpose
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            root = node.func.value
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name) and (
+                    root.id in self.freevars
+                    or root.id in self.mutable_globals):
+                self._flag("DTA104",
+                           f"mutates captured state "
+                           f"{root.id!r}.{node.func.attr}() — UDFs "
+                           f"must be pure for replay soundness", node)
+        self.generic_visit(node)
+
+    def _is_nondet(self, dotted: str, node: ast.Call) -> bool:
+        if dotted in _NONDET_CALLS:
+            return True
+        if not (dotted + ".").startswith(_NONDET_PREFIXES):
+            return False
+        # seeded constructors with a literal argument (positional or
+        # keyword: default_rng(seed=42)) are deterministic
+        if dotted.endswith(_SEEDED_CTORS) and any(
+                isinstance(a, ast.Constant)
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]):
+            return False
+        return True
+
+    # -- set iteration order ----------------------------------------------
+
+    def _iter_is_set(self, it: ast.AST) -> bool:
+        if isinstance(it, ast.Set):
+            return True
+        if isinstance(it, ast.Call):
+            d = _dotted(it.func)
+            return d in ("set", "frozenset")
+        return False
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._iter_is_set(node.iter):
+            self._flag("DTA103",
+                       "iteration over a set — element order varies by "
+                       "process (hash salting); sort first", node)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node: ast.comprehension) -> None:
+        if self._iter_is_set(node.iter):
+            self._flag("DTA103",
+                       "comprehension over a set — element order varies "
+                       "by process; sort first", node.iter)
+        self.generic_visit(node)
+
+    # -- captured-state mutation ------------------------------------------
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._flag("DTA104",
+                   f"rebinds global(s) {', '.join(node.names)} — UDFs "
+                   f"must be pure for replay soundness", node)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self._flag("DTA104",
+                   f"rebinds closure variable(s) {', '.join(node.names)} "
+                   f"— UDFs must be pure for replay soundness", node)
+
+    def _check_store_target(self, tgt: ast.AST, node: ast.AST) -> None:
+        if isinstance(tgt, ast.Subscript):
+            root = tgt.value
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name) and (
+                    root.id in self.freevars
+                    or root.id in self.mutable_globals):
+                self._flag("DTA104",
+                           f"assigns into captured state {root.id!r}[...] "
+                           f"— UDFs must be pure for replay soundness",
+                           node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_store_target(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store_target(node.target, node)
+        self.generic_visit(node)
+
+
+def lint_udf(fn: Callable, role: str = "udf") -> List[Diagnostic]:
+    """Determinism findings for one callable (empty when the source is
+    unavailable — builtins / C extensions are framework-owned)."""
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.split(".")[0] in ("jax", "jaxlib", "numpy", "builtins"):
+        return []
+    parsed = _fn_source(fn)
+    if parsed is None:
+        return []
+    tree, fname, first_line = parsed
+    v = _UdfVisitor(fn)
+    v.visit(tree)
+    qual = getattr(fn, "__qualname__", role)
+    out = []
+    for code, msg, lineno in v.findings:
+        out.append(Diagnostic(
+            code, "warn", f"{role} {qual!r}: {msg}",
+            Span(fname, first_line + lineno - 1, str(qual)), node=role))
+    return out

@@ -84,6 +84,7 @@ class Context:
             # SURVEY.md §3.5)
             self._resident_producers: Dict[str, Any] = {}
             return
+        self._event_log = event_log
         self.mesh = mesh if mesh is not None else make_mesh()
         self.nparts = self.mesh.devices.size
         # multi-level meshes trigger hierarchical aggregation plans; the
@@ -96,6 +97,29 @@ class Context:
         self.executor = Executor(self.mesh, event_log=event_log,
                                  config=self.config)
 
+    # -- pre-submit static analysis (dryad_tpu/analysis) --------------------
+
+    def _pre_submit_lint(self, node, cluster: bool) -> None:
+        """JobConfig.lint gate: verify the plan + lint its UDFs BEFORE any
+        executor/cluster work starts (the reference's phase-1 static
+        validation point, DryadLinqQueryGen.cs).  "warn" logs findings to
+        the EventLog; "error" refuses to submit on error-severity
+        findings (analysis.LintError)."""
+        mode = getattr(self.config, "lint", "off")
+        if mode == "off":
+            return
+        from dryad_tpu.analysis import LintError, check_plan
+        report = check_plan(node, cluster=cluster, fn_table=self.fn_table)
+        ev = self._event_log
+        if ev is not None:
+            for d in report:
+                ev({"event": "lint_finding", "code": d.code,
+                    "severity": d.severity, "message": d.message,
+                    "node": d.node,
+                    "span": str(d.span) if d.span else None})
+        if mode == "error" and report.errors:
+            raise LintError(report)
+
     # -- cluster submission -------------------------------------------------
 
     def _cluster_run(self, node, collect: bool = True,
@@ -103,13 +127,16 @@ class Context:
                      store_partitioning: Optional[Dict[str, Any]] = None,
                      keep_token: Optional[str] = None,
                      want_reply: bool = False,
-                     store_compression: Optional[str] = None):
+                     store_compression: Optional[str] = None,
+                     lint: bool = True):
         """Plan, serialize, and submit one query to the worker gang.
         Returns the host table (default) or, with ``want_reply``, worker
         0's full reply (resident-cache metadata included).  Queued token
         releases from dropped cached Datasets piggyback on every job."""
         from dryad_tpu.runtime import ClusterJobError, WorkerFailure
         from dryad_tpu.runtime.shiplan import serialize_for_cluster
+        if lint:
+            self._pre_submit_lint(node, cluster=True)
         graph = plan_query(node, self.nparts, hosts=self.hosts,
                            levels=self.levels,
                            config=self.config)
@@ -444,11 +471,14 @@ class Context:
                                               keep_token=token,
                                               want_reply=True)
                     cap = reply["resident_capacity"]
-                    for _ in range(n_iters):
+                    for it in range(n_iters):
+                        # the body plan is structurally identical every
+                        # round (subst only swaps the placeholder for the
+                        # resident token): lint it ONCE, not per iteration
                         reply = self._cluster_run(
                             subst(body_node, token, cap),
                             collect=cond is not None, keep_token=token,
-                            want_reply=True)
+                            want_reply=True, lint=it == 0)
                         cap = reply["resident_capacity"]
                         if cond is not None and not cond(reply["table"]):
                             break
@@ -890,6 +920,7 @@ class Dataset:
         """Plan with ONE logical partition and execute over chunk streams
         (exec/stream_exec.py); returns the lazy output ChunkSource."""
         from dryad_tpu.exec.stream_exec import run_stream_graph
+        self.ctx._pre_submit_lint(self.node, cluster=False)
         graph = plan_query(self.node, 1, hosts=1, config=self.ctx.config)
         return run_stream_graph(graph, self.ctx.config,
                                 spill_dir=self.ctx.spill_dir,
@@ -897,6 +928,7 @@ class Dataset:
                                 if self.ctx.executor else None)
 
     def _materialize(self) -> PData:
+        self.ctx._pre_submit_lint(self.node, cluster=False)
         graph = plan_query(self.node, self.ctx.nparts,
                            hosts=self.ctx.hosts,
                            levels=self.ctx.levels,
@@ -1054,8 +1086,27 @@ class Dataset:
         t = self.take(1).collect()
         return {k: v[0] for k, v in t.items()}
 
-    def explain(self) -> str:
-        return plan_query(self.node, self.ctx.nparts,
+    # -- static analysis ---------------------------------------------------
+
+    def check(self, cluster: Optional[bool] = None):
+        """Statically verify this query — plan rules + UDF determinism/
+        shippability lint — WITHOUT executing anything (the reference's
+        phase-1 validation, DryadLinqQueryGen.cs, as a user call).
+        Returns an ``analysis.DiagnosticReport`` with every finding at
+        once (stable DTA0xx/DTA1xx codes, source spans).  ``cluster``
+        forces the cluster-shipping rules on/off; default: whether this
+        Context targets a cluster."""
+        from dryad_tpu.analysis import check_plan
+        if cluster is None:
+            cluster = self.ctx.cluster is not None
+        return check_plan(self.node, cluster=cluster,
+                          fn_table=self.ctx.fn_table)
+
+    def explain(self, verify: bool = False) -> str:
+        text = plan_query(self.node, self.ctx.nparts,
                           hosts=self.ctx.hosts,
                           levels=self.ctx.levels,
                           config=self.ctx.config).explain()
+        if verify:
+            text += "\n\ndiagnostics:\n" + self.check().render()
+        return text

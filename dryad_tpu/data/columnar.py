@@ -24,7 +24,7 @@ collapses to host<->device transfer of dense arrays.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Mapping, Sequence
+from typing import Any, Dict, Mapping, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,7 @@ fifo (channelbufferqueue.cpp:777 buffered block sizing).
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 __all__ = ["pick_chunk_rows", "measured_rates"]
 

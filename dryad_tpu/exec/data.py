@@ -12,14 +12,13 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Dict, Mapping, Optional, Sequence
+from typing import Any, Dict, Mapping, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dryad_tpu.data.columnar import (Batch, StringColumn,
-                                     string_column_from_list)
+from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.parallel.mesh import batch_sharding
 
 __all__ = ["PData", "pdata_from_host", "pdata_to_host", "put_batch",

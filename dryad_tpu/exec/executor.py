@@ -20,7 +20,7 @@ exec/recovery.py.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +32,7 @@ from dryad_tpu.ops import kernels
 from dryad_tpu.ops.text import (lower_ascii, split_tokens,
                                 tokenize_group_count)
 from dryad_tpu.parallel import shuffle
-from dryad_tpu.parallel.mesh import PARTITION_AXIS, partition_spec
+from dryad_tpu.parallel.mesh import PARTITION_AXIS
 from dryad_tpu.plan.stages import Exchange, Stage, StageGraph, StageOp
 from jax.sharding import PartitionSpec as P
 

@@ -20,7 +20,7 @@ disk instead of recomputing, and a NEW process can resume the run
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 from dryad_tpu.exec.data import PData
 from dryad_tpu.plan.stages import StageGraph

@@ -40,14 +40,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from dryad_tpu.data.columnar import Batch, StringColumn
+from dryad_tpu.data.columnar import Batch
 from dryad_tpu.exec import ooc
 from dryad_tpu.exec.ooc import (ChunkSource, HChunk, OOCError,
                                 _batch_to_chunk, _chunk_to_batch,
                                 _concat_hchunks, _slice_hchunk, chunk_schema)
 from dryad_tpu.ops import kernels
 from dryad_tpu.ops.text import lower_ascii, split_tokens
-from dryad_tpu.plan.stages import Stage, StageGraph, StageOp
+from dryad_tpu.plan.stages import StageGraph, StageOp
 
 __all__ = ["StreamSource", "StreamExecutionError", "run_stream_graph",
            "chunks_to_table"]

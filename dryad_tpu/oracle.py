@@ -120,8 +120,6 @@ def _eval_decomposable(dec: "E.Decomposable", t: Dict[str, Any],
     applied per single-row state)."""
     import functools
 
-    import jax
-
     from dryad_tpu.data.columnar import string_column_from_list
 
     # string columns feed seed as 1-row StringColumns (the same columnar

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.ops.hashing import hash_batch_keys
 from dryad_tpu.ops.kernels import sort_lanes_for
-from dryad_tpu.parallel.mesh import HOST_AXIS, PARTITION_AXIS
+from dryad_tpu.parallel.mesh import PARTITION_AXIS
 
 __all__ = ["exchange_by_dest", "hash_exchange", "range_exchange",
            "broadcast_gather", "range_dest_lane", "zip_exchange",

@@ -171,10 +171,27 @@ class Planner:
             for p in n.parents:
                 self.consumers[p.id] = self.consumers.get(p.id, 0) + 1
         for n in order:
+            pre_stages = len(self.stages)
             frag = self._lower(n)
             if self.consumers.get(n.id, 0) > 1:
                 _, frag = self._materialize(frag, label=f"tee:{type(n).__name__}")
             self.frags[n.id] = frag
+            # provenance: ops created lowering THIS node (in the pending
+            # fragment or in stages it cut) inherit its creation span;
+            # ops carried over from earlier fragments keep their own
+            span = getattr(n, "span", None)
+            if span is not None:
+                for op in frag.ops:
+                    if op.span is None:
+                        op.span = span
+                for st in self.stages[pre_stages:]:
+                    for leg in st.legs:
+                        for op in leg.ops:
+                            if op.span is None:
+                                op.span = span
+                    for op in st.body:
+                        if op.span is None:
+                            op.span = span
         out_id, _ = self._materialize(self.frags[root.id], label="output")
         # a placement claim flows backward through exchange-less legs
         # (Tee/materialize pass-throughs), so reliance must disable

@@ -56,8 +56,11 @@ def _op_to_json(op: StageOp, fn_names: Dict[int, str],
         # fn_table like other UDFs
         return {"__opaque__": f"{op.kind}.{pname}"}
 
-    return {"kind": op.kind,
-            "params": {k: enc(v, k) for k, v in op.params.items()}}
+    d = {"kind": op.kind,
+         "params": {k: enc(v, k) for k, v in op.params.items()}}
+    if op.span is not None:
+        d["span"] = list(op.span)
+    return d
 
 
 def _op_from_json(d: dict, fn_table: Optional[Dict[str, Callable]],
@@ -89,7 +92,9 @@ def _op_from_json(d: dict, fn_table: Optional[Dict[str, Callable]],
             return tuple(dec(x) for x in v)
         return v
 
-    return StageOp(d["kind"], {k: dec(v) for k, v in d["params"].items()})
+    span = tuple(d["span"]) if d.get("span") else None
+    return StageOp(d["kind"], {k: dec(v) for k, v in d["params"].items()},
+                   span=span)
 
 
 def graph_to_json(graph: StageGraph,

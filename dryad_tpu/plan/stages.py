@@ -37,6 +37,10 @@ class StageOp:
 
     kind: str
     params: Dict[str, Any]
+    # user-source provenance of the logical node this op lowers
+    # ((file, line, func), plan/expr._creation_span): diagnostics and
+    # runtime errors cite the query line.  NOT part of fingerprint().
+    span: Optional[Tuple[str, int, str]] = None
 
 
 @dataclasses.dataclass

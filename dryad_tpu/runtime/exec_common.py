@@ -52,7 +52,7 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
     ``release`` drops tokens no longer referenced."""
     import jax
 
-    from dryad_tpu.exec.data import PData, replicate_tree
+    from dryad_tpu.exec.data import replicate_tree
     from dryad_tpu.exec.executor import Executor
     from dryad_tpu.plan.serialize import graph_from_json
     from dryad_tpu.runtime.sources import build_source

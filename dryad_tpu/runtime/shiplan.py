@@ -15,15 +15,31 @@ import importlib
 import json
 from typing import Any, Callable, Dict, Iterable, Tuple
 
+from dryad_tpu.analysis.diagnostics import DiagnosticError
 from dryad_tpu.plan.serialize import graph_to_json
 from dryad_tpu.plan.stages import StageGraph
 from dryad_tpu.runtime.sources import DeferredSource
 
-__all__ = ["PlanShipError", "serialize_for_cluster", "resolve_fn_table"]
+__all__ = ["PlanShipError", "serialize_for_cluster", "resolve_fn_table",
+           "register_fn_table"]
 
 
-class PlanShipError(RuntimeError):
-    pass
+class PlanShipError(DiagnosticError):
+    """Shipping-contract violation.  Carries the stable diagnostic code
+    of the dryad_tpu/analysis rule that catches the same condition
+    pre-submit (DTA014/015/016; DTA905 is worker-side deploy-only)."""
+
+
+# process-global shipping names (merged UNDER Context(fn_table=...)):
+# a convenience registry so library code can pre-register its UDFs once
+_GLOBAL_FN_TABLE: Dict[str, Any] = {}
+
+
+def register_fn_table(table: Dict[str, Any]) -> None:
+    """Register callables/Decomposables by shipping name for every later
+    ``serialize_for_cluster`` in this process.  Workers must still export
+    the same names from a ``--fn-module`` FN_TABLE."""
+    _GLOBAL_FN_TABLE.update(table)
 
 
 def _import_ref(fn: Callable) -> str | None:
@@ -61,12 +77,19 @@ def _collect_refs(graph: StageGraph,
         if callable(v):
             ref = _import_ref(v)
             if ref is None:
+                code_obj = getattr(v, "__code__", None)
+                defined = (f", defined at {code_obj.co_filename}:"
+                           f"{code_obj.co_firstlineno}"
+                           if code_obj is not None else "")
                 raise PlanShipError(
                     f"op {op.kind!r} param {pname!r}: callable "
-                    f"{getattr(v, '__qualname__', v)!r} is not importable "
-                    f"(lambda/closure?) — move it to module level, or "
-                    f"register it by name in Context(fn_table=...) and "
-                    f"export it from a worker --fn-module FN_TABLE")
+                    f"{getattr(v, '__qualname__', v)!r}{defined} is not "
+                    f"importable (lambda/closure?) — move it to module "
+                    f"level, or register it by name via "
+                    f"runtime.shiplan.register_fn_table({{name: fn}}) / "
+                    f"Context(fn_table=...) and export it from a worker "
+                    f"--fn-module FN_TABLE",
+                    code="DTA014", span=op.span)
             fn_names[id(v)] = ref
             return
         if isinstance(v, (tuple, list)):
@@ -80,8 +103,10 @@ def _collect_refs(graph: StageGraph,
         raise PlanShipError(
             f"op {op.kind!r} param {pname!r} ({type(v).__name__}) is "
             f"not serializable for cluster execution — register it by "
-            f"name in Context(fn_table=...) and export it from a worker "
-            f"--fn-module FN_TABLE")
+            f"name via runtime.shiplan.register_fn_table({{name: value}}) "
+            f"/ Context(fn_table=...) and export it from a worker "
+            f"--fn-module FN_TABLE",
+            code="DTA016", span=op.span)
 
     for st in graph.stages:
         ops = [o for leg in st.legs for o in leg.ops] + list(st.body)
@@ -97,7 +122,9 @@ def serialize_for_cluster(graph: StageGraph,
                           user_fn_table: Dict[str, Any] | None = None
                           ) -> Tuple[str, Dict[str, Dict[str, Any]]]:
     """Returns (plan_json, source_specs keyed "sid:leg")."""
-    user_names = {id(v): k for k, v in (user_fn_table or {}).items()}
+    merged = dict(_GLOBAL_FN_TABLE)
+    merged.update(user_fn_table or {})
+    user_names = {id(v): k for k, v in merged.items()}
     fn_names = _collect_refs(graph, user_names)
     plan_json = graph_to_json(graph, fn_names)
     specs: Dict[str, Dict[str, Any]] = {}
@@ -106,10 +133,12 @@ def serialize_for_cluster(graph: StageGraph,
             if isinstance(leg.src, tuple) and leg.src[0] == "source":
                 v = leg.src[1]
                 if not isinstance(v, DeferredSource):
+                    span = next((o.span for o in leg.ops
+                                 if o.span is not None), None)
                     raise PlanShipError(
                         "cluster execution needs deferred sources — create "
                         "datasets through a Context constructed with "
-                        "cluster=...")
+                        "cluster=...", code="DTA015", span=span)
                 specs[f"{st.id}:{li}"] = v.spec
     return plan_json, specs
 
@@ -152,5 +181,6 @@ def resolve_fn_table(plan_json: str,
             table[name] = obj
         else:
             raise PlanShipError(
-                f"plan references {name!r} but no --fn-module exports it")
+                f"plan references {name!r} but no --fn-module exports it",
+                code="DTA905")
     return table

@@ -36,13 +36,19 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dryad_tpu.plan.stages import Exchange, Stage, StageGraph, StageOp
+from dryad_tpu.analysis.diagnostics import DiagnosticError
+from dryad_tpu.plan.stages import Exchange, Stage, StageOp
 
 __all__ = ["execute_stream_plan", "has_stream_sources", "StreamPlanError"]
 
 
-class StreamPlanError(RuntimeError):
-    pass
+class StreamPlanError(DiagnosticError):
+    """Streamed-plan contract violation.  Every raise carries the stable
+    diagnostic code of the dryad_tpu/analysis rule that catches the same
+    condition pre-submit (DTA001/002/003), or a DTA9xx runtime-only code
+    for data-dependent overflows and internal invariants — see
+    analysis/diagnostics.CODES; tests/test_analysis.py asserts the
+    mapping has no drift."""
 
 
 # leg-op kinds safe to apply PER CHUNK inside the wave program: chunk-local
@@ -163,7 +169,8 @@ def _wave_chunk_op(b, op: StageOp, scale: int):
             b, list(p["keys"]), p["decs"], p["box"]), no
     if k == "distinct":
         return kernels.distinct(b, list(p["keys"]) or None), no
-    raise StreamPlanError(f"op {k!r} cannot ride a wave program")
+    raise StreamPlanError(f"op {k!r} cannot ride a wave program",
+                          code="DTA901", span=op.span)
 
 
 def _build_wave_fn(mesh, leg_ops: List[StageOp], ex: Exchange,
@@ -200,7 +207,8 @@ def _build_wave_fn(mesh, leg_ops: List[StageOp], ex: Exchange,
             out, nr, nsl = shuffle.broadcast_gather(b, out_cap, axes=axes)
             slot = jnp.zeros((), jnp.int32)
         else:
-            raise StreamPlanError(f"exchange kind {ex.kind!r}")
+            raise StreamPlanError(f"exchange kind {ex.kind!r}",
+                                  code="DTA902")
         exch_scale = (-(-nr // jnp.int32(max(1, ex.out_capacity)))
                       ).astype(jnp.int32)
         need_scale = jnp.maximum(need_local, exch_scale)
@@ -280,7 +288,8 @@ def _run_leg_waves(dev: _DevStreams, leg_ops: List[StageOp], ex: Exchange,
         if out.n > out_cap:
             raise StreamPlanError(
                 f"bucket {start + d} holds {out.n} distinct groups > "
-                f"exchange capacity {out_cap}; raise chunk_rows")
+                f"exchange capacity {out_cap}; raise chunk_rows",
+                code="DTA903")
         store._ram[d] = [out]
 
     fns: Dict[Tuple, Any] = {}
@@ -330,7 +339,8 @@ def _run_leg_waves(dev: _DevStreams, leg_ops: List[StageOp], ex: Exchange,
         else:
             raise StreamPlanError(
                 "wave exchange still overflowing after "
-                f"{config.max_capacity_retries} retries (scale={scale})")
+                f"{config.max_capacity_retries} retries (scale={scale})",
+                code="DTA904")
         local = _read_local_shards(out, start, dpp)
         _, wave_chunks = local_batch_chunks(local)
         for d, hc in enumerate(wave_chunks):
@@ -433,11 +443,13 @@ def _apply_whole_stream_ops(cs, ops: List[StageOp], config, job_root):
             if payload.kind in _UNSUPPORTED:
                 raise StreamPlanError(
                     f"op {payload.kind!r} is not supported over cluster "
-                    f"streams: {_UNSUPPORTED[payload.kind]}")
+                    f"streams: {_UNSUPPORTED[payload.kind]}",
+                    code="DTA003", span=payload.span)
             if payload.kind == "take" and payload.params.get("global"):
                 raise StreamPlanError(
                     "global take over cluster streams is not supported — "
-                    "collect() then slice, or take() before streaming")
+                    "collect() then slice, or take() before streaming",
+                    code="DTA001", span=payload.span)
             cs = stream_exec._stream_global(cs, payload, config, job_root)
     return cs
 
@@ -467,10 +479,12 @@ def _run_body(legs_out: List[_DevStreams], body: List[StageOp], config,
             elif op.kind in _UNSUPPORTED:
                 raise StreamPlanError(
                     f"op {op.kind!r} is not supported over cluster "
-                    f"streams: {_UNSUPPORTED[op.kind]}")
+                    f"streams: {_UNSUPPORTED[op.kind]}",
+                    code="DTA003", span=op.span)
             elif op.kind == "take" and op.params.get("global"):
                 raise StreamPlanError(
-                    "global take over cluster streams is not supported")
+                    "global take over cluster streams is not supported",
+                    code="DTA001", span=op.span)
             elif op.kind in stream_exec._STREAM_KINDS \
                     or op.kind == "dgroup_merge":
                 cur = _body_stream_global(cur, op, config, job_root)
@@ -478,7 +492,8 @@ def _run_body(legs_out: List[_DevStreams], body: List[StageOp], config,
                 cur = stream_exec._stream_local(cur, [op], config)
             else:
                 raise StreamPlanError(
-                    f"op {op.kind!r} unsupported over cluster streams")
+                    f"op {op.kind!r} unsupported over cluster streams",
+                    code="DTA003", span=op.span)
         outs.append(cur)
     return _DevStreams(outs)
 
@@ -591,7 +606,8 @@ def execute_stream_plan(plan_json: str, fn_table, source_specs, mesh,
             else:
                 raise StreamPlanError(
                     "placeholders are not supported in streamed cluster "
-                    "plans (do_while ships loop state as residents)")
+                    "plans (do_while ships loop state as residents)",
+                    code="DTA002")
             src = as_dev_streams(src)
             if leg.exchange is None:
                 streams = [

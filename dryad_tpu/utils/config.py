@@ -188,6 +188,15 @@ class JobConfig:
     # -- iteration (api do_while) ------------------------------------------
     max_loop_iterations: int = 1000
 
+    # -- pre-submit static analysis (dryad_tpu/analysis) -------------------
+    # gate every executor/cluster/stream submission through the plan
+    # verifier + UDF lint (the reference's phase-1 static validation,
+    # DryadLinqQueryGen.cs): "off" = no checking, "warn" = run the job
+    # but log findings to the EventLog (viewer Diagnostics section),
+    # "error" = refuse to submit when error-severity findings exist
+    # (analysis.LintError).  Dataset.check() is the interactive form.
+    lint: str = "off"
+
     def __post_init__(self):
         checks = [
             (self.ooc_group_bucket_rows > 0,
@@ -239,6 +248,8 @@ class JobConfig:
             (self.broadcast_join_threshold >= 0,
              "broadcast_join_threshold >= 0"),
             (self.max_loop_iterations >= 1, "max_loop_iterations >= 1"),
+            (self.lint in ("off", "warn", "error"),
+             "lint in ('off', 'warn', 'error')"),
         ]
         for ok, msg in checks:
             if not ok:

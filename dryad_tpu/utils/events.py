@@ -24,6 +24,7 @@ _LEVELS = {
     "worker_wedged": 0,
     "stage_done": 1, "plan": 1, "stage_spilled": 1, "stage_restored": 1,
     "task_done": 1, "task_duplicated": 1, "task_reassigned": 1,
+    "lint_finding": 1,
     "progress": 2, "task_duplicate_ignored": 2,
 }
 

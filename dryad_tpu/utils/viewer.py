@@ -316,6 +316,35 @@ def diagnose(events) -> List[Dict[str, Any]]:
     return out
 
 
+def _lint_html(events) -> str:
+    """Static-analysis findings ("lint_finding" events, emitted by the
+    JobConfig.lint pre-submit gate in api/dataset.py) as a Diagnostics
+    section — present only when the stream carries findings."""
+    recs = [e for e in events if e.get("event") == "lint_finding"]
+    if not recs:
+        return ""
+    sev_rank = {"error": 0, "warn": 1, "info": 2}
+    icon = {"error": "&#10006; error", "warn": "&#9888; warn",
+            "info": "&#8505; info"}
+    rows = []
+    for e in sorted(recs, key=lambda e: (sev_rank.get(e.get("severity"),
+                                                      3),
+                                         e.get("code", ""))):
+        sev = e.get("severity", "info")
+        cls = ("critical" if sev == "error"
+               else "warning" if sev == "warn" else "ink2")
+        rows.append(
+            f'<tr><td style="color: var(--{cls})">'
+            f'{icon.get(sev, sev)}</td>'
+            f'<td>{html.escape(str(e.get("code", "")))}</td>'
+            f'<td>{html.escape(str(e.get("message", "")))}</td>'
+            f'<td>{html.escape(str(e.get("span") or ""))}</td></tr>')
+    head = ("<tr><th>severity</th><th>code</th><th>finding</th>"
+            "<th>source</th></tr>")
+    return ("<h2>Diagnostics (static analysis)</h2>"
+            f"<table class='lint'>{head}{''.join(rows)}</table>")
+
+
 def _diagnosis_html(events) -> str:
     recs = diagnose(events)
     if not recs:
@@ -405,6 +434,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
     text-align: right; }}
   th {{ color: var(--ink2); font-weight: 600; }}
   td:nth-child(2), th:nth-child(2) {{ text-align: left; }}
+  table.lint th, table.lint td {{ text-align: left; }}
   .diag {{ border: 1px solid var(--critical); border-radius: 8px;
     padding: 10px 14px; margin: 8px 0; }}
   .diag .hl {{ color: var(--critical); }}
@@ -414,6 +444,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
 <h1>{html.escape(title)}</h1>
 <div class="tiles">{tile_html}</div>
 {_diagnosis_html(events)}
+{_lint_html(events)}
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}
 <h2>Gantt (time from job start)</h2>{_svg_gantt(stages, order)}
 <h2>Per-stage table</h2>{_table(stages, order)}

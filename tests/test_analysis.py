@@ -1,0 +1,550 @@
+"""Static analysis subsystem (dryad_tpu/analysis): rule-by-rule unit
+tests, the all-findings-in-one-pass acceptance pipeline, the pre-submit
+lint gate, the runtime<->analyzer code drift check, the serialized-plan
+CLI, and the apps-are-clean integration sweep."""
+
+import ast
+import inspect
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context, Decomposable
+from dryad_tpu.analysis import (CODES, RULES, RUNTIME_ONLY_CODES,
+                                STATIC_RULE_CODES, LintError, check_plan,
+                                check_plan_json)
+from dryad_tpu.exec.ooc import ChunkSource
+from dryad_tpu.plan import expr as E
+from dryad_tpu.plan.planner import plan_query
+from dryad_tpu.plan.serialize import graph_from_json, graph_to_json
+from dryad_tpu.utils.config import JobConfig
+from dryad_tpu.utils.events import EventLog
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return Context()
+
+
+def _stream_ds(ctx):
+    """A check-only streamed Dataset (never iterated)."""
+    cs = ChunkSource(lambda: iter([]),
+                    {"k": {"kind": "num", "dtype": "int32"}}, 8)
+    return ctx.from_stream(cs)
+
+
+# module-level (shippable) UDFs ------------------------------------------
+
+def doubler(c):
+    return {"k": c["k"], "v": c["v"] * 2}
+
+
+def nondet_udf(c):
+    return {"k": c["k"], "v": c["v"] + time.time()}
+
+
+def fixed_seed_udf(c):
+    rng = np.random.RandomState(0)
+    return {"k": c["k"], "v": c["v"] + rng.randn()}
+
+
+def identity_dep_udf(c):
+    return {"k": c["k"], "v": c["v"] + id(c)}
+
+
+def set_iter_udf(c):
+    s = 0
+    for x in {1, 2, 3}:
+        s += x
+    return {"k": c["k"], "v": c["v"] + s}
+
+
+_LEAKY_STATE = []
+
+
+def leaky_udf(c):
+    _LEAKY_STATE.append(1)
+    return {"k": c["k"], "v": c["v"]}
+
+
+def fm_fn(c):
+    return {"k": c["k"]}, None
+
+
+def _kv(ctx):
+    return ctx.from_columns({"k": np.arange(8, dtype=np.int32),
+                             "v": np.arange(8, dtype=np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# rule-by-rule
+
+
+def test_dta001_stream_global_take(ctx):
+    q = _stream_ds(ctx).take(3)
+    rep = q.check(cluster=True)
+    assert "DTA001" in rep.codes()
+    assert all(d.severity == "error" for d in rep.by_code("DTA001"))
+    # local (non-cluster) streams support global take: no finding
+    assert "DTA001" not in q.check(cluster=False).codes()
+
+
+def test_dta002_stream_placeholder(ctx):
+    ph = E.Placeholder(parents=(), name="__loop", _npartitions=ctx.nparts)
+    node = E.Concat(parents=(_stream_ds(ctx).node, ph))
+    rep = check_plan(node, cluster=True)
+    assert "DTA002" in rep.codes()
+
+
+def test_dta003_mirrors_unsupported_map(ctx, monkeypatch):
+    from dryad_tpu.runtime import stream_plan
+    q = _stream_ds(ctx).zip_with(_stream_ds(ctx))
+    # today nothing is unsupported over cluster streams — rule is silent
+    assert "DTA003" not in q.check(cluster=True).codes()
+    # ...but a future _UNSUPPORTED entry is caught the same day
+    monkeypatch.setattr(stream_plan, "_UNSUPPORTED",
+                        {"zip": "testing drift"})
+    assert "DTA003" in q.check(cluster=True).codes()
+
+
+def test_dta010_capacity_hazard(ctx):
+    q = _kv(ctx).flat_map(fm_fn, out_capacity=16)
+    rep = q.check()
+    assert "DTA010" in rep.codes()
+    assert all(d.severity == "info" for d in rep.by_code("DTA010"))
+    # a with_capacity bound downstream clears the hazard
+    assert "DTA010" not in \
+        q.with_capacity(32).check().codes()
+
+
+def test_dta011_redundant_repartition(ctx):
+    q = _kv(ctx).hash_partition(["k"]).hash_partition(["k"])
+    rep = q.check()
+    assert "DTA011" in rep.codes()
+    d = rep.by_code("DTA011")[0]
+    assert d.severity == "warn" and d.span is not None
+    assert "test_analysis.py" in d.span.file
+    # range flavor
+    qr = _kv(ctx).order_by([("k", False)]).range_partition(["k"])
+    assert "DTA011" in qr.check().codes()
+    # a DIFFERENT key is not redundant
+    q2 = _kv(ctx).hash_partition(["k"]).hash_partition(["v"])
+    assert "DTA011" not in q2.check().codes()
+
+
+def test_dta012_tee_without_cache(ctx):
+    base = _kv(ctx).select(doubler)
+    a = base.where(doubler)
+    b = base.where(doubler)
+    rep = a.concat(b).check()
+    assert "DTA012" in rep.codes()
+    assert all(d.severity == "info" for d in rep.by_code("DTA012"))
+
+
+def test_dta013_unsound_assume(ctx):
+    q = _kv(ctx).hash_partition(["k"]).assume_hash_partition(["v"])
+    rep = q.check()
+    assert "DTA013" in rep.codes()
+    # matching claim is sound
+    ok = _kv(ctx).hash_partition(["k"]).assume_hash_partition(["k"])
+    assert "DTA013" not in ok.check().codes()
+
+
+def test_dta014_unshippable_udf(ctx):
+    q = _kv(ctx).select(lambda c: {"k": c["k"]})
+    rep = q.check(cluster=True)
+    assert "DTA014" in rep.codes()
+    d = rep.by_code("DTA014")[0]
+    assert d.severity == "error"
+    assert d.span is not None and "test_analysis.py" in d.span.file
+    # module-level functions ship fine
+    assert "DTA014" not in _kv(ctx).select(doubler).check(
+        cluster=True).codes()
+    # no cluster target: lambdas are fine
+    assert "DTA014" not in q.check(cluster=False).codes()
+
+
+def test_dta014_registered_fn_table_ok():
+    fn = lambda c: {"k": c["k"]}  # noqa: E731
+    ctx2 = Context(fn_table={"my_fn": fn})
+    q = _kv(ctx2).select(fn)
+    assert "DTA014" not in q.check(cluster=True).codes()
+
+
+def test_dta014_respects_global_register_fn_table(ctx):
+    """register_fn_table'd UDFs ship (serialize_for_cluster merges the
+    global registry) — the static view must agree, or lint='error'
+    would block jobs the runtime accepts."""
+    from dryad_tpu.runtime import shiplan
+    fn = lambda c: {"k": c["k"]}  # noqa: E731
+    q = _kv(ctx).select(fn)
+    assert "DTA014" in q.check(cluster=True).codes()
+    shiplan.register_fn_table({"globally_known": fn})
+    try:
+        assert "DTA014" not in q.check(cluster=True).codes()
+    finally:
+        shiplan._GLOBAL_FN_TABLE.pop("globally_known", None)
+
+
+def test_dta015_nondeferred_source(ctx):
+    rep = _kv(ctx).select(doubler).check(cluster=True)
+    assert "DTA015" in rep.codes()
+
+
+def test_dta016_unregistered_decomposable(ctx):
+    dec = Decomposable(seed=doubler, merge=doubler)
+    q = _kv(ctx).group_by(["k"], {"agg": dec})
+    rep = q.check(cluster=True)
+    assert "DTA016" in rep.codes()
+    ctx2 = Context(fn_table={"dec": dec})
+    q2 = _kv(ctx2).group_by(["k"], {"agg": dec})
+    assert "DTA016" not in q2.check(cluster=True).codes()
+
+
+def kw_seeded_udf(c):
+    rng = np.random.default_rng(seed=42)
+    return {"k": c["k"], "v": c["v"] + rng.random()}
+
+
+def test_udf_lint_rules(ctx):
+    assert "DTA101" in _kv(ctx).select(nondet_udf).check().codes()
+    # fixed-seed RNG is deterministic: not flagged
+    assert "DTA101" not in _kv(ctx).select(fixed_seed_udf).check().codes()
+    # keyword-seeded constructors are deterministic too
+    assert "DTA101" not in _kv(ctx).select(kw_seeded_udf).check().codes()
+    assert "DTA102" in _kv(ctx).select(identity_dep_udf).check().codes()
+    assert "DTA103" in _kv(ctx).select(set_iter_udf).check().codes()
+    assert "DTA104" in _kv(ctx).select(leaky_udf).check().codes()
+    # clean UDF: no determinism findings
+    clean = _kv(ctx).select(doubler).check()
+    assert not {"DTA101", "DTA102", "DTA103",
+                "DTA104"} & clean.codes()
+
+
+_STATE = {"k": []}
+
+
+def sub_mut_udf(c):
+    _STATE["k"].append(1)
+    return c
+
+
+def test_dta104_subscripted_captured_mutation(ctx):
+    """Mutation through a subscripted receiver (state['k'].append) is
+    still captured-state mutation."""
+    assert "DTA104" in _kv(ctx).select(sub_mut_udf).check().codes()
+
+
+class _FakeCluster:
+    nparts = 4
+    n_processes = 1
+
+    def __init__(self):
+        self.event_log = None
+        self.pending_release = []
+        self.executes = 0
+
+    def execute(self, plan_json, specs, **kw):
+        self.executes += 1
+        return {"resident_capacity": 8, "table": None}
+
+
+def test_do_while_lints_once_per_loop():
+    """Cluster do_while submits a structurally identical body plan every
+    iteration — the lint gate must run once, not n_iters times."""
+    cl = _FakeCluster()
+    ctx2 = Context(cluster=cl, config=JobConfig(lint="warn"))
+    calls = []
+    orig = ctx2._pre_submit_lint
+    ctx2._pre_submit_lint = lambda node, cluster: (
+        calls.append(1), orig(node, cluster))[-1]
+    init = _kv(ctx2)
+    ctx2.do_while(init, lambda ds: ds, n_iters=5)
+    assert cl.executes == 6          # init + 5 iterations ran
+    assert len(calls) == 2           # linted init + body once
+
+
+def test_udf_lint_spans_point_at_udf_line(ctx):
+    rep = _kv(ctx).select(nondet_udf).check()
+    d = rep.by_code("DTA101")[0]
+    assert "test_analysis.py" in d.span.file
+    src_line, first = inspect.getsourcelines(nondet_udf)
+    assert first <= d.span.line < first + len(src_line)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: all findings in ONE pass, zero execution
+
+
+def test_all_findings_one_pass_no_execution(ctx):
+    q = (_stream_ds(ctx)
+         .select(nondet_udf)
+         .select(lambda c: dict(c))
+         .hash_partition(["k"]).hash_partition(["k"])
+         .take(3))
+    # any executor/cluster work would blow up here
+    orig_run = ctx.executor.run
+    ctx.executor.run = lambda *a, **k: pytest.fail(
+        "check() must not execute")
+    try:
+        rep = q.check(cluster=True)
+    finally:
+        ctx.executor.run = orig_run
+    codes = rep.codes()
+    assert {"DTA001", "DTA011", "DTA014", "DTA101"} <= codes
+    for code in ("DTA001", "DTA011", "DTA014", "DTA101"):
+        assert any(d.span is not None for d in rep.by_code(code)), code
+    # one report carries everything, sorted errors-first
+    sevs = [d.severity for d in rep]
+    assert sevs == sorted(sevs, key=["error", "warn", "info"].index)
+
+
+def test_explain_verify(ctx):
+    q = _kv(ctx).hash_partition(["k"]).hash_partition(["k"])
+    out = q.explain(verify=True)
+    assert "diagnostics:" in out and "DTA011" in out
+    assert "DTA011" not in q.explain()
+
+
+# ---------------------------------------------------------------------------
+# pre-submit gate (JobConfig.lint)
+
+
+def test_lint_gate_error_blocks(ctx):
+    cfg = JobConfig(lint="error")
+    ctx2 = Context(config=cfg)
+    q = _kv(ctx2).select(lambda c: dict(c))
+    # cluster-targeted submit with an unshippable lambda: blocked before
+    # any work starts
+    with pytest.raises(LintError) as ei:
+        ctx2._pre_submit_lint(q.node, cluster=True)
+    assert "DTA014" in str(ei.value)
+    # local submit of the same plan has no error findings: runs fine
+    out = q.collect()
+    assert len(out["k"]) == 8
+
+
+def test_lint_gate_warn_runs_and_logs():
+    ev = EventLog()
+    ctx2 = Context(config=JobConfig(lint="warn"), event_log=ev)
+    q = _kv(ctx2).hash_partition(["k"]).hash_partition(["k"])
+    out = q.collect()          # job still runs
+    assert sorted(np.asarray(out["k"])) == list(range(8))
+    findings = ev.of_type("lint_finding")
+    assert any(e["code"] == "DTA011" for e in findings)
+    assert all(e["severity"] in ("error", "warn", "info")
+               for e in findings)
+
+
+def test_lint_off_by_default():
+    assert JobConfig().lint == "off"
+    with pytest.raises(ValueError):
+        JobConfig(lint="loud")
+
+
+def test_viewer_diagnostics_section():
+    from dryad_tpu.utils.viewer import job_report_html
+    events = [{"event": "lint_finding", "code": "DTA011",
+               "severity": "warn", "message": "redundant repartition",
+               "span": "q.py:7", "ts": 1.0},
+              {"event": "stage_done", "stage": 0, "label": "x",
+               "wall_s": 0.1, "ts": 2.0}]
+    doc = job_report_html(events)
+    assert "Diagnostics (static analysis)" in doc
+    assert "DTA011" in doc and "q.py:7" in doc
+    # section absent without findings
+    assert "Diagnostics (static analysis)" not in job_report_html(
+        [e for e in events if e["event"] != "lint_finding"])
+
+
+# ---------------------------------------------------------------------------
+# runtime <-> analyzer drift
+
+
+def _raise_codes(mod, err_name):
+    tree = ast.parse(inspect.getsource(mod))
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Raise)
+                and isinstance(node.exc, ast.Call)):
+            continue
+        f = node.exc.func
+        name = f.id if isinstance(f, ast.Name) else getattr(f, "attr", "")
+        if name != err_name:
+            continue
+        kw = {k.arg: k.value for k in node.exc.keywords}
+        assert "code" in kw, \
+            f"{mod.__name__}:{node.lineno}: raise {err_name} without a " \
+            f"stable code= keyword"
+        assert isinstance(kw["code"], ast.Constant), \
+            f"{mod.__name__}:{node.lineno}: code= must be a literal"
+        out.append((kw["code"].value, node.lineno))
+    return out
+
+
+def test_runtime_raises_match_analyzer_rules():
+    """Every StreamPlanError/PlanShipError raise site carries a stable
+    code that is either (a) emitted by a static-analyzer rule or (b) an
+    explicitly documented runtime-only condition — no drift between the
+    two surfaces."""
+    from dryad_tpu.runtime import shiplan, stream_plan
+    sites = (_raise_codes(stream_plan, "StreamPlanError")
+             + _raise_codes(shiplan, "PlanShipError"))
+    assert len(sites) >= 10  # every historical raise site is covered
+    for code, lineno in sites:
+        assert code in CODES, f"unregistered code {code} (line {lineno})"
+        assert code in STATIC_RULE_CODES or code in RUNTIME_ONLY_CODES, \
+            f"code {code} (line {lineno}) has neither a static rule " \
+            f"nor a runtime-only registration"
+    # static-mirrored codes really do have rules behind them
+    rule_codes = {r.code for r in RULES}
+    for code, _ in sites:
+        if code not in RUNTIME_ONLY_CODES:
+            assert code in STATIC_RULE_CODES
+    assert rule_codes <= set(CODES)
+
+
+def test_shiplan_lambda_names_definition_site(ctx):
+    from dryad_tpu.runtime.shiplan import (PlanShipError,
+                                           serialize_for_cluster)
+    fn = lambda c: dict(c)  # noqa: E731
+    graph = plan_query(_kv(ctx).select(fn).node, ctx.nparts)
+    with pytest.raises(PlanShipError) as ei:
+        serialize_for_cluster(graph)
+    msg = str(ei.value)
+    assert ei.value.code == "DTA014"
+    assert "test_analysis.py" in msg          # the lambda's def site
+    assert "register_fn_table" in msg
+    assert ei.value.span is not None          # the query line (op span)
+
+
+def test_register_fn_table_global(ctx):
+    from dryad_tpu.runtime import shiplan
+    fn = lambda c: dict(c)  # noqa: E731
+    graph = plan_query(_kv(ctx).select(fn).node, ctx.nparts)
+    shiplan.register_fn_table({"my_global_fn": fn})
+    try:
+        # callables now resolve; the non-deferred source is the next
+        # (correctly coded) failure
+        with pytest.raises(shiplan.PlanShipError) as ei:
+            serialize = shiplan.serialize_for_cluster(graph)  # noqa: F841
+        assert ei.value.code == "DTA015"
+    finally:
+        shiplan._GLOBAL_FN_TABLE.pop("my_global_fn", None)
+
+
+# ---------------------------------------------------------------------------
+# provenance spans
+
+
+def test_node_spans_and_plan_json_roundtrip(ctx):
+    q = _kv(ctx).select(doubler)
+    file, line, func = q.node.span
+    assert "test_analysis.py" in file and line > 0
+    graph = plan_query(q.node, ctx.nparts)
+    ops = [o for st in graph.stages for leg in st.legs for o in leg.ops]
+    assert any(o.span is not None and "test_analysis.py" in o.span[0]
+               for o in ops)
+    js = graph_to_json(graph, {id(doubler): "doubler"})
+    g2 = graph_from_json(js, fn_table={"doubler": doubler},
+                         sources={"0:0": _kv(ctx).node.data})
+    ops2 = [o for st in g2.stages for leg in st.legs for o in leg.ops]
+    assert any(o.span is not None and "test_analysis.py" in o.span[0]
+               for o in ops2)
+
+
+def test_span_not_in_fingerprint(ctx):
+    from dryad_tpu.plan.stages import StageOp
+    a = StageOp("fn", {"fn": doubler}, span=("a.py", 1, "f"))
+    b = StageOp("fn", {"fn": doubler}, span=("b.py", 9, "g"))
+    from dryad_tpu.plan.stages import Leg, Stage
+    sa = Stage(id=0, legs=[Leg("x", [a], None)])
+    sb = Stage(id=0, legs=[Leg("x", [b], None)])
+    assert sa.fingerprint() == sb.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# offline CLI
+
+
+def test_check_plan_json_and_cli(ctx, tmp_path):
+    fn = lambda c: dict(c)  # noqa: E731
+    graph = plan_query(_kv(ctx).select(fn).take(2).node, ctx.nparts)
+    js = graph_to_json(graph)   # anonymous fn_... ref, unresolvable
+    rep = check_plan_json(js)
+    assert "DTA905" in rep.codes()
+    rep_s = check_plan_json(js, stream=True)
+    assert {"DTA905", "DTA001"} <= rep_s.codes()
+
+    from dryad_tpu.analysis.__main__ import main
+    p = tmp_path / "plan.json"
+    p.write_text(js)
+    assert main([str(p)]) == 1
+    # a REGISTERED shipping name is deployable (worker --fn-module):
+    # warn-severity note, not a gate failure
+    graph_named = plan_query(_kv(ctx).select(fn).node, ctx.nparts)
+    js_named = graph_to_json(graph_named, {id(fn): "myfn"})
+    rep_named = check_plan_json(js_named)
+    assert not rep_named.errors
+    assert any(d.code == "DTA905" and d.severity == "warn"
+               for d in rep_named)
+    # a fully structured plan is clean
+    clean = graph_to_json(plan_query(
+        _kv(ctx).group_by(["k"], {"n": ("count", None)}).node,
+        ctx.nparts))
+    p2 = tmp_path / "clean.json"
+    p2.write_text(clean)
+    assert main([str(p2)]) == 0
+    assert json.loads(clean)["stages"]
+
+
+# ---------------------------------------------------------------------------
+# integration: every apps/ sample pipeline checks clean
+
+
+def test_apps_pipelines_check_clean(ctx):
+    from dryad_tpu.apps.groupbyreduce import gen_pairs, groupbyreduce_query
+    from dryad_tpu.apps.kmeans import _assign_fn, _assign_host, gen_points
+    from dryad_tpu.apps.terasort import gen_records, terasort_query
+    from dryad_tpu.apps.wordcount import wordcount_query
+
+    pipelines = {}
+    lines = ctx.from_columns({"line": [b"a b c", b"b c"]}, str_max_len=16)
+    pipelines["wordcount"] = wordcount_query(lines,
+                                             tokens_per_partition=64)
+    pipelines["terasort"] = terasort_query(
+        ctx.from_columns(gen_records(64), str_max_len=10))
+    pipelines["groupbyreduce"] = groupbyreduce_query(
+        ctx.from_columns(gen_pairs(64, 4)))
+
+    pts_cols, _ = gen_points(64, 4, 3)
+    pts = ctx.from_columns(pts_cols)
+    cents = ctx.from_columns(
+        {"cid": np.arange(3, dtype=np.int32),
+         "cx": np.zeros((3, 4), np.float32)})
+    pipelines["kmeans-step"] = (
+        pts.cross_apply(cents, _assign_fn, host_fn=_assign_host)
+           .group_by(["cid"], {"cx": ("mean", "x")})
+           .with_capacity(3))
+
+    from dryad_tpu.apps.pagerank import gen_graph
+    edges = ctx.from_columns(gen_graph(32, 64))
+    deg = edges.group_by(["src"], {"deg": ("count", None)})
+    edges_deg = edges.join(deg, ["src"], ["src"], expansion=2.0,
+                           right_unique=True)
+    ranks = ctx.from_columns(
+        {"node": np.arange(32, dtype=np.int32),
+         "rank": np.full(32, 1 / 32, np.float32)})
+    contribs = edges_deg.join(ranks, ["src"], ["node"], expansion=2.0,
+                              right_unique=True)
+    sums = (contribs
+            .select(lambda c: {"node": c["dst"],
+                               "c": c["rank"] / c["deg"]})
+            .group_by(["node"], {"s": ("sum", "c")}))
+    pipelines["pagerank-step"] = sums.with_capacity(64)
+
+    for name, q in pipelines.items():
+        rep = q.check()
+        assert rep.clean, f"{name} not clean:\n{rep.render()}"
