@@ -107,6 +107,107 @@ def _label_wall(events, label):
                and label in e.get("label", ""))
 
 
+def smoke(out_path="BENCH_obs.json", n_lines=None):
+    """Perf-smoke mode (``python bench.py --smoke``): ONE small traced
+    wordcount, wall/compile/io split + telemetry overhead vs an untraced
+    (DRYAD_LOGGING_LEVEL=0) run, written as ``BENCH_obs.json``.  Fast
+    enough to ride the normal pytest tier (tests/test_obs.py), so the
+    perf-trajectory file is refreshed on every run instead of staying
+    empty between full bench captures."""
+    import tempfile
+
+    import jax
+
+    from dryad_tpu import Context
+    from dryad_tpu.apps import wordcount
+    from dryad_tpu.obs.critical_path import critical_path
+    from dryad_tpu.obs.metrics import metrics_from_events
+    from dryad_tpu.parallel.mesh import make_mesh
+    from dryad_tpu.utils.events import EventLog
+
+    n_lines = n_lines or int(os.environ.get("BENCH_SMOKE_LINES", "20000"))
+    rng = np.random.RandomState(0)
+    vocab = np.array(["alpha", "beta", "gamma", "delta", "epsilon",
+                      "zeta", "eta", "theta"])
+    words_per_line = 6
+    idx = rng.randint(0, len(vocab), (n_lines, words_per_line))
+    lines = [" ".join(vocab[i]) for i in idx]
+    mesh = make_mesh(jax.devices())
+    nchips = mesh.devices.size
+    per_part = -(-n_lines // nchips)
+    cap = per_part * (words_per_line + 2)
+
+    def run_once(log):
+        ctx = Context(mesh=mesh, event_log=log)
+        q = wordcount.wordcount_query(
+            ctx.from_columns({"line": lines}, str_max_len=64),
+            tokens_per_partition=cap)
+        q.collect()              # warmup: compiles
+        mark = len(log.events)
+        t0 = time.time()
+        q.collect()
+        return time.time() - t0, log.events[mark:]
+
+    # untraced reference: level 0 = errors only, span creation is a no-op
+    prev = os.environ.get("DRYAD_LOGGING_LEVEL")
+    os.environ["DRYAD_LOGGING_LEVEL"] = "0"
+    try:
+        with EventLog(level=0) as log0:
+            untraced_s, _ = run_once(log0)
+            spans_untraced = len([e for e in log0.events
+                                  if e.get("event") == "span"])
+    finally:
+        if prev is None:
+            os.environ.pop("DRYAD_LOGGING_LEVEL", None)
+        else:
+            os.environ["DRYAD_LOGGING_LEVEL"] = prev
+
+    jsonl = os.path.join(tempfile.mkdtemp(prefix="bench-obs-"),
+                         "events.jsonl")
+    # EventLog.close (the with-exit) detaches itself from the tracer
+    with EventLog(jsonl, level=2) as log:
+        traced_s, ev = run_once(log)
+
+    comp = sum(e.get("compile_s", 0) for e in ev
+               if e.get("event") == "stage_done")
+    # the measured run usually hits the compile cache; the warmup's
+    # compile wall (same log, earlier events) is the honest compile cost
+    comp_warm = sum(e.get("compile_s", 0) for e in log.events
+                    if e.get("event") == "stage_done")
+    runw = sum(e.get("wall_s", 0) for e in ev
+               if e.get("event") == "stage_done")
+    io_s = sum(e.get("dur_s", 0) for e in ev
+               if e.get("event") == "span" and e.get("kind") == "io")
+    cp = critical_path(ev)
+    snap = metrics_from_events(ev).snapshot()
+    out = {
+        "metric": "obs smoke (traced wordcount)",
+        "lines": n_lines,
+        "n_chips": nchips,
+        "wall_s_traced": round(traced_s, 4),
+        "wall_s_untraced": round(untraced_s, 4),
+        "tracing_overhead_pct": round(
+            100.0 * (traced_s - untraced_s) / untraced_s, 1)
+            if untraced_s > 0 else None,
+        "span_events_traced": len([e for e in ev
+                                   if e.get("event") == "span"]),
+        "span_events_untraced": spans_untraced,
+        "split": {"compile_s": round(comp, 4),
+                  "compile_s_incl_warmup": round(comp_warm, 4),
+                  "run_s": round(runw, 4), "io_s": round(io_s, 4)},
+        "critical_path": {
+            "total_s": cp["total_s"],
+            "top": [{"name": s["name"], "kind": s["kind"],
+                     "self_s": s["self_s"]} for s in cp["top"][:5]]},
+        "metrics": snap,
+        "events_jsonl": jsonl,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return out
+
+
 def main():
     import jax
 
@@ -670,4 +771,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke"]
+        smoke(out_path=args[0] if args else "BENCH_obs.json")
+    else:
+        main()

@@ -65,6 +65,13 @@ class Context:
         self.config = config or JobConfig()
         from dryad_tpu.utils.compile_cache import enable_persistent_cache
         enable_persistent_cache(self.config.compilation_cache_dir)
+        # route driver-side spans (IO provider reads, job submission)
+        # into this context's event stream (obs/trace.py).  The sink is
+        # process-global and the LATEST Context owns it — including a
+        # log-less Context, which detaches the previous sink: a later
+        # job's spans must never leak into an earlier job's JSONL
+        from dryad_tpu.obs import trace as _trace
+        _trace.install(event_log)
         if cluster is not None:
             # multi-process mode (runtime.LocalCluster): the driver owns no
             # devices; plans + deferred sources ship to the worker gang

@@ -41,6 +41,32 @@ __all__ = ["Executor", "CapacityError"]
 _MAX_CAPACITY_RETRIES = 3
 _SAMPLES_PER_PART = 4096
 
+# stage-loop metrics, resolved ONCE (Counter handles are stable
+# get-or-create objects; per-stage registry lookups would put a lock +
+# key construction on the superstep hot path).  Family names/help come
+# from the canonical obs.metrics.FAMILIES table, shared with the
+# event-derived mirror (metrics_from_events) so the two cannot drift.
+from dryad_tpu.obs.metrics import REGISTRY as _METRICS
+from dryad_tpu.obs.metrics import family_counter as _family
+
+_M_CACHE_HITS = _family(_METRICS, "cache_hits")
+_M_CACHE_MISSES = _family(_METRICS, "cache_misses")
+_M_COMPILE_S = _family(_METRICS, "compile_seconds")
+_M_STAGE_RUNS = _family(_METRICS, "stage_runs")
+_M_RUN_S = _family(_METRICS, "run_seconds")
+_M_SHUFFLE_B = _family(_METRICS, "shuffle_bytes")
+_M_CAP_RETRIES = _family(_METRICS, "cap_retries")
+
+
+def _no_event(e) -> None:
+    """Default event sink: drops everything.  The explicit ``level = 0``
+    tells the span gate (obs/trace._sink_level) not to build spans that
+    nothing will ever read — an executor without an EventLog pays zero
+    tracing work."""
+
+
+_no_event.level = 0
+
 
 class CapacityError(RuntimeError):
     pass
@@ -401,7 +427,7 @@ class Executor:
         self.config = config or JobConfig()
         from dryad_tpu.utils.compile_cache import enable_persistent_cache
         enable_persistent_cache(self.config.compilation_cache_dir)
-        self._event = event_log or (lambda e: None)
+        self._event = event_log or _no_event
         # Multi-process (runtime-cluster) mode: host-side reads of sharded
         # values (overflow flags, sample lanes, counts) must first replicate
         # over the mesh — every process executes the same replication
@@ -747,7 +773,9 @@ class Executor:
                 args.append(bounds)
             fn = self._compile_cache.get(key)
             compile_s = 0.0
+            cache_hit = fn is not None
             if fn is None:
+                _M_CACHE_MISSES.inc()
                 # AOT compile so the event stream separates compile time
                 # from run time (the device-time profiling the reference
                 # surfaces through Artemis; VERDICT r1 weak item 8)
@@ -758,10 +786,12 @@ class Executor:
                                           slot_hints=slot_hints
                                           ).lower(*args).compile()
                 compile_s = time.time() - t0
+                _M_COMPILE_S.inc(compile_s)
                 self._compile_cache[key] = fn
                 if len(self._compile_cache) > self._compile_cache_max:
                     self._compile_cache.popitem(last=False)
             else:
+                _M_CACHE_HITS.inc()
                 self._compile_cache.move_to_end(key)
             t0 = time.time()
             out_batch, info = fn(*args)
@@ -775,11 +805,23 @@ class Executor:
                 # the reference GM likewise never chats mid-vertex (one
                 # DVertexCommandBlock start per vertex,
                 # dvertexcommand.h:199).
+                # live counters must not wait for the settle (out_bytes
+                # is STATIC shape metadata — no device sync here); the
+                # capacity-retry counter alone is settled later, when
+                # the overflow verdict exists (recovery._settle)
+                enqueue_s = round(time.time() - t0, 4)
+                out_bytes = int(sum(
+                    x.size * x.dtype.itemsize
+                    for x in jax.tree.leaves(out_batch)))
+                _M_STAGE_RUNS.inc()
+                _M_RUN_S.inc(enqueue_s)
+                _M_SHUFFLE_B.inc(out_bytes)
                 defer.append({"stage": stage, "info": info,
                               "scale": scale, "slack": slack,
-                              "salted": salted,
+                              "salted": salted, "cache_hit": cache_hit,
                               "compile_s": round(compile_s, 4),
-                              "enqueue_s": round(time.time() - t0, 4)})
+                              "out_bytes": out_bytes,
+                              "enqueue_s": enqueue_s})
                 stage._capacity_scale = scale
                 stage._send_slack = slack
                 stage._salted = salted
@@ -797,6 +839,11 @@ class Executor:
             out_bytes = int(sum(
                 x.size * x.dtype.itemsize
                 for x in jax.tree.leaves(out_batch)))
+            _M_STAGE_RUNS.inc()
+            _M_RUN_S.inc(wall)
+            _M_SHUFFLE_B.inc(out_bytes)
+            if of:
+                _M_CAP_RETRIES.inc()
             self._event({"event": "stage_done", "stage": stage.id,
                          "label": stage.label, "attempt": attempt,
                          "scale": scale, "slack": slack, "overflow": of,
@@ -805,6 +852,7 @@ class Executor:
                          "need_exchange": need_exch, "salted": salted,
                          "rows": rows, "out_bytes": out_bytes,
                          "compile_s": round(compile_s, 4),
+                         "cache_hit": cache_hit,
                          "dispatches": 2,   # program launch + info fetch
                          "wall_s": round(wall, 4)})
             decision = self._decide_needs(stage, scale, slack, salted,

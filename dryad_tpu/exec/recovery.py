@@ -78,11 +78,32 @@ class Run:
     # -- public ------------------------------------------------------------
 
     def output(self) -> PData:
-        out = self.result(self.graph.out_stage)
-        if self._defer:
-            out = self._settle()
+        import time as _time
+
+        from dryad_tpu.obs import trace
+        from dryad_tpu.obs.metrics import REGISTRY
+        t0 = _time.time()
+        # the job span: every stage/io span of this run parents into it
+        # (on a worker the envelope's trace_ctx makes it a child of the
+        # driver's job span — obs/trace.py context propagation)
+        with trace.span("run", "job", sink=self.ex._event,
+                        stages=len(self.graph.stages)):
+            out = self.result(self.graph.out_stage)
+            if self._defer:
+                out = self._settle()
         self.ex._event({"event": "progress", "done": len(self._results),
                         "total": len(self.graph.stages), "pct": 100.0})
+        # job-end metrics snapshot.  "metrics" carries CUMULATIVE
+        # process counters (the Prometheus model: monotone since process
+        # start), not per-job deltas.  Farm workers suppress this event
+        # (runtime/worker.py sets _emit_job_done=False) — a 16-task farm
+        # is one job, not 16.
+        if getattr(self.ex, "_emit_job_done", True):
+            self.ex._event({"event": "job_done",
+                            "wall_s": round(_time.time() - t0, 4),
+                            "stages": len(self.graph.stages),
+                            "replays": self.failures,
+                            "metrics": REGISTRY.snapshot()})
         return out
 
     def _settle(self) -> PData:
@@ -114,10 +135,19 @@ class Run:
                 "overflow": of, "need_scale": need_scale,
                 "need_slack": need_slack, "need_exchange": need_exch,
                 "salted": rec["salted"], "rows": info[:, 3].tolist(),
-                "compile_s": rec["compile_s"], "deferred": True,
+                "compile_s": rec["compile_s"],
+                "cache_hit": rec.get("cache_hit", False),
+                "out_bytes": rec.get("out_bytes", 0),
+                "deferred": True,
                 "dispatches": 1,   # program launch only; fetch amortized
                 "wall_s": rec["enqueue_s"]})
             if of:
+                # the deferred path counts runs/bytes at enqueue
+                # (executor defer branch); the overflow verdict only
+                # exists here, so the retry counter settles here too
+                from dryad_tpu.obs.metrics import (REGISTRY,
+                                                   family_counter)
+                family_counter(REGISTRY, "cap_retries").inc()
                 decision = self.ex._decide_needs(
                     stage, rec["scale"], rec["slack"], rec["salted"],
                     need_scale, need_slack, need_exch)
@@ -171,8 +201,16 @@ class Run:
         # ensure inputs (recursively replays lost ancestors)
         for dep in stage.input_stage_ids():
             self.result(dep)
-        out = self.ex._run_stage(stage, self._results, self.bindings,
-                                 defer=self._defer)
+        from dryad_tpu.obs import trace
+        # one span per stage execution (compile + run attempts; on the
+        # deferred path this covers the enqueue only — the device time
+        # lands in the settle's stage_done events)
+        with trace.span(f"stage {stage.id}:{stage.label}", "stage",
+                        sink=self.ex._event, stage=stage.id,
+                        label=stage.label,
+                        deferred=self._defer is not None):
+            out = self.ex._run_stage(stage, self._results, self.bindings,
+                                     defer=self._defer)
         self._results[sid] = out
         self._save_spill(sid, out)
         # progress percentage pushed to the event stream (the reference

@@ -72,31 +72,40 @@ def _head(url: str, timeout: float = HTTP_TIMEOUT_S) -> Tuple[int, bool]:
 def read_url_bytes(url: str, block: int = _DEFAULT_BLOCK,
                    timeout: float = HTTP_TIMEOUT_S) -> bytes:
     """Fetch a URL's body with block-ranged GETs (HttpReader.cs:78-105);
-    servers without range support get one whole-body GET."""
-    size, ranges = _head(url, timeout)
-    if not ranges or size < 0:
-        with _open(urllib.request.Request(url), timeout) as r:
-            return r.read()
-    chunks: List[bytes] = []
-    off = 0
-    while off < size:
-        end = min(off + block, size) - 1
-        req = urllib.request.Request(
-            url, headers={"Range": f"bytes={off}-{end}"})
-        with _open(req, timeout) as r:
-            body = r.read()
-            if r.status != 206:
-                # advertised ranges but served the full body — trusting
-                # the loop would concatenate N copies of the file
-                return body
-            if not body:
-                raise IOError(
-                    f"empty 206 response for {url} range {off}-{end}")
-            chunks.append(body)
-        # advance by what actually arrived: proxies may clamp ranges, and
-        # assuming the full block would leave silent byte gaps
-        off += len(body)
-    return b"".join(chunks)
+    servers without range support get one whole-body GET.  Traced as one
+    io span (bytes + ranged-request count + latency)."""
+    from dryad_tpu.obs import trace
+    with trace.span("http.get", "io", url=url) as sp:
+        size, ranges = _head(url, timeout)
+        if not ranges or size < 0:
+            with _open(urllib.request.Request(url), timeout) as r:
+                body = r.read()
+            sp.set(bytes=len(body), requests=1)
+            return body
+        chunks: List[bytes] = []
+        off = 0
+        n_req = 0
+        while off < size:
+            end = min(off + block, size) - 1
+            req = urllib.request.Request(
+                url, headers={"Range": f"bytes={off}-{end}"})
+            n_req += 1
+            with _open(req, timeout) as r:
+                body = r.read()
+                if r.status != 206:
+                    # advertised ranges but served the full body —
+                    # trusting the loop would concatenate N copies
+                    sp.set(bytes=len(body), requests=n_req)
+                    return body
+                if not body:
+                    raise IOError(
+                        f"empty 206 response for {url} range {off}-{end}")
+                chunks.append(body)
+            # advance by what actually arrived: proxies may clamp ranges,
+            # and assuming the full block would leave silent byte gaps
+            off += len(body)
+        sp.set(bytes=off, requests=n_req)
+        return b"".join(chunks)
 
 
 def enumerate_http(url: str,

@@ -191,13 +191,17 @@ class S3Client:
     def get_object(self, bucket: str, key: str,
                    rng: Optional[Tuple[int, int]] = None) -> bytes:
         """Fetch an object (optionally bytes [start, end] inclusive)."""
+        from dryad_tpu.obs import trace
         headers = {}
         ok: Tuple[int, ...] = (200,)
         if rng is not None:
             headers["Range"] = f"bytes={rng[0]}-{rng[1]}"
             ok = (200, 206)
-        _, _, body = self._request("GET", self._url(bucket, key),
-                                   headers=headers, ok=ok)
+        with trace.span("s3.get", "io", key=f"s3://{bucket}/{key}",
+                        **({"offset": rng[0]} if rng else {})) as sp:
+            _, _, body = self._request("GET", self._url(bucket, key),
+                                       headers=headers, ok=ok)
+            sp.set(bytes=len(body))
         return body
 
     def head_size(self, bucket: str, key: str) -> int:
@@ -208,10 +212,13 @@ class S3Client:
         """Upload; bodies over multipart_bytes go through the multipart
         protocol (the large-output path of channelbufferhdfs.cpp's
         block writer)."""
-        if len(data) <= self.cfg.multipart_bytes:
-            self._request("PUT", self._url(bucket, key), payload=data)
-            return
-        self._multipart_put(bucket, key, data)
+        from dryad_tpu.obs import trace
+        with trace.span("s3.put", "io", key=f"s3://{bucket}/{key}",
+                        bytes=len(data)):
+            if len(data) <= self.cfg.multipart_bytes:
+                self._request("PUT", self._url(bucket, key), payload=data)
+                return
+            self._multipart_put(bucket, key, data)
 
     def _multipart_put(self, bucket: str, key: str, data: bytes) -> None:
         _, _, body = self._request(

@@ -232,9 +232,16 @@ class WebHdfsClient:
 
     def open(self, path: str, offset: int = 0,
              length: Optional[int] = None) -> bytes:
-        """Ranged read (op=OPEN&offset=&length=) via datanode redirect."""
-        _status, body = self._read_op(
-            "GET", self._url(path, "OPEN", offset=offset, length=length))
+        """Ranged read (op=OPEN&offset=&length=) via datanode redirect.
+        Every ranged read is one io span (bytes + latency) — the
+        channel-level visibility Artemis mines from the Calypso stream."""
+        from dryad_tpu.obs import trace
+        with trace.span("hdfs.open", "io", path=path,
+                        offset=offset) as sp:
+            _status, body = self._read_op(
+                "GET", self._url(path, "OPEN", offset=offset,
+                                 length=length))
+            sp.set(bytes=len(body))
         return body
 
     def read_all(self, path: str, block: int = _RANGE_BLOCK) -> bytes:
@@ -255,8 +262,11 @@ class WebHdfsClient:
 
     def create(self, path: str, data: bytes, overwrite: bool = True
                ) -> None:
-        self._data_op("PUT", self._url(
-            path, "CREATE", overwrite=str(bool(overwrite)).lower()), data)
+        from dryad_tpu.obs import trace
+        with trace.span("hdfs.create", "io", path=path, bytes=len(data)):
+            self._data_op("PUT", self._url(
+                path, "CREATE", overwrite=str(bool(overwrite)).lower()),
+                data)
 
     def append(self, path: str, data: bytes) -> None:
         """APPEND is NOT idempotent — the data hop never retries (a

@@ -1,0 +1,35 @@
+"""dryad_tpu.obs — the telemetry layer (tracing + metrics + analysis).
+
+The reference dedicates a whole layer to observability: the Calypso
+reporter streams vertex/process/topology events to the job's DFS log
+(GraphManager/reporting/DrCalypsoReporting.cpp) and JobBrowser/Artemis
+render DAGs, Gantt charts and post-hoc diagnosis from it.  This package
+is that layer for dryad_tpu, in three pillars:
+
+* ``obs.trace``   — Span API with cross-process context propagation
+  (executor -> farm -> worker -> IO providers), emitted as ordinary
+  EventLog events so one JSONL stream carries everything;
+* ``obs.metrics`` — dependency-free counter/gauge/histogram registry
+  with Prometheus text exposition (live at the viewer's ``/metrics``,
+  post-hoc via ``metrics_from_events``);
+* ``obs.chrome`` / ``obs.critical_path`` — exporters/analyzers:
+  ``python -m dryad_tpu.obs trace events.jsonl -o trace.json`` (load in
+  Perfetto) and ``python -m dryad_tpu.obs critical-path events.jsonl``.
+
+Everything here is stdlib-only and import-light: the runtime imports
+``obs.trace``/``obs.metrics`` on its hot paths.
+"""
+
+from dryad_tpu.obs import trace  # noqa: F401
+from dryad_tpu.obs.chrome import chrome_trace  # noqa: F401
+from dryad_tpu.obs.critical_path import critical_path, render_text  # noqa: F401
+from dryad_tpu.obs.metrics import (REGISTRY, Registry,  # noqa: F401
+                                   metrics_dump, metrics_from_events)
+from dryad_tpu.obs.trace import (Span, current_ctx, ctx_of,  # noqa: F401
+                                 finish, install, span, start, tracing,
+                                 tracing_enabled)
+
+__all__ = ["trace", "Span", "span", "start", "finish", "tracing",
+           "install", "current_ctx", "ctx_of", "tracing_enabled",
+           "REGISTRY", "Registry", "metrics_dump", "metrics_from_events",
+           "chrome_trace", "critical_path", "render_text"]

@@ -1,0 +1,68 @@
+"""Observability CLI — ``python -m dryad_tpu.obs <cmd> events.jsonl``.
+
+The jobctl-style post-hoc tools over a recorded EventLog stream:
+
+* ``trace``          export Chrome trace-event JSON (open in Perfetto)
+* ``critical-path``  print the job's critical-path decomposition
+* ``metrics``        print Prometheus text metrics derived from events
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dryad_tpu.obs",
+        description="telemetry tools over an EventLog JSONL stream")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("trace", help="export Chrome trace-event JSON")
+    t.add_argument("events", help="EventLog JSONL path")
+    t.add_argument("-o", "--out",
+                   help="output path (default: <events>.trace.json)")
+
+    c = sub.add_parser("critical-path",
+                       help="critical-path decomposition")
+    c.add_argument("events", help="EventLog JSONL path")
+    c.add_argument("--top", type=int, default=10,
+                   help="segments to print (default 10)")
+    c.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+
+    m = sub.add_parser("metrics",
+                       help="Prometheus text metrics from events")
+    m.add_argument("events", help="EventLog JSONL path")
+
+    args = ap.parse_args(argv)
+    from dryad_tpu.utils.viewer import _read_jsonl
+    events = _read_jsonl(args.events)
+
+    if args.cmd == "trace":
+        from dryad_tpu.obs.chrome import chrome_trace
+        out = args.out or (args.events + ".trace.json")
+        with open(out, "w") as f:
+            json.dump(chrome_trace(events), f)
+        print(out)
+        return 0
+    if args.cmd == "critical-path":
+        from dryad_tpu.obs.critical_path import critical_path, render_text
+        res = critical_path(events, top=args.top)
+        if args.json:
+            json.dump(res, sys.stdout)
+            print()
+        else:
+            print(render_text(res, top=args.top))
+        return 0
+    if args.cmd == "metrics":
+        from dryad_tpu.obs.metrics import metrics_from_events
+        sys.stdout.write(metrics_from_events(events).render())
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

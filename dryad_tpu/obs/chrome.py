@@ -1,0 +1,69 @@
+"""Chrome trace-event exporter: EventLog span events -> Perfetto.
+
+``python -m dryad_tpu.obs trace events.jsonl -o trace.json`` converts
+the ``"span"`` records of an EventLog JSONL stream into the Chrome
+trace-event JSON format (the JobBrowser Gantt's modern equivalent —
+load the output at https://ui.perfetto.dev).  Spans become complete
+("ph": "X") events; the process lane is the emitting worker (driver =
+pid 0), and overlapping spans within a process are laid out on
+greedily-allocated tracks so sibling tasks render side by side instead
+of on top of each other.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+__all__ = ["chrome_trace"]
+
+
+def _pid_of(e: Dict[str, Any]) -> int:
+    """Process lane: forwarded worker events carry a ``worker`` tag
+    (runtime/cluster.py, runtime/farm.py); driver-emitted spans don't."""
+    w = e.get("worker")
+    if w is None:
+        w = (e.get("attrs") or {}).get("worker_pid")
+    try:
+        return int(w) + 1 if w is not None else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+def chrome_trace(events) -> Dict[str, Any]:
+    """Build the Chrome trace dict from an event iterable."""
+    spans = [e for e in events
+             if e.get("event") == "span" and e.get("t0") is not None
+             and e.get("dur_s") is not None]
+    out: List[Dict[str, Any]] = []
+    # lane allocation per process: first track whose last span ended
+    # before this one starts (spans sorted by start time)
+    lanes: Dict[int, List[float]] = {}
+    named_pids = set()
+    for e in sorted(spans, key=lambda e: (float(e["t0"]),
+                                          -float(e["dur_s"]))):
+        pid = _pid_of(e)
+        t0, dur = float(e["t0"]), float(e["dur_s"])
+        ends = lanes.setdefault(pid, [])
+        for tid, end in enumerate(ends):
+            if end <= t0 + 1e-9:
+                break
+        else:
+            tid = len(ends)
+            ends.append(0.0)
+        ends[tid] = t0 + dur
+        if pid not in named_pids:
+            named_pids.add(pid)
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "tid": 0,
+                        "args": {"name": ("driver" if pid == 0
+                                          else f"worker {pid - 1}")}})
+        args = {"trace": e.get("trace"), "span": e.get("span")}
+        if e.get("parent"):
+            args["parent"] = e["parent"]
+        args.update(e.get("attrs") or {})
+        out.append({"name": e.get("name", "?"),
+                    "cat": e.get("kind", "internal"), "ph": "X",
+                    "ts": round(t0 * 1e6, 1),
+                    "dur": max(round(dur * 1e6, 1), 1.0),
+                    "pid": pid, "tid": tid, "args": args})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

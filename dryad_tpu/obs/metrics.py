@@ -1,0 +1,330 @@
+"""Dependency-free metrics registry with Prometheus text exposition.
+
+The reference's JobBrowser derives its counters (vertices run, bytes
+moved, retries) by mining the Calypso stream post-hoc; production
+systems additionally need LIVE counters a scraper can poll.  This module
+provides both from one implementation:
+
+* a process-global :data:`REGISTRY` the runtime increments in place
+  (task farm, executor compile cache, IO providers), rendered by
+  :func:`metrics_dump` / scraped at the live viewer's ``/metrics``;
+* :func:`metrics_from_events` — the same counter families RE-DERIVED
+  from a recorded EventLog stream, so a viewer process that only holds
+  the JSONL (the usual deployment: the job ran elsewhere) still exposes
+  task / retry / straggler / shuffle-bytes / compile-cache metrics.
+
+Counters, gauges, and histograms only — the three types every scraper
+understands; no external client library (the container bakes none in).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+           "FAMILIES", "family_counter", "family_gauge",
+           "family_histogram", "metrics_dump", "metrics_from_events"]
+
+_DEF_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0, 60.0)
+
+# canonical metric families: name + help defined ONCE, shared by the
+# live instrumentation (executor/farm/compile_cache) and the
+# event-derived mirror below — a rename on one side cannot silently
+# diverge /metrics between a viewer that ran the job and one that only
+# holds the JSONL
+FAMILIES = {
+    "tasks": ("dryad_farm_tasks_total", "completed farm tasks"),
+    "straggler_dups": ("dryad_farm_straggler_duplicates_total",
+                       "speculative duplicates by outcome"),
+    "spec_launches": ("dryad_farm_speculative_launches_total",
+                      "straggler duplicates dispatched"),
+    "task_retries": ("dryad_farm_task_retries_total",
+                     "task re-dispatches by cause"),
+    "task_seconds": ("dryad_task_seconds", "farm task wall"),
+    "queue_depth": ("dryad_farm_queue_depth",
+                    "tasks awaiting dispatch"),
+    "stage_runs": ("dryad_stage_runs_total", "stage executions"),
+    "cap_retries": ("dryad_stage_capacity_retries_total",
+                    "capacity-overflow retries"),
+    "stage_replays": ("dryad_stage_replays_total", "lineage replays"),
+    "shuffle_bytes": ("dryad_shuffle_bytes_total",
+                      "bytes materialized by stage outputs"),
+    "compile_seconds": ("dryad_compile_seconds_total",
+                        "stage-program compile wall"),
+    "run_seconds": ("dryad_run_seconds_total", "stage run wall"),
+    "cache_hits": ("dryad_compile_cache_hits_total",
+                   "compiled-stage cache hits"),
+    "cache_misses": ("dryad_compile_cache_misses_total",
+                     "compiled-stage cache misses"),
+    "persistent_cache": ("dryad_persistent_compile_cache_enabled",
+                         "1 when the on-disk XLA cache is active"),
+    "tee_spills": ("dryad_stream_tee_spills_total",
+                   "stream Tee spills"),
+    "jobs": ("dryad_jobs_total", "completed jobs"),
+    "jobs_failed": ("dryad_jobs_failed_total", "failed jobs"),
+    "io_requests": ("dryad_io_requests_total",
+                    "IO provider operations"),
+    "io_bytes": ("dryad_io_bytes_total", "IO provider bytes moved"),
+    "io_seconds": ("dryad_io_seconds_total", "IO provider wall"),
+}
+
+
+def family_counter(reg: "Registry", key: str, **labels) -> "Counter":
+    """Get-or-create the canonical counter family ``key`` on ``reg``."""
+    name, help_ = FAMILIES[key]
+    return reg.counter(name, help_, **labels)
+
+
+def family_gauge(reg: "Registry", key: str, **labels) -> "Gauge":
+    name, help_ = FAMILIES[key]
+    return reg.gauge(name, help_, **labels)
+
+
+def family_histogram(reg: "Registry", key: str, **labels) -> "Histogram":
+    name, help_ = FAMILIES[key]
+    return reg.histogram(name, help_, **labels)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers without the trailing .0."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _esc(v: Any) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in labels) + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str,
+                 labels: Tuple[Tuple[str, str], ...]):
+        self.name, self.help, self.labels = name, help_, labels
+        self._lock = threading.Lock()
+
+    def sample_lines(self) -> List[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, labels):
+        super().__init__(name, help_, labels)
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += n
+
+    def sample_lines(self) -> List[str]:
+        return [f"{self.name}{_label_str(self.labels)} {_fmt(self.value)}"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_, labels):
+        super().__init__(name, help_, labels)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    def sample_lines(self) -> List[str]:
+        return [f"{self.name}{_label_str(self.labels)} {_fmt(self.value)}"]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, labels, buckets=None):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(sorted(buckets or _DEF_BUCKETS))
+        self.counts = [0] * len(self.buckets)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+
+    def sample_lines(self) -> List[str]:
+        out = []
+        base = list(self.labels)
+        # bucket counts are kept cumulative by observe() (every bucket
+        # with v <= le increments), matching the exposition contract
+        for b, c in zip(self.buckets, self.counts):
+            lbl = _label_str(tuple(base + [("le", _fmt(b))]))
+            out.append(f"{self.name}_bucket{lbl} {c}")
+        lbl = _label_str(tuple(base + [("le", "+Inf")]))
+        out.append(f"{self.name}_bucket{lbl} {self.count}")
+        out.append(f"{self.name}_sum{_label_str(self.labels)} "
+                   f"{_fmt(self.sum)}")
+        out.append(f"{self.name}_count{_label_str(self.labels)} "
+                   f"{self.count}")
+        return out
+
+
+class Registry:
+    """Name+labels-keyed metric store; get-or-create accessors so call
+    sites never pre-register."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "Dict[Tuple[str, tuple], _Metric]" = {}
+
+    def _get(self, cls, name: str, help_: str, labels: Dict[str, Any],
+             **kw) -> _Metric:
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help_, key[1], **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as "
+                                f"{m.kind}")
+            return m
+
+    def counter(self, name: str, help_: str = "", **labels) -> Counter:
+        return self._get(Counter, name, help_, labels)
+
+    def gauge(self, name: str, help_: str = "", **labels) -> Gauge:
+        return self._get(Gauge, name, help_, labels)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Optional[Iterable[float]] = None,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, help_, labels, buckets=buckets)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def merge_from(self, other: "Registry") -> "Registry":
+        """Copy families from ``other`` that this registry does not
+        already hold (event-derived metrics win over live ones, so a
+        viewer that both recorded and ran never double-counts)."""
+        with other._lock:
+            theirs = dict(other._metrics)
+        with self._lock:
+            for key, m in theirs.items():
+                self._metrics.setdefault(key, m)
+        return self
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: List[str] = []
+        seen_family = set()
+        for (name, _labels), m in metrics:
+            if name not in seen_family:
+                seen_family.add(name)
+                if m.help:
+                    out.append(f"# HELP {name} {m.help}")
+                out.append(f"# TYPE {name} {m.kind}")
+            out.extend(m.sample_lines())
+        return "\n".join(out) + ("\n" if out else "")
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Flat {name{labels}: value} dict — what job_done embeds."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for (name, labels), m in metrics:
+            key = name + _label_str(labels)
+            if isinstance(m, Histogram):
+                out[key] = {"count": m.count, "sum": round(m.sum, 6)}
+            else:
+                out[key] = round(m.value, 6)
+        return out
+
+
+REGISTRY = Registry()
+
+
+def metrics_dump() -> str:
+    """The process-global registry in Prometheus text format."""
+    return REGISTRY.render()
+
+
+def metrics_from_events(events, registry: Optional[Registry] = None
+                        ) -> Registry:
+    """Derive the counter families from a recorded event stream (the
+    post-hoc path: a viewer holding only the JSONL).  Families mirror
+    the live instrumentation so scrape dashboards work on either."""
+    r = registry or Registry()
+    for e in events:
+        k = e.get("event")
+        if k == "task_done":
+            family_counter(r, "tasks").inc()
+            if e.get("wall_s") is not None:
+                family_histogram(r, "task_seconds").observe(e["wall_s"])
+            if "dup_won" in e:
+                family_counter(r, "straggler_dups",
+                               result="won" if e["dup_won"] else "lost"
+                               ).inc()
+        elif k == "task_duplicated":
+            family_counter(r, "spec_launches").inc()
+        elif k in ("task_reassigned", "task_timeout",
+                   "worker_ping_timeout"):
+            family_counter(r, "task_retries", reason=k).inc()
+        elif k in ("stage_done", "stream_stage_done"):
+            family_counter(r, "stage_runs").inc()
+            if e.get("overflow"):
+                family_counter(r, "cap_retries").inc()
+            if e.get("out_bytes"):
+                family_counter(r, "shuffle_bytes").inc(e["out_bytes"])
+            if e.get("compile_s"):
+                family_counter(r, "compile_seconds").inc(e["compile_s"])
+            if e.get("wall_s"):
+                family_counter(r, "run_seconds").inc(e["wall_s"])
+            if "cache_hit" in e:
+                family_counter(r, "cache_hits"
+                               ).inc(1 if e["cache_hit"] else 0)
+                family_counter(r, "cache_misses"
+                               ).inc(0 if e["cache_hit"] else 1)
+        elif k in ("stage_replay", "settle_replay"):
+            family_counter(r, "stage_replays").inc()
+        elif k == "stream_tee_spill":
+            family_counter(r, "tee_spills").inc()
+        elif k == "job_done":
+            family_counter(r, "jobs").inc()
+        elif k == "job_failed":
+            family_counter(r, "jobs_failed").inc()
+        elif k == "span" and e.get("kind") == "io":
+            a = e.get("attrs") or {}
+            op = e.get("name", "io")
+            family_counter(r, "io_requests", op=op).inc()
+            if a.get("bytes"):
+                family_counter(r, "io_bytes", op=op).inc(a["bytes"])
+            if e.get("dur_s"):
+                family_counter(r, "io_seconds", op=op).inc(e["dur_s"])
+    return r

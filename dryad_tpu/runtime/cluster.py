@@ -510,6 +510,7 @@ class LocalCluster(ClusterBackend):
         driver's executor knobs apply on the workers.  ``keep_token``
         caches the result cluster-resident; ``release`` piggybacks token
         drops."""
+        from dryad_tpu.obs import trace
         if not self.alive():
             self.restart()
         job = self.next_job_id()
@@ -517,21 +518,33 @@ class LocalCluster(ClusterBackend):
         del self.pending_release[:len(queued)]
         hb_every = getattr(config, "gang_heartbeat_s", 2.0) if config \
             else 2.0
-        msg = {"cmd": "run", "plan": plan_json, "sources": source_specs,
-               "collect": collect, "store_path": store_path,
-               "store_partitioning": store_partitioning, "job": job,
-               "config": config, "keep_token": keep_token,
-               "release": list(release) + queued,
-               "store_compression": store_compression,
-               "hb_every": hb_every}
-        for pid in self.gang_pids():
-            s = self._socks[pid]
-            s.setblocking(True)
-            protocol.send_msg(s, msg)
-            s.setblocking(False)
+        # the driver's job span: its context rides the envelope so every
+        # worker's run/stage/io spans parent-link here (protocol.TRACE_CTX);
+        # the sink inherits the attached EventLog's level — and with NO
+        # log attached, level 0: no consumer means zero span work, and
+        # no trace_ctx means the workers skip theirs too
+        with trace.span(f"job {job}", "job",
+                        sink=trace.leveled(
+                            self._emit,
+                            getattr(self.event_log, "level", None)
+                            if self.event_log is not None else 0),
+                        job=job) as jsp:
+            msg = protocol.attach_trace(
+                {"cmd": "run", "plan": plan_json, "sources": source_specs,
+                 "collect": collect, "store_path": store_path,
+                 "store_partitioning": store_partitioning, "job": job,
+                 "config": config, "keep_token": keep_token,
+                 "release": list(release) + queued,
+                 "store_compression": store_compression,
+                 "hb_every": hb_every}, trace.ctx_of(jsp))
+            for pid in self.gang_pids():
+                s = self._socks[pid]
+                s.setblocking(True)
+                protocol.send_msg(s, msg)
+                s.setblocking(False)
 
-        replies = self._gather_job_replies(job, timeout, "job",
-                                           config=config)
+            replies = self._gather_job_replies(job, timeout, "job",
+                                               config=config)
 
         if self.event_log is not None and 0 in replies:
             for e in replies[0].get("events", []):

@@ -77,7 +77,8 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
                for key, spec in source_specs.items()}
     graph = graph_from_json(plan_json, fn_table=fn_table, sources=sources)
     ex = _gang_executor(mesh, config)
-    ex._event = event_log or (lambda e: None)
+    from dryad_tpu.exec.executor import _no_event
+    ex._event = event_log or _no_event
     pd = ex.run(graph)
 
     extras: Dict[str, Any] = {}

@@ -44,7 +44,7 @@ def _norm_host(h: str) -> str:
 
 class _Task:
     __slots__ = ("idx", "sources", "runs", "delays", "result", "duplicated",
-                 "pref")
+                 "pref", "spans", "dup_pid")
 
     def __init__(self, idx: int, sources: Dict[str, Dict[str, Any]],
                  host_pids: Dict[str, set]):
@@ -52,8 +52,10 @@ class _Task:
         self.sources = sources
         self.runs: Dict[int, float] = {}   # worker -> dispatch time
         self.delays: Dict[int, float] = {}  # worker -> commanded test delay
+        self.spans: Dict[int, Any] = {}    # worker -> open dispatch span
         self.result: Optional[Dict[str, Any]] = None
         self.duplicated = False
+        self.dup_pid: Optional[int] = None   # the speculative copy's pid
         # soft locality hints from the task's source specs: an explicit
         # worker pid (the worker that wrote/holds the store partitions)
         # and/or block-holding HOST names (hdfs GETFILEBLOCKLOCATIONS
@@ -144,10 +146,51 @@ class TaskFarm:
         """``timeout`` bounds the whole farm run (None = unbounded);
         ``task_timeout_s`` overrides JobConfig.farm_task_timeout_s for
         legitimately slow tasks."""
+        from dryad_tpu.obs import trace
+        from dryad_tpu.obs.metrics import REGISTRY, family_gauge
+
         cl = self.cluster
         if not cl.alive():
             cl.restart()
         job = cl.next_job_id()
+        # the farm span roots every per-dispatch sched span; its context
+        # rides each task envelope to the workers (runtime/protocol
+        # TRACE_CTX), so worker task/stage/io spans link back here.  It
+        # must finish on EVERY exit path or the sched spans it parents
+        # would dangle in the stream.  The sink inherits the attached
+        # EventLog's level — and with NO log attached, level 0: no
+        # consumer means zero span work, and no trace_ctx means the
+        # workers skip theirs too.
+        tsink = trace.leveled(self._emit,
+                              getattr(cl.event_log, "level", None)
+                              if cl.event_log is not None else 0)
+        queue_gauge = family_gauge(REGISTRY, "queue_depth")
+        farm_span = trace.start("farm", "farm", sink=tsink,
+                                job=job, tasks=len(per_task_sources))
+        try:
+            out = self._run(plan_json, per_task_sources, timeout,
+                            task_timeout_s, job, farm_span, tsink,
+                            queue_gauge)
+        except BaseException as e:
+            trace.finish(farm_span, error=type(e).__name__)
+            raise
+        finally:
+            # an idle farm has no queue — a stale depth would misfire
+            # any dashboard alerting on it
+            queue_gauge.set(0)
+        trace.finish(farm_span, done=len(out))
+        return out
+
+    def _run(self, plan_json: str,
+             per_task_sources: List[Dict[str, Dict[str, Any]]],
+             timeout: Optional[float], task_timeout_s: Optional[float],
+             job: int, farm_span, tsink, queue_gauge
+             ) -> List[Dict[str, Any]]:
+        from dryad_tpu.obs import trace
+        from dryad_tpu.obs.metrics import REGISTRY, family_histogram
+
+        cl = self.cluster
+        task_hist = family_histogram(REGISTRY, "task_seconds")
         hosts = (self.worker_hosts if self.worker_hosts is not None
                  else (cl.worker_hosts()
                        if hasattr(cl, "worker_hosts") else {}))
@@ -193,20 +236,29 @@ class TaskFarm:
             delay = (self.delay_hook(task.idx, pid)
                      if self.delay_hook else 0.0)
             sock = cl.sockets[pid]
+            # driver-side dispatch span: covers queue + wire + worker
+            # execution; the worker's own task span (child) subtracts to
+            # the queue/transit share (obs/critical_path.py)
+            sp = trace.start(f"task {task.idx}", "sched",
+                             parent=farm_span, sink=tsink,
+                             task=task.idx, worker=pid)
             try:
                 sock.setblocking(True)
-                protocol.send_msg(sock, {"cmd": "run_task",
-                                         "plan": plan_json,
-                                         "sources": task.sources,
-                                         "task": task.idx, "job": job,
-                                         "config": self.config,
-                                         "delay_s": delay})
+                protocol.send_msg(sock, protocol.attach_trace(
+                    {"cmd": "run_task", "plan": plan_json,
+                     "sources": task.sources,
+                     "task": task.idx, "job": job,
+                     "config": self.config, "delay_s": delay},
+                    trace.ctx_of(sp if sp is not None else farm_span)))
                 sock.setblocking(False)
             except OSError:
+                trace.finish(sp, error="dispatch_failed")
                 worker_lost(pid)
                 return False
             task.runs[pid] = time.time()
             task.delays[pid] = delay
+            if sp is not None:
+                task.spans[pid] = sp
             running[pid] = task
             idle.discard(pid)
             return True
@@ -217,6 +269,8 @@ class TaskFarm:
             dead.add(pid)
             idle.discard(pid)
             task = running.pop(pid, None)
+            if task is not None:
+                trace.finish(task.spans.pop(pid, None), error="worker_lost")
             if (task is not None and task.result is None
                     and task not in todo):
                 task.runs.pop(pid, None)
@@ -228,6 +282,7 @@ class TaskFarm:
                     "all workers died during task farm" + cl.log_tails())
 
         while n_done < len(tasks):
+            queue_gauge.set(len(todo))
             if deadline is not None and time.time() > deadline:
                 raise FarmError(
                     f"task farm timed out; {len(tasks) - n_done} tasks "
@@ -300,6 +355,7 @@ class TaskFarm:
                         # straggler cloneable elsewhere
                         if dispatch(worst, pid):
                             worst.duplicated = True
+                            worst.dup_pid = pid
                             dups_used += 1
                             self._emit({"event": "task_duplicated",
                                         "task": worst.idx, "worker": pid,
@@ -332,9 +388,17 @@ class TaskFarm:
                     idle.add(pid)
                     t = (tasks[reply["task"]]
                          if reply.get("task") is not None else None)
+                    # forward the worker's span/event records (tagged
+                    # with the emitting worker) — losing duplicates
+                    # included: their spans ARE the straggler evidence
+                    for e in reply.get("events") or ():
+                        self._emit(dict(e, worker=pid))
                     if not reply.get("ok"):
                         # a losing duplicate's failure costs nothing once
                         # the winner delivered (first-finisher-wins)
+                        if t is not None:
+                            trace.finish(t.spans.pop(pid, None),
+                                         error="task_failed")
                         if t is not None and t.result is not None:
                             self._emit({"event":
                                         "task_duplicate_failed_ignored",
@@ -344,13 +408,24 @@ class TaskFarm:
                             f"task {reply.get('task')} failed on worker "
                             f"{pid}:\n{reply.get('error')}")
                     took = time.time() - t.runs.get(pid, time.time())
+                    trace.finish(t.spans.pop(pid, None),
+                                 won=t.result is None)
                     if t.result is None:
                         t.result = reply["table"]
                         n_done += 1
                         durations.append(took)
-                        self._emit({"event": "task_done", "task": t.idx,
-                                    "worker": pid,
-                                    "wall_s": round(took, 3)})
+                        task_hist.observe(took)
+                        done_ev = {"event": "task_done", "task": t.idx,
+                                   "worker": pid,
+                                   "wall_s": round(took, 3)}
+                        if t.duplicated:
+                            # which copy won (straggler metrics —
+                            # DrStageStatistics outcome accounting);
+                            # keyed on the RECORDED duplicate pid, not
+                            # dispatch order: a lost original's runs
+                            # entry is popped by worker_lost
+                            done_ev["dup_won"] = pid == t.dup_pid
+                        self._emit(done_ev)
                     else:
                         self._emit({"event": "task_duplicate_ignored",
                                     "task": t.idx, "worker": pid})

@@ -27,6 +27,30 @@ from typing import Any, Optional
 _MAGIC = b"DRYD"
 _ACK = b"OK01"
 
+# -- trace-context propagation ----------------------------------------------
+# Every job/task envelope may carry a TRACE_CTX field: the submitting
+# driver span's {"trace": trace_id, "parent": span_id}, adopted by the
+# worker for the execution's duration (obs/trace.tracing) so worker-side
+# task/stage/io spans parent-link into the driver's trace across the
+# process boundary (the Dapper propagation model; the reference's
+# Calypso stream carries no causality — SURVEY.md §5 gap).
+TRACE_CTX = "trace_ctx"
+
+
+def attach_trace(msg: dict, ctx) -> dict:
+    """Attach a wire trace context to an outgoing envelope (no-op when
+    tracing is off and ``ctx`` is None)."""
+    if ctx:
+        msg[TRACE_CTX] = ctx
+    return msg
+
+
+def extract_trace(msg: dict):
+    """Worker side: the envelope's trace context, if any (validated to a
+    plain dict — the field rides the pickle channel but is inert data)."""
+    ctx = msg.get(TRACE_CTX)
+    return ctx if isinstance(ctx, dict) else None
+
 
 class AuthError(RuntimeError):
     """Control-plane handshake failed (wrong secret or not our protocol)."""

@@ -141,38 +141,70 @@ def main(argv=None) -> int:
             # reference DrVertex::RequestDuplicate)
             import time as _time
 
+            from dryad_tpu.obs import trace as _trace
+
             reply = {"ok": True, "pid": args.process_id,
                      "task": msg.get("task"), "job": msg.get("job")}
+            events: list = []
+
+            def _ev(e, _events=events):
+                # stamp the emission time HERE: the driver only forwards
+                # these after the reply, and a late setdefault would skew
+                # every viewer/Gantt timestamp by the task wall
+                _events.append(dict(e, ts=round(_time.time(), 4)))
+
             try:
-                if msg.get("delay_s"):
-                    _time.sleep(msg["delay_s"])
-                from dryad_tpu.exec.data import (maybe_shrink_for_collect,
-                                                 pdata_to_host)
-                from dryad_tpu.exec.executor import Executor
-                from dryad_tpu.plan.serialize import graph_from_json
-                from dryad_tpu.runtime.shiplan import resolve_fn_table
-                from dryad_tpu.runtime.sources import build_source
-                global _LOCAL
-                try:
-                    local_mesh, local_ex = _LOCAL
-                except NameError:
-                    local_mesh = make_mesh(devices=jax.local_devices())
-                    local_ex = Executor(local_mesh)
-                    _LOCAL = (local_mesh, local_ex)
-                cfg = msg.get("config")
-                local_ex.apply_config(cfg)
-                fn_table = resolve_fn_table(msg["plan"], args.fn_module)
-                sources = {key: build_source(spec, local_mesh)
-                           for key, spec in msg["sources"].items()}
-                graph = graph_from_json(msg["plan"], fn_table=fn_table,
-                                        sources=sources)
-                pd = local_ex.run(graph)
-                reply["table"] = pdata_to_host(
-                    maybe_shrink_for_collect(pd, config=cfg))
+                # adopt the driver's trace context for this task only:
+                # our task/stage/io spans parent-link into the dispatch
+                # span riding the envelope (protocol.TRACE_CTX).  The
+                # SUBMITTING DRIVER decides tracing for the whole job —
+                # trace_ctx presence carries its verdict, so an untraced
+                # driver costs zero span work here too
+                _tctx = protocol.extract_trace(msg)
+                _evs = _trace.leveled(_ev, 2 if _tctx is not None else 0)
+                with _trace.tracing(_evs, _tctx), \
+                        _trace.span(f"task {msg.get('task')}", "task",
+                                    task=msg.get("task"),
+                                    job=msg.get("job"),
+                                    worker_pid=args.process_id):
+                    if msg.get("delay_s"):
+                        _time.sleep(msg["delay_s"])
+                    from dryad_tpu.exec.data import (
+                        maybe_shrink_for_collect, pdata_to_host)
+                    from dryad_tpu.exec.executor import Executor
+                    from dryad_tpu.plan.serialize import graph_from_json
+                    from dryad_tpu.runtime.shiplan import resolve_fn_table
+                    from dryad_tpu.runtime.sources import build_source
+                    global _LOCAL
+                    try:
+                        local_mesh, local_ex = _LOCAL
+                    except NameError:
+                        local_mesh = make_mesh(devices=jax.local_devices())
+                        local_ex = Executor(local_mesh)
+                        # a farm task is one slice of the driver's job,
+                        # not a job: its Run must not emit job_done
+                        # (exec/recovery.py) or dryad_jobs_total would
+                        # count every task
+                        local_ex._emit_job_done = False
+                        _LOCAL = (local_mesh, local_ex)
+                    cfg = msg.get("config")
+                    local_ex.apply_config(cfg)
+                    local_ex._event = _evs
+                    fn_table = resolve_fn_table(msg["plan"],
+                                                args.fn_module)
+                    sources = {key: build_source(spec, local_mesh)
+                               for key, spec in msg["sources"].items()}
+                    graph = graph_from_json(msg["plan"],
+                                            fn_table=fn_table,
+                                            sources=sources)
+                    pd = local_ex.run(graph)
+                    reply["table"] = pdata_to_host(
+                        maybe_shrink_for_collect(pd, config=cfg))
             except Exception:
                 reply = {"ok": False, "pid": args.process_id,
                          "task": msg.get("task"), "job": msg.get("job"),
                          "error": traceback.format_exc()}
+            reply["events"] = events
             if not _send_reply(reply):
                 lost_control = True
                 break
@@ -189,7 +221,17 @@ def main(argv=None) -> int:
                 break
             continue
         if cmd == "run":
+            import time as _time
+
+            from dryad_tpu.obs import trace as _trace
+
             events: list = []
+
+            def _ev(e, _events=events):
+                # emission-time stamp (see run_task): forwarded events
+                # must carry the time they happened, not arrival time
+                _events.append(dict(e, ts=round(_time.time(), 4)))
+
             reply: dict = {"ok": True, "pid": args.process_id,
                            "job": msg.get("job")}
             hb_stop = threading.Event()
@@ -205,15 +247,20 @@ def main(argv=None) -> int:
                 from dryad_tpu.runtime.shiplan import resolve_fn_table
                 fn_table = resolve_fn_table(msg["plan"], args.fn_module)
                 collect = msg.get("collect", True)
-                table, extras = execute_plan(
-                    msg["plan"], fn_table, msg["sources"], mesh,
-                    event_log=events.append,
-                    store_path=msg.get("store_path"),
-                    store_partitioning=msg.get("store_partitioning"),
-                    collect=collect, config=msg.get("config"),
-                    keep_token=msg.get("keep_token"),
-                    release=tuple(msg.get("release") or ()),
-                    store_compression=msg.get("store_compression"))
+                # trace_ctx presence = the driver's tracing verdict
+                # (see run_task)
+                _tctx = protocol.extract_trace(msg)
+                _evs = _trace.leveled(_ev, 2 if _tctx is not None else 0)
+                with _trace.tracing(_evs, _tctx):
+                    table, extras = execute_plan(
+                        msg["plan"], fn_table, msg["sources"], mesh,
+                        event_log=_evs,
+                        store_path=msg.get("store_path"),
+                        store_partitioning=msg.get("store_partitioning"),
+                        collect=collect, config=msg.get("config"),
+                        keep_token=msg.get("keep_token"),
+                        release=tuple(msg.get("release") or ()),
+                        store_compression=msg.get("store_compression"))
                 reply.update(extras)
                 if collect == "count":
                     if args.process_id == 0:

@@ -70,6 +70,7 @@ def enable_persistent_cache(path: Optional[str] = DEFAULT_CACHE_DIR) -> Optional
     init — the cache is consulted at compile time, not backend-init
     time."""
     global _enabled_dir
+    from dryad_tpu.obs.metrics import REGISTRY, family_gauge
     with _lock:
         import jax
 
@@ -77,6 +78,7 @@ def enable_persistent_cache(path: Optional[str] = DEFAULT_CACHE_DIR) -> Optional
             if _enabled_dir is not None:
                 jax.config.update("jax_compilation_cache_dir", None)
                 _enabled_dir = None
+            family_gauge(REGISTRY, "persistent_cache").set(0)
             return None
         # namespace by platform selection AND machine feature set: CPU
         # worker processes and the accelerator-attached driver compile
@@ -99,4 +101,5 @@ def enable_persistent_cache(path: Optional[str] = DEFAULT_CACHE_DIR) -> Optional
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         _enabled_dir = resolved
+        family_gauge(REGISTRY, "persistent_cache").set(1)
         return resolved

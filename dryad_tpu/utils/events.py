@@ -4,8 +4,9 @@ The reference streams timestamped key=value vertex/process/topology events to
 ``calypso.log`` on the job's DFS dir (GraphManager/reporting/
 DrCalypsoReporting.cpp:163-187, attached at LinqToDryadJM.cs:81-83), consumed
 by JobBrowser.  Here: structured JSONL with the same role — every stage
-execution, retry, replay, and spill is an event; ``job_report`` renders the
-per-stage summary (the JobBrowser per-stage table).
+execution, retry, replay, spill, farm dispatch, and trace span is an event;
+``job_report`` renders the per-stage summary (the JobBrowser per-stage
+table).
 """
 
 from __future__ import annotations
@@ -18,28 +19,45 @@ __all__ = ["EventLog", "job_report"]
 
 
 # event kinds by verbosity level (DRYAD_LOGGING_LEVEL role,
-# LinqToDryadJM.cs:213): 0=errors only, 1=+stage/job lifecycle, 2=all
+# LinqToDryadJM.cs:213): 0=errors only, 1=+stage/job lifecycle, 2=all.
+# EVERY kind the runtime emits must be registered here — unknown kinds
+# default to level 0 (always emitted), so an unregistered kind would
+# bypass the filter entirely; tests/test_obs.py drift-tests this table
+# against the ``{"event": ...}`` literals in the source tree.
 _LEVELS = {
+    # failures / teardown verdicts — visible even at level 0
     "stage_replay": 0, "worker_failed": 0, "job_failed": 0,
-    "worker_wedged": 0,
+    "worker_wedged": 0, "task_timeout": 0, "worker_ping_timeout": 0,
+    # stage/job lifecycle + scheduling decisions
     "stage_done": 1, "plan": 1, "stage_spilled": 1, "stage_restored": 1,
     "task_done": 1, "task_duplicated": 1, "task_reassigned": 1,
-    "lint_finding": 1,
+    "lint_finding": 1, "settle_replay": 1, "stage_retry": 1,
+    "stream_stage_done": 1, "stream_tee_spill": 1, "job_done": 1,
+    # chatter: progress ticks, losing duplicates, locality notes, spans
     "progress": 2, "task_duplicate_ignored": 2,
+    "task_duplicate_failed_ignored": 2, "task_locality_dispatch": 2,
+    "span": 2,
 }
 
 
 class EventLog:
     """In-memory + optional JSONL-file event sink.
 
-    ``level`` filters by verbosity (default: env ``DRYAD_LOGGING_LEVEL`` or
-    2 = everything); unknown event kinds always pass."""
+    ``level`` filters by verbosity (default: env ``DRYAD_LOGGING_LEVEL``
+    or 2 = everything); unknown event kinds always pass.  Usable as a
+    context manager so a failing job path cannot leak the JSONL handle::
+
+        with EventLog(path) as log:
+            ctx = Context(event_log=log)
+            ...
+    """
 
     def __init__(self, path: Optional[str] = None,
                  level: Optional[int] = None):
         import os
         self.events: List[Dict[str, Any]] = []
         self._f = open(path, "a") if path else None
+        self.closed = False
         self.level = (level if level is not None
                       else int(os.environ.get("DRYAD_LOGGING_LEVEL", "2")))
 
@@ -49,35 +67,58 @@ class EventLog:
         e = dict(event)
         e.setdefault("ts", round(time.time(), 4))
         self.events.append(e)
-        if self._f is not None:
+        # write-after-close guard: a straggler's late losing-duplicate
+        # reply may still emit after the job closed the log — keep the
+        # in-memory record, never touch the closed handle
+        if self._f is not None and not self.closed:
             self._f.write(json.dumps(e) + "\n")
             self._f.flush()
 
     def close(self):
+        self.closed = True
         if self._f is not None:
             self._f.close()
             self._f = None
+        # a closed log must stop being the process span sink, or later
+        # jobs' spans would silently pile into this dead in-memory list
+        from dryad_tpu.obs import trace
+        trace.uninstall(self)
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def of_type(self, kind: str) -> List[Dict[str, Any]]:
         return [e for e in self.events if e.get("event") == kind]
 
 
 def job_report(events) -> str:
-    """Render a per-stage execution summary from an event stream."""
+    """Render a per-stage execution summary from an event stream.
+
+    Covers gang stages (``stage_done``/``stage_replay``) AND stream-mode
+    stages (``stream_stage_done``, with ``stream_tee_spill`` counted in
+    the spills column) — a streamed run's stages must not silently drop
+    out of the table."""
     if isinstance(events, EventLog):
         events = events.events
     stages: Dict[Any, Dict[str, Any]] = {}
     order = []
+    kinds = ("stage_done", "stage_replay", "stage_retry",
+             "stream_stage_done", "stream_tee_spill")
     for e in events:
-        if e.get("event") in ("stage_done", "stage_replay", "stage_retry"):
+        if e.get("event") in kinds:
             sid = e.get("stage")
             if sid not in stages:
                 stages[sid] = {"label": e.get("label", "?"), "runs": 0,
-                               "retries": 0, "replays": 0, "wall_s": 0.0,
-                               "scale": 1}
+                               "retries": 0, "replays": 0, "spills": 0,
+                               "wall_s": 0.0, "scale": 1}
                 order.append(sid)
             s = stages[sid]
-            if e["event"] == "stage_done":
+            if e.get("label"):
+                s["label"] = e["label"]
+            if e["event"] in ("stage_done", "stream_stage_done"):
                 s["runs"] += 1
                 s["wall_s"] += e.get("wall_s", 0.0)
                 s["scale"] = max(s["scale"], e.get("scale", 1))
@@ -85,11 +126,14 @@ def job_report(events) -> str:
                     s["retries"] += 1
             elif e["event"] == "stage_replay":
                 s["replays"] += 1
+            elif e["event"] == "stream_tee_spill":
+                s["spills"] += 1
     lines = [f"{'stage':>6} {'label':<16} {'runs':>4} {'retries':>7} "
-             f"{'replays':>7} {'scale':>5} {'wall_s':>8}"]
+             f"{'replays':>7} {'spills':>6} {'scale':>5} {'wall_s':>8}"]
     for sid in order:
         s = stages[sid]
         lines.append(f"{sid:>6} {s['label']:<16} {s['runs']:>4} "
-                     f"{s['retries']:>7} {s['replays']:>7} {s['scale']:>5} "
+                     f"{s['retries']:>7} {s['replays']:>7} "
+                     f"{s['spills']:>6} {s['scale']:>5} "
                      f"{s['wall_s']:>8.3f}")
     return "\n".join(lines)

@@ -345,6 +345,54 @@ def _lint_html(events) -> str:
             f"<table class='lint'>{head}{''.join(rows)}</table>")
 
 
+def _critical_path_html(events) -> str:
+    """Critical-path section (the Artemis question): top path segments
+    plus the per-stage queue/compile/run/io split, computed from the
+    span events (obs/critical_path.py).  Absent when the stream carries
+    no timing at all."""
+    from dryad_tpu.obs.critical_path import critical_path
+    res = critical_path(events)
+    if not res["segments"] and not res["per_stage"]:
+        return ""
+    total = res["total_s"]
+    rows = []
+    for i, s in enumerate(res["top"][:10], 1):
+        pct = 100.0 * s["self_s"] / total if total > 0 else 0.0
+        bar = (f'<div style="background: var(--series); height: 10px; '
+               f'width: {max(pct, 0.5):.1f}%"></div>')
+        rows.append(f"<tr><td>{i}</td>"
+                    f"<td>{html.escape(str(s['name']))}</td>"
+                    f"<td>{html.escape(str(s['kind']))}</td>"
+                    f"<td>{s['self_s']:.3f}</td><td>{pct:.1f}%</td>"
+                    f"<td style='min-width: 160px; text-align: left'>"
+                    f"{bar}</td></tr>")
+    seg_html = ""
+    if rows:
+        seg_html = (f"<p>total {total:.3f}s across "
+                    f"{len(res['segments'])} segment(s)</p>"
+                    "<table><tr><th>#</th><th>segment</th><th>kind</th>"
+                    "<th>self&nbsp;s</th><th>%</th><th></th></tr>"
+                    + "".join(rows) + "</table>")
+    brows = []
+    for r in res["per_stage"]:
+        brows.append(f"<tr><td>{html.escape(str(r['stage']))}</td>"
+                     f"<td>{html.escape(str(r['label']))}</td>"
+                     f"<td>{r['queue_s']:.3f}</td>"
+                     f"<td>{r['compile_s']:.3f}</td>"
+                     f"<td>{r['run_s']:.3f}</td>"
+                     f"<td>{r['io_s']:.3f}</td></tr>")
+    br_html = ""
+    if brows:
+        br_html = ("<h3>per-stage time (queue / compile / run / io)</h3>"
+                   "<table><tr><th>stage</th><th>label</th>"
+                   "<th>queue&nbsp;s</th><th>compile&nbsp;s</th>"
+                   "<th>run&nbsp;s</th><th>io&nbsp;s</th></tr>"
+                   + "".join(brows) + "</table>")
+    if not seg_html and not br_html:
+        return ""
+    return "<h2>Critical path</h2>" + seg_html + br_html
+
+
 def _diagnosis_html(events) -> str:
     recs = diagnose(events)
     if not recs:
@@ -445,6 +493,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
 <div class="tiles">{tile_html}</div>
 {_diagnosis_html(events)}
 {_lint_html(events)}
+{_critical_path_html(events)}
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}
 <h2>Gantt (time from job start)</h2>{_svg_gantt(stages, order)}
 <h2>Per-stage table</h2>{_table(stages, order)}
@@ -481,6 +530,10 @@ def serve_live(jsonl_path: str, port: int = 0,
     """Serve the report over HTTP, re-rendered from the JSONL event
     stream on every request (EventLog flushes per event, so an open
     browser follows a RUNNING job — the live JobBrowser model).
+    ``/metrics`` exposes Prometheus text metrics: the counter families
+    derived from the event stream (task/retry/straggler/shuffle-bytes/
+    compile-cache), merged with this process's live registry (queue
+    depth and friends when the job runs in-process).
     Returns the bound (server, port); call server.serve_forever()."""
     import http.server
 
@@ -488,14 +541,24 @@ def serve_live(jsonl_path: str, port: int = 0,
         return job_report_html(_read_jsonl(jsonl_path), title=jsonl_path,
                                live_refresh_s=refresh_s).encode()
 
+    def render_metrics() -> bytes:
+        from dryad_tpu.obs.metrics import REGISTRY, metrics_from_events
+        reg = metrics_from_events(_read_jsonl(jsonl_path))
+        return reg.merge_from(REGISTRY).render().encode()
+
     class H(http.server.BaseHTTPRequestHandler):
         def log_message(self, *a):
             pass
 
         def do_GET(self):
-            body = render()
+            if self.path.split("?", 1)[0] == "/metrics":
+                body = render_metrics()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = render()
+                ctype = "text/html; charset=utf-8"
             self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
