@@ -72,6 +72,13 @@ class Context:
         # job's spans must never leak into an earlier job's JSONL
         from dryad_tpu.obs import trace as _trace
         _trace.install(event_log)
+        # job-history archiving (obs/history.py): JobConfig.history_dir
+        # makes the attached EventLog archive this job's {events, plan,
+        # metrics, bundles} on close; an explicit EventLog(history_dir=)
+        # wins over the config knob
+        if (self.config.history_dir and event_log is not None
+                and getattr(event_log, "history_dir", "absent") is None):
+            event_log.history_dir = self.config.history_dir
         if cluster is not None:
             # multi-process mode (runtime.LocalCluster): the driver owns no
             # devices; plans + deferred sources ship to the worker gang

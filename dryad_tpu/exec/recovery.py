@@ -80,17 +80,33 @@ class Run:
     def output(self) -> PData:
         import time as _time
 
+        from dryad_tpu.obs import profile as _profile
         from dryad_tpu.obs import trace
         from dryad_tpu.obs.metrics import REGISTRY
         t0 = _time.time()
-        # the job span: every stage/io span of this run parents into it
-        # (on a worker the envelope's trace_ctx makes it a child of the
-        # driver's job span — obs/trace.py context propagation)
-        with trace.span("run", "job", sink=self.ex._event,
-                        stages=len(self.graph.stages)):
-            out = self.result(self.graph.out_stage)
-            if self._defer:
-                out = self._settle()
+        # background resource sampler for this run's duration
+        # (obs/profile.py): gated by the sink's level like spans, so a
+        # no-consumer run starts no thread.  Worker processes (tagged
+        # with DRYAD_WORKER_ID) run their OWN per-command samplers
+        # (runtime/worker.py) — sampling here too would double-report
+        # them under a driver label.
+        sampler = _profile.start(
+            self.ex._event if os.environ.get("DRYAD_WORKER_ID") is None
+            else None,
+            getattr(getattr(self.ex, "config", None),
+                    "resource_sample_s", 0.0) or 0.0,
+            role="driver")
+        try:
+            # the job span: every stage/io span of this run parents into
+            # it (on a worker the envelope's trace_ctx makes it a child
+            # of the driver's job span — obs/trace.py propagation)
+            with trace.span("run", "job", sink=self.ex._event,
+                            stages=len(self.graph.stages)):
+                out = self.result(self.graph.out_stage)
+                if self._defer:
+                    out = self._settle()
+        finally:
+            _profile.stop(sampler)
         self.ex._event({"event": "progress", "done": len(self._results),
                         "total": len(self.graph.stages), "pct": 100.0})
         # job-end metrics snapshot.  "metrics" carries CUMULATIVE

@@ -20,11 +20,18 @@ Everything here is stdlib-only and import-light: the runtime imports
 ``obs.trace``/``obs.metrics`` on its hot paths.
 """
 
+from dryad_tpu.obs import flight  # noqa: F401
+from dryad_tpu.obs import history  # noqa: F401
+from dryad_tpu.obs import profile  # noqa: F401
 from dryad_tpu.obs import trace  # noqa: F401
 from dryad_tpu.obs.chrome import chrome_trace  # noqa: F401
 from dryad_tpu.obs.critical_path import critical_path, render_text  # noqa: F401
+from dryad_tpu.obs.flight import (capture_bundle, load_bundle,  # noqa: F401
+                                  persist_bundle, replay_bundle)
+from dryad_tpu.obs.history import archive_job, history_index  # noqa: F401
 from dryad_tpu.obs.metrics import (REGISTRY, Registry,  # noqa: F401
                                    metrics_dump, metrics_from_events)
+from dryad_tpu.obs.profile import ResourceSampler, diagnose_events  # noqa: F401
 from dryad_tpu.obs.trace import (Span, current_ctx, ctx_of,  # noqa: F401
                                  finish, install, span, start, tracing,
                                  tracing_enabled)
@@ -32,4 +39,7 @@ from dryad_tpu.obs.trace import (Span, current_ctx, ctx_of,  # noqa: F401
 __all__ = ["trace", "Span", "span", "start", "finish", "tracing",
            "install", "current_ctx", "ctx_of", "tracing_enabled",
            "REGISTRY", "Registry", "metrics_dump", "metrics_from_events",
-           "chrome_trace", "critical_path", "render_text"]
+           "chrome_trace", "critical_path", "render_text",
+           "flight", "capture_bundle", "persist_bundle", "load_bundle",
+           "replay_bundle", "profile", "ResourceSampler",
+           "diagnose_events", "history", "archive_job", "history_index"]

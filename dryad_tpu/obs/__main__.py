@@ -1,17 +1,117 @@
-"""Observability CLI — ``python -m dryad_tpu.obs <cmd> events.jsonl``.
+"""Observability CLI — ``python -m dryad_tpu.obs <cmd> ...``.
 
-The jobctl-style post-hoc tools over a recorded EventLog stream:
+The jobctl-style post-hoc tools over recorded telemetry:
 
-* ``trace``          export Chrome trace-event JSON (open in Perfetto)
+* ``trace``          export Chrome trace-event JSON (open in Perfetto;
+                     includes resource-sample counter tracks)
 * ``critical-path``  print the job's critical-path decomposition
 * ``metrics``        print Prometheus text metrics derived from events
+* ``replay``         re-execute a task-failure forensics bundle
+                     in-process, reproducing the remote exception
+* ``history``        list a job-history directory with cross-run deltas
+
+Exit codes: 0 success (for ``replay``: the recorded failure was
+faithfully reproduced), 1 reproduction mismatch, 2 malformed input
+(missing/unreadable files, empty event streams, non-bundles).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+
+def _fail(msg: str) -> int:
+    print(f"dryad_tpu.obs: {msg}", file=sys.stderr)
+    return 2
+
+
+def _load_events(path: str):
+    """Events or None (malformed): missing file, or a file from which
+    not a single event parses."""
+    if not os.path.isfile(path):
+        return None
+    from dryad_tpu.utils.viewer import _read_jsonl
+    events = _read_jsonl(path)
+    return events or None
+
+
+def _cmd_replay(args) -> int:
+    from dryad_tpu.obs import flight
+    try:
+        bundle = flight.load_bundle(args.bundle)
+    except Exception as e:
+        return _fail(f"cannot load bundle {args.bundle!r}: {e}")
+    # CPU replay needs as many virtual devices as the worker had.  The
+    # backend initializes lazily on the first device query, so setting
+    # the XLA flag here still works even though jax is already
+    # imported; the flag only affects the host (CPU) client, so it is
+    # inert when jax auto-selects a real accelerator — set it
+    # UNCONDITIONALLY (an operator's JAX_PLATFORMS is usually unset,
+    # and jax then picks cpu on a CPU-only box).  replay_bundle still
+    # raises a clear BundleError if an already-initialized backend is
+    # too small.
+    n = bundle.get("n_devices") or 1
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}")
+    # a bundle from a cpu-platform worker replays on the cpu backend
+    # without the operator exporting JAX_PLATFORMS themselves (an
+    # installed-but-unreachable accelerator plugin would otherwise
+    # hijack — or hang — backend selection)
+    if bundle.get("platform") == "cpu" \
+            and not os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    rec = bundle.get("error") or {}
+    print(f"replaying {bundle.get('kind', 'task')} "
+          f"{bundle.get('task')} of job {bundle.get('job')} "
+          f"(worker {bundle.get('worker')}, {n} device(s))")
+    if rec:
+        print(f"recorded : {rec.get('type')}: {rec.get('message')}")
+    try:
+        flight.replay_bundle(bundle)
+    except Exception as e:
+        if args.reraise:
+            raise
+        got_t, got_m = type(e).__name__, str(e)
+        print(f"replayed : {got_t}: {got_m}")
+        # message match: exact, or a NON-EMPTY substring either way
+        # (jax may append trace notes) — an empty side must not make
+        # every same-type exception count as reproduced
+        rm = rec.get("message") or ""
+        same = (got_t == rec.get("type")
+                and (rm == got_m or (bool(rm) and rm in got_m)
+                     or (bool(got_m) and got_m in rm)))
+        print(f"verdict  : "
+              f"{'REPRODUCED' if same else 'DIFFERENT FAILURE'}")
+        if not same and rec:
+            import traceback
+            traceback.print_exc()
+        return 0 if same else 1
+    if rec:
+        print("replayed : task completed WITHOUT error — the recorded "
+              "failure did not reproduce (environment difference?)")
+        return 1
+    print("replayed : task completed without error")
+    return 0
+
+
+def _cmd_history(args) -> int:
+    if not os.path.isdir(args.dir):
+        return _fail(f"{args.dir!r} is not a history directory")
+    from dryad_tpu.obs.history import (history_index, index_html,
+                                       render_history_text)
+    entries = history_index(args.dir)
+    print(render_history_text(entries))
+    if args.html:
+        with open(args.html, "w") as f:
+            f.write(index_html(entries, title=args.dir))
+        print(f"\nindex page: {args.html}")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -37,10 +137,32 @@ def main(argv=None) -> int:
                        help="Prometheus text metrics from events")
     m.add_argument("events", help="EventLog JSONL path")
 
-    args = ap.parse_args(argv)
-    from dryad_tpu.utils.viewer import _read_jsonl
-    events = _read_jsonl(args.events)
+    r = sub.add_parser("replay",
+                       help="re-execute a forensics bundle in-process "
+                            "(obs/flight.py), reproducing the failure")
+    r.add_argument("bundle", help="bundle path (from the task_forensics "
+                                  "event / FarmError message)")
+    r.add_argument("--raise", dest="reraise", action="store_true",
+                   help="re-raise the reproduced exception instead of "
+                        "printing a verdict (for `python -m pdb`)")
 
+    h = sub.add_parser("history",
+                       help="list a job-history directory "
+                            "(obs/history.py) with cross-run deltas")
+    h.add_argument("dir", help="history directory "
+                               "(JobConfig.history_dir)")
+    h.add_argument("--html", help="also write the index page here")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "replay":
+        return _cmd_replay(args)
+    if args.cmd == "history":
+        return _cmd_history(args)
+
+    events = _load_events(args.events)
+    if events is None:
+        return _fail(f"{args.events!r} is missing or holds no "
+                     f"parseable events")
     if args.cmd == "trace":
         from dryad_tpu.obs.chrome import chrome_trace
         out = args.out or (args.events + ".trace.json")

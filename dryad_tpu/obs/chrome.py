@@ -7,7 +7,9 @@ load the output at https://ui.perfetto.dev).  Spans become complete
 ("ph": "X") events; the process lane is the emitting worker (driver =
 pid 0), and overlapping spans within a process are laid out on
 greedily-allocated tracks so sibling tasks render side by side instead
-of on top of each other.
+of on top of each other.  ``resource_sample`` events (obs/profile.py)
+become per-process COUNTER tracks ("ph": "C"): memory (RSS + jax
+device-buffer MiB) and CPU%, drawn above each process's span lanes.
 """
 
 from __future__ import annotations
@@ -19,8 +21,11 @@ __all__ = ["chrome_trace"]
 
 def _pid_of(e: Dict[str, Any]) -> int:
     """Process lane: forwarded worker events carry a ``worker`` tag
-    (runtime/cluster.py, runtime/farm.py); driver-emitted spans don't."""
+    (runtime/cluster.py, runtime/farm.py); worker-side emitters also
+    self-tag ``worker_pid``; driver-emitted events carry neither."""
     w = e.get("worker")
+    if w is None:
+        w = e.get("worker_pid")
     if w is None:
         w = (e.get("attrs") or {}).get("worker_pid")
     try:
@@ -31,14 +36,27 @@ def _pid_of(e: Dict[str, Any]) -> int:
 
 def chrome_trace(events) -> Dict[str, Any]:
     """Build the Chrome trace dict from an event iterable."""
+    events = list(events)
     spans = [e for e in events
              if e.get("event") == "span" and e.get("t0") is not None
              and e.get("dur_s") is not None]
+    samples = [e for e in events
+               if e.get("event") == "resource_sample"
+               and e.get("ts") is not None]
     out: List[Dict[str, Any]] = []
+    named_pids = set()
+
+    def ensure_name(pid: int) -> None:
+        if pid not in named_pids:
+            named_pids.add(pid)
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "tid": 0,
+                        "args": {"name": ("driver" if pid == 0
+                                          else f"worker {pid - 1}")}})
+
     # lane allocation per process: first track whose last span ended
     # before this one starts (spans sorted by start time)
     lanes: Dict[int, List[float]] = {}
-    named_pids = set()
     for e in sorted(spans, key=lambda e: (float(e["t0"]),
                                           -float(e["dur_s"]))):
         pid = _pid_of(e)
@@ -51,12 +69,7 @@ def chrome_trace(events) -> Dict[str, Any]:
             tid = len(ends)
             ends.append(0.0)
         ends[tid] = t0 + dur
-        if pid not in named_pids:
-            named_pids.add(pid)
-            out.append({"ph": "M", "name": "process_name", "pid": pid,
-                        "tid": 0,
-                        "args": {"name": ("driver" if pid == 0
-                                          else f"worker {pid - 1}")}})
+        ensure_name(pid)
         args = {"trace": e.get("trace"), "span": e.get("span")}
         if e.get("parent"):
             args["parent"] = e["parent"]
@@ -66,4 +79,22 @@ def chrome_trace(events) -> Dict[str, Any]:
                     "ts": round(t0 * 1e6, 1),
                     "dur": max(round(dur * 1e6, 1), 1.0),
                     "pid": pid, "tid": tid, "args": args})
+    # resource samples -> per-process counter tracks
+    for e in sorted(samples, key=lambda e: float(e["ts"])):
+        pid = _pid_of(e)
+        ensure_name(pid)
+        ts = round(float(e["ts"]) * 1e6, 1)
+        mem: Dict[str, float] = {}
+        if e.get("rss_bytes") is not None:
+            mem["rss_mb"] = round(float(e["rss_bytes"]) / (1 << 20), 2)
+        if e.get("device_bytes") is not None:
+            mem["device_mb"] = round(float(e["device_bytes"])
+                                     / (1 << 20), 2)
+        if mem:
+            out.append({"ph": "C", "name": "memory", "pid": pid,
+                        "tid": 0, "ts": ts, "args": mem})
+        if e.get("cpu_pct") is not None:
+            out.append({"ph": "C", "name": "cpu", "pid": pid, "tid": 0,
+                        "ts": ts,
+                        "args": {"cpu_pct": float(e["cpu_pct"])}})
     return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -103,6 +103,69 @@ def _merge(segments: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [s for s in out if s["self_s"] > 0]
 
 
+def _related(a_sid, b_sid, by_id) -> bool:
+    """True when one span is an ancestor of the other (chain walk with
+    a cycle guard); the root pseudo-segment (span None) relates to
+    everything."""
+    if a_sid is None or b_sid is None:
+        return True
+
+    def ancestors(sid):
+        seen = set()
+        while sid is not None and sid not in seen:
+            seen.add(sid)
+            sp = by_id.get(sid)
+            sid = sp.get("parent") if sp is not None else None
+        return seen
+
+    return a_sid in ancestors(b_sid) or b_sid in ancestors(a_sid)
+
+
+def _absorb_slivers(segments: List[Dict[str, Any]], by_id,
+                    min_s: float = 1e-3) -> List[Dict[str, Any]]:
+    """Fold sub-``min_s`` segments into a time-adjacent neighbor.
+
+    The chain walk is exact, so a parent span resuming between two long
+    children shows up as a microscopic sliver (e.g. a 5.5e-05 s "run"
+    between a stage end and the job end) that crowds real work out of
+    the top list.  Each sliver's interval is handed to the neighbor on
+    its own parent/child chain when one exists (the time belongs to
+    that call path), else to the longer neighbor — the segments still
+    partition [lo, hi) exactly, so sum(self_s) == total_s holds."""
+    segs = [dict(s) for s in segments]
+    changed = True
+    while changed and len(segs) > 1:
+        changed = False
+        for i, s in enumerate(segs):
+            if s["t1"] - s["t0"] >= min_s:
+                continue
+            prev_ = segs[i - 1] if i > 0 else None
+            next_ = segs[i + 1] if i + 1 < len(segs) else None
+            if prev_ is not None and _related(s["span"], prev_["span"],
+                                              by_id):
+                target = prev_
+            elif next_ is not None and _related(s["span"], next_["span"],
+                                                by_id):
+                target = next_
+            elif prev_ is None:
+                target = next_
+            elif next_ is None:
+                target = prev_
+            else:
+                target = (prev_ if (prev_["t1"] - prev_["t0"])
+                          >= (next_["t1"] - next_["t0"]) else next_)
+            if target is prev_:
+                target["t1"] = s["t1"]
+            else:
+                target["t0"] = s["t0"]
+            del segs[i]
+            changed = True
+            break
+    for s in segs:
+        s["self_s"] = round(s["t1"] - s["t0"], 6)
+    return segs
+
+
 def _stage_breakdown(events, spans, by_id) -> List[Dict[str, Any]]:
     """Per-stage queue / compile / run / io rows."""
     rows: Dict[Any, Dict[str, Any]] = {}
@@ -170,12 +233,15 @@ def _stage_breakdown(events, spans, by_id) -> List[Dict[str, Any]]:
     return out
 
 
-def critical_path(events, top: int = 10) -> Dict[str, Any]:
+def critical_path(events, top: int = 10,
+                  min_segment_s: float = 1e-3) -> Dict[str, Any]:
     """Compute the critical-path decomposition of an event stream.
 
     Returns ``{"total_s", "segments" (time order), "top" (by self
     time), "per_stage"}``; ``total_s`` is the trace envelope (root span
-    duration) and always equals ``sum(seg.self_s)``."""
+    duration) and always equals ``sum(seg.self_s)``.  Segments shorter
+    than ``min_segment_s`` are folded into their parent-chain neighbor
+    (``_absorb_slivers``; pass 0 to keep every raw segment)."""
     events = list(events)
     spans = _span_records(events)
     if not spans:
@@ -191,6 +257,8 @@ def critical_path(events, top: int = 10) -> Dict[str, Any]:
     segments: List[Dict[str, Any]] = []
     _decompose(None, "(driver)", "root", kids, lo, hi, segments)
     segments = _merge(segments)
+    if min_segment_s > 0:
+        segments = _absorb_slivers(segments, by_id, min_segment_s)
     ranked = sorted(segments, key=lambda s: -s["self_s"])[:top]
     return {"total_s": round(hi - lo, 6), "segments": segments,
             "top": ranked,

@@ -1,0 +1,219 @@
+"""Continuous resource profiling + sibling-relative diagnosis.
+
+The reference's Artemis mines vertex logs post-hoc for stragglers and
+data skew; production systems additionally sample LIVE process health.
+Two pieces here, both feeding the ONE JSONL event stream:
+
+* :class:`ResourceSampler` — a background thread in worker and driver
+  emitting periodic ``resource_sample`` events (RSS, CPU%, jax
+  device-buffer bytes, gc counts).  Samples ride the normal event path:
+  worker samples land in the task reply's events buffer and are
+  forwarded worker-tagged by the farm, so ``obs/chrome.py`` can render
+  them as per-process counter tracks.  One sample is taken immediately
+  at start and one at stop, so even a millisecond task leaves a record.
+
+* :func:`diagnose_events` — the Artemis questions answerable from the
+  recorded stream: DATA SKEW (one partition holding >= ``skew_factor``x
+  the rows/bytes of its sibling median, from ``stage_done`` per-
+  partition row counts) and SLOW WORKERS (a worker whose mean farm-task
+  wall is >= ``slow_factor``x its siblings' median, from ``task_done``).
+  Findings are event-shaped (``diagnosis_skew`` /
+  ``diagnosis_slow_worker``, registered in ``utils/events._LEVELS``)
+  so they can be archived with the job; ``utils/viewer.diagnose()``
+  renders them in the HTML Diagnosis section.
+
+Everything is stdlib + best-effort: a failed sample must never fail the
+job (same contract as spans, obs/trace.py).
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ResourceSampler", "start", "stop", "sample_now",
+           "diagnose_events"]
+
+
+def _rss_bytes() -> Optional[int]:
+    """Resident set size: /proc on Linux, ru_maxrss (peak) fallback."""
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # Linux reports KiB, macOS bytes; both are an upper bound here
+        return rss * 1024 if rss < 1 << 40 else rss
+    except Exception:
+        return None
+
+
+def _device_bytes() -> Optional[int]:
+    """Live jax device-buffer bytes: allocator stats where the backend
+    exposes them, else the sizes of live arrays (CPU backend)."""
+    try:
+        import sys
+        jax = sys.modules.get("jax")
+        if jax is None:       # never force-import jax from a sampler
+            return None
+        total = 0
+        stats_seen = False
+        for d in jax.local_devices():
+            s = getattr(d, "memory_stats", lambda: None)()
+            if s and "bytes_in_use" in s:
+                total += int(s["bytes_in_use"])
+                stats_seen = True
+        if stats_seen:
+            return total
+        return int(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def sample_now(cpu_prev: Optional[tuple] = None,
+               **tags: Any) -> Dict[str, Any]:
+    """One ``resource_sample`` event.  ``cpu_prev`` is the previous
+    ``(wall, cpu_seconds)`` pair for the CPU%% delta (None on the first
+    sample)."""
+    e: Dict[str, Any] = {"event": "resource_sample", **tags}
+    rss = _rss_bytes()
+    if rss is not None:
+        e["rss_bytes"] = rss
+    dev = _device_bytes()
+    if dev is not None:
+        e["device_bytes"] = dev
+    t = os.times()
+    now, cpu = time.time(), t.user + t.system
+    if cpu_prev is not None and now > cpu_prev[0]:
+        e["cpu_pct"] = round(100.0 * (cpu - cpu_prev[1])
+                             / (now - cpu_prev[0]), 1)
+    e["_cpu_state"] = (now, cpu)    # stripped by the sampler before emit
+    e["gc_counts"] = list(gc.get_count())
+    return e
+
+
+class ResourceSampler:
+    """Background ``resource_sample`` emitter; ``start()``/``stop()``
+    bracket the profiled scope.  The sink is any event callable (an
+    EventLog, the worker reply buffer, the farm's ``_emit``)."""
+
+    def __init__(self, sink, interval_s: float, **tags: Any):
+        self._sink = sink
+        self._interval = max(float(interval_s), 0.01)
+        self._tags = tags
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cpu_prev: Optional[tuple] = None
+
+    def _emit_one(self) -> None:
+        try:
+            e = sample_now(self._cpu_prev, **self._tags)
+            self._cpu_prev = e.pop("_cpu_state", None)
+            self._sink(e)
+        except Exception:
+            pass                  # telemetry must never fail the job
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._emit_one()
+
+    def start(self) -> "ResourceSampler":
+        self._emit_one()          # guarantee >=1 sample per scope
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._emit_one()          # final reading at scope end
+
+
+def start(sink, interval_s: float, **tags: Any
+          ) -> Optional[ResourceSampler]:
+    """Gated constructor: None (no sampler, zero threads) when there is
+    no sink, sampling is disabled (``interval_s <= 0``), or the sink's
+    explicit verbosity level filters level-2 events anyway — the same
+    no-consumer-means-no-work contract spans follow (obs/trace.py)."""
+    if sink is None or not interval_s or interval_s <= 0:
+        return None
+    lvl = getattr(sink, "level", None)
+    if isinstance(lvl, int) and lvl < 2:
+        return None
+    return ResourceSampler(sink, interval_s, **tags).start()
+
+
+def stop(sampler: Optional[ResourceSampler]) -> None:
+    """None-safe stop."""
+    if sampler is not None:
+        sampler.stop()
+
+
+# -- sibling-relative diagnosis ----------------------------------------------
+
+def diagnose_events(events, skew_factor: float = 4.0,
+                    slow_factor: float = 2.0,
+                    min_tasks: int = 2) -> List[Dict[str, Any]]:
+    """Skew / slow-worker findings from a recorded event stream.
+
+    Returns event-shaped records (kinds ``diagnosis_skew`` and
+    ``diagnosis_slow_worker``); callers may render them
+    (``viewer.diagnose``) or archive them (``obs/history``)."""
+    out: List[Dict[str, Any]] = []
+    # data skew: one partition >= skew_factor x the sibling median of
+    # per-partition row counts (rows x fixed row width = bytes, so the
+    # row ratio IS the bytes ratio for a columnar stage output)
+    worst: Dict[Any, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("event") != "stage_done":
+            continue
+        rows = e.get("rows")
+        if not isinstance(rows, list) or len(rows) < 2:
+            continue
+        rows = [int(r) for r in rows]
+        peak = max(rows)
+        sib = sorted(r for i, r in enumerate(rows)
+                     if i != rows.index(peak))
+        med = sib[len(sib) // 2] if sib else 0
+        if peak < skew_factor * max(med, 1) or peak < 2:
+            continue
+        rec = {"event": "diagnosis_skew", "stage": e.get("stage"),
+               "label": e.get("label", "?"),
+               "partition": rows.index(peak), "rows_max": peak,
+               "rows_sibling_median": med,
+               "ratio": round(peak / max(med, 1), 1)}
+        prev = worst.get(e.get("stage"))
+        if prev is None or rec["ratio"] > prev["ratio"]:
+            worst[e.get("stage")] = rec
+    out.extend(worst[k] for k in sorted(worst, key=str))
+    # slow workers: mean task wall vs the median of the other workers'
+    # means (the farm's sibling-relative straggler evidence, post-hoc)
+    walls: Dict[Any, List[float]] = {}
+    for e in events:
+        if e.get("event") == "task_done" and e.get("wall_s") is not None \
+                and e.get("worker") is not None:
+            walls.setdefault(e["worker"], []).append(float(e["wall_s"]))
+    if len(walls) >= 2:
+        means = {w: sum(v) / len(v) for w, v in walls.items()}
+        for w, m in sorted(means.items(), key=str):
+            if len(walls[w]) < min_tasks:
+                continue
+            sib = sorted(v for k, v in means.items() if k != w)
+            med = sib[len(sib) // 2]
+            if med > 0 and m >= slow_factor * med:
+                out.append({"event": "diagnosis_slow_worker", "worker": w,
+                            "tasks": len(walls[w]),
+                            "mean_s": round(m, 3),
+                            "sibling_median_s": round(med, 3),
+                            "ratio": round(m / med, 1)})
+    return out

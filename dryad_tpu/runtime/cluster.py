@@ -697,6 +697,7 @@ class LocalCluster(ClusterBackend):
                         "workers": sorted(errs),
                         "error": errs[min(errs)],
                         "log_tails": self._log_tails(800)})
+            bpath = self._persist_forensics(replies, sorted(errs), config)
             self._kill_all()  # gang state is unknown after an error
             first = min(errs)
             # ANY failing worker's lost-resident tag makes the job
@@ -708,9 +709,27 @@ class LocalCluster(ClusterBackend):
                        None)
             raise ClusterJobError(
                 f"{what} failed on worker(s) {sorted(errs)}; worker "
-                f"{first} error:\n{errs[first]}",
+                f"{first} error:\n{errs[first]}"
+                + (f"\nforensics bundle: {bpath}\n"
+                   f"  reproduce locally: python -m dryad_tpu.obs "
+                   f"replay {bpath}" if bpath else ""),
                 missing_token=tok)
         return replies
+
+    def _persist_forensics(self, replies: Dict[int, dict], err_pids,
+                           config) -> Optional[str]:
+        """Persist the FIRST failing worker's flight-recorder bundle
+        (the raised error quotes that worker; peers usually fail as
+        collective aborts of the same root cause).  Best-effort; the
+        placement/breadcrumb logic is shared with the task farm
+        (obs/flight.persist_reply_forensics)."""
+        from dryad_tpu.obs import flight
+        for pid in err_pids:
+            path = flight.persist_reply_forensics(
+                replies[pid], config, self.event_log, self._emit)
+            if path:
+                return path
+        return None
 
 
 def _try_decode(buf: bytearray):

@@ -127,6 +127,14 @@ class TaskFarm:
         if self.cluster.event_log is not None:
             self.cluster.event_log(dict(e))
 
+    def _persist_forensics(self, reply: dict):
+        """Persist a failing reply's flight-recorder bundle and emit
+        the task_forensics breadcrumb (obs/flight.py); returns the
+        bundle path (None when the reply carries no bundle)."""
+        from dryad_tpu.obs import flight
+        return flight.persist_reply_forensics(
+            reply, self.config, self.cluster.event_log, self._emit)
+
     # -- scheduling --------------------------------------------------------
 
     def _threshold(self, durations: List[float]) -> Optional[float]:
@@ -167,6 +175,13 @@ class TaskFarm:
         queue_gauge = family_gauge(REGISTRY, "queue_depth")
         farm_span = trace.start("farm", "farm", sink=tsink,
                                 job=job, tasks=len(per_task_sources))
+        # driver-side resource sampler for the farm's duration (workers
+        # run their own per-task samplers); gated by the same sink level
+        # as the spans, so an untraced farm starts no thread
+        from dryad_tpu.obs import profile as _profile
+        sampler = _profile.start(
+            tsink, getattr(self.config, "resource_sample_s", 0.0) or 0.0,
+            role="driver", job=job)
         try:
             out = self._run(plan_json, per_task_sources, timeout,
                             task_timeout_s, job, farm_span, tsink,
@@ -175,6 +190,7 @@ class TaskFarm:
             trace.finish(farm_span, error=type(e).__name__)
             raise
         finally:
+            _profile.stop(sampler)
             # an idle farm has no queue — a stale depth would misfire
             # any dashboard alerting on it
             queue_gauge.set(0)
@@ -404,9 +420,17 @@ class TaskFarm:
                                         "task_duplicate_failed_ignored",
                                         "task": t.idx, "worker": pid})
                             continue
+                        # persist the worker's flight-recorder bundle
+                        # BEFORE raising: the error message points the
+                        # operator at the local reproduction
+                        bpath = self._persist_forensics(reply)
                         raise FarmError(
                             f"task {reply.get('task')} failed on worker "
-                            f"{pid}:\n{reply.get('error')}")
+                            f"{pid}:\n{reply.get('error')}"
+                            + (f"\nforensics bundle: {bpath}\n"
+                               f"  reproduce locally: python -m "
+                               f"dryad_tpu.obs replay {bpath}"
+                               if bpath else ""))
                     took = time.time() - t.runs.get(pid, time.time())
                     trace.finish(t.spans.pop(pid, None),
                                  won=t.result is None)

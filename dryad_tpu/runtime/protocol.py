@@ -52,6 +52,28 @@ def extract_trace(msg: dict):
     return ctx if isinstance(ctx, dict) else None
 
 
+# -- failure forensics -------------------------------------------------------
+# A failing worker's error reply may carry a FORENSICS field: the flight
+# recorder's self-contained bundle (obs/flight.py — task envelope, input
+# digests, exception, recent-event ring) for driver-side persistence and
+# `python -m dryad_tpu.obs replay` local reproduction.
+FORENSICS = "forensics"
+
+
+def attach_forensics(reply: dict, bundle) -> dict:
+    """Attach a forensics bundle to an error reply (no-op on None)."""
+    if bundle:
+        reply[FORENSICS] = bundle
+    return reply
+
+
+def extract_forensics(reply: dict):
+    """Driver side: the reply's forensics bundle, if it carries a valid
+    one (obs/flight.py magic key — anything else is ignored)."""
+    b = reply.get(FORENSICS)
+    return b if isinstance(b, dict) and b.get("dryad_forensics") else None
+
+
 class AuthError(RuntimeError):
     """Control-plane handshake failed (wrong secret or not our protocol)."""
 

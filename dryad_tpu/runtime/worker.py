@@ -141,6 +141,8 @@ def main(argv=None) -> int:
             # reference DrVertex::RequestDuplicate)
             import time as _time
 
+            from dryad_tpu.obs import flight as _flight
+            from dryad_tpu.obs import profile as _profile
             from dryad_tpu.obs import trace as _trace
 
             reply = {"ok": True, "pid": args.process_id,
@@ -151,17 +153,28 @@ def main(argv=None) -> int:
                 # stamp the emission time HERE: the driver only forwards
                 # these after the reply, and a late setdefault would skew
                 # every viewer/Gantt timestamp by the task wall
-                _events.append(dict(e, ts=round(_time.time(), 4)))
+                e = dict(e, ts=round(_time.time(), 4))
+                _events.append(e)
+                # the flight ring keeps recent events across TASKS, so
+                # a later failure's forensics bundle carries the lead-up
+                _flight.record(e)
 
+            # adopt the driver's trace context for this task only: our
+            # task/stage/io spans parent-link into the dispatch span
+            # riding the envelope (protocol.TRACE_CTX).  The SUBMITTING
+            # DRIVER decides tracing for the whole job — trace_ctx
+            # presence carries its verdict, so an untraced driver costs
+            # zero span work here too; the resource sampler follows the
+            # same verdict (plus its own JobConfig.resource_sample_s
+            # gate)
+            _tctx = protocol.extract_trace(msg)
+            _evs = _trace.leveled(_ev, 2 if _tctx is not None else 0)
+            _sampler = _profile.start(
+                _ev if _tctx is not None else None,
+                getattr(msg.get("config"), "resource_sample_s", 0.0)
+                or 0.0,
+                worker_pid=args.process_id, task=msg.get("task"))
             try:
-                # adopt the driver's trace context for this task only:
-                # our task/stage/io spans parent-link into the dispatch
-                # span riding the envelope (protocol.TRACE_CTX).  The
-                # SUBMITTING DRIVER decides tracing for the whole job —
-                # trace_ctx presence carries its verdict, so an untraced
-                # driver costs zero span work here too
-                _tctx = protocol.extract_trace(msg)
-                _evs = _trace.leveled(_ev, 2 if _tctx is not None else 0)
                 with _trace.tracing(_evs, _tctx), \
                         _trace.span(f"task {msg.get('task')}", "task",
                                     task=msg.get("task"),
@@ -200,10 +213,24 @@ def main(argv=None) -> int:
                     pd = local_ex.run(graph)
                     reply["table"] = pdata_to_host(
                         maybe_shrink_for_collect(pd, config=cfg))
-            except Exception:
+            except Exception as e:
                 reply = {"ok": False, "pid": args.process_id,
                          "task": msg.get("task"), "job": msg.get("job"),
                          "error": traceback.format_exc()}
+                # ship the flight recorder's forensics bundle with the
+                # error: the driver persists it and `python -m
+                # dryad_tpu.obs replay` reproduces this failure locally.
+                # Best-effort — forensics must never mask the error.
+                try:
+                    protocol.attach_forensics(
+                        reply, _flight.capture_bundle(
+                            msg, e, kind="task",
+                            worker=args.process_id,
+                            fn_modules=args.fn_module, events=events))
+                except Exception:
+                    pass
+            finally:
+                _profile.stop(_sampler)
             reply["events"] = events
             if not _send_reply(reply):
                 lost_control = True
@@ -223,6 +250,8 @@ def main(argv=None) -> int:
         if cmd == "run":
             import time as _time
 
+            from dryad_tpu.obs import flight as _flight
+            from dryad_tpu.obs import profile as _profile
             from dryad_tpu.obs import trace as _trace
 
             events: list = []
@@ -230,7 +259,9 @@ def main(argv=None) -> int:
             def _ev(e, _events=events):
                 # emission-time stamp (see run_task): forwarded events
                 # must carry the time they happened, not arrival time
-                _events.append(dict(e, ts=round(_time.time(), 4)))
+                e = dict(e, ts=round(_time.time(), 4))
+                _events.append(e)
+                _flight.record(e)
 
             reply: dict = {"ok": True, "pid": args.process_id,
                            "job": msg.get("job")}
@@ -242,15 +273,20 @@ def main(argv=None) -> int:
                     target=_heartbeat,
                     args=(msg.get("job"), hb_every, hb_stop), daemon=True)
                 hb_thread.start()
+            # trace_ctx presence = the driver's tracing verdict (see
+            # run_task); the resource sampler follows it too
+            _tctx = protocol.extract_trace(msg)
+            _evs = _trace.leveled(_ev, 2 if _tctx is not None else 0)
+            _sampler = _profile.start(
+                _ev if _tctx is not None else None,
+                getattr(msg.get("config"), "resource_sample_s", 0.0)
+                or 0.0,
+                worker_pid=args.process_id, job=msg.get("job"))
             try:
                 from dryad_tpu.runtime.exec_common import execute_plan
                 from dryad_tpu.runtime.shiplan import resolve_fn_table
                 fn_table = resolve_fn_table(msg["plan"], args.fn_module)
                 collect = msg.get("collect", True)
-                # trace_ctx presence = the driver's tracing verdict
-                # (see run_task)
-                _tctx = protocol.extract_trace(msg)
-                _evs = _trace.leveled(_ev, 2 if _tctx is not None else 0)
                 with _trace.tracing(_evs, _tctx):
                     table, extras = execute_plan(
                         msg["plan"], fn_table, msg["sources"], mesh,
@@ -285,7 +321,16 @@ def main(argv=None) -> int:
                          "job": msg.get("job"),
                          "error": traceback.format_exc()}
                 _tag_missing_token(reply, e)
+                try:
+                    protocol.attach_forensics(
+                        reply, _flight.capture_bundle(
+                            msg, e, kind="job",
+                            worker=args.process_id,
+                            fn_modules=args.fn_module, events=events))
+                except Exception:
+                    pass
             finally:
+                _profile.stop(_sampler)
                 hb_stop.set()
                 if hb_thread is not None:
                     hb_thread.join(timeout=5)

@@ -188,6 +188,23 @@ class JobConfig:
     # -- iteration (api do_while) ------------------------------------------
     max_loop_iterations: int = 1000
 
+    # -- observability: forensics / profiling / history (dryad_tpu/obs) ----
+    # background resource sampler period (obs/profile.py): driver and
+    # workers emit periodic resource_sample events (RSS, CPU%, device
+    # buffer bytes, gc counts; level 2) that export as Chrome-trace
+    # counter tracks.  0 disables.  The sampler only runs when an event
+    # consumer exists (same no-consumer-zero-work contract as spans).
+    resource_sample_s: float = 0.5
+    # where task-failure forensics bundles persist (obs/flight.py);
+    # None = a bundles/ dir next to the job's EventLog JSONL, or a temp
+    # dir when the log is memory-only
+    forensics_dir: Optional[str] = None
+    # job history archive (obs/history.py): when set, every job's
+    # EventLog snapshots {events, plan, metrics, bundles} here on close
+    # (the JobBrowser job-history role); browse with
+    # `python -m dryad_tpu.obs history <dir>`
+    history_dir: Optional[str] = None
+
     # -- pre-submit static analysis (dryad_tpu/analysis) -------------------
     # gate every executor/cluster/stream submission through the plan
     # verifier + UDF lint (the reference's phase-1 static validation,
@@ -250,6 +267,7 @@ class JobConfig:
             (self.max_loop_iterations >= 1, "max_loop_iterations >= 1"),
             (self.lint in ("off", "warn", "error"),
              "lint in ('off', 'warn', 'error')"),
+            (self.resource_sample_s >= 0, "resource_sample_s >= 0"),
         ]
         for ok, msg in checks:
             if not ok:

@@ -28,15 +28,18 @@ _LEVELS = {
     # failures / teardown verdicts — visible even at level 0
     "stage_replay": 0, "worker_failed": 0, "job_failed": 0,
     "worker_wedged": 0, "task_timeout": 0, "worker_ping_timeout": 0,
+    "task_forensics": 0,
     # stage/job lifecycle + scheduling decisions
     "stage_done": 1, "plan": 1, "stage_spilled": 1, "stage_restored": 1,
     "task_done": 1, "task_duplicated": 1, "task_reassigned": 1,
     "lint_finding": 1, "settle_replay": 1, "stage_retry": 1,
     "stream_stage_done": 1, "stream_tee_spill": 1, "job_done": 1,
-    # chatter: progress ticks, losing duplicates, locality notes, spans
+    "job_archived": 1, "diagnosis_skew": 1, "diagnosis_slow_worker": 1,
+    # chatter: progress ticks, losing duplicates, locality notes, spans,
+    # periodic resource samples (obs/profile.py)
     "progress": 2, "task_duplicate_ignored": 2,
     "task_duplicate_failed_ignored": 2, "task_locality_dispatch": 2,
-    "span": 2,
+    "span": 2, "resource_sample": 2,
 }
 
 
@@ -53,11 +56,24 @@ class EventLog:
     """
 
     def __init__(self, path: Optional[str] = None,
-                 level: Optional[int] = None):
+                 level: Optional[int] = None,
+                 history_dir: Optional[str] = None,
+                 app: Optional[str] = None):
         import os
+        import threading
+        # background emitters exist now (obs/profile.ResourceSampler):
+        # the append+write pair must be atomic or two threads' JSONL
+        # lines interleave into garbage the tolerant reader then drops
+        self._lock = threading.Lock()
         self.events: List[Dict[str, Any]] = []
+        self.path = path
         self._f = open(path, "a") if path else None
         self.closed = False
+        # job-history archiving (obs/history.py): when set, close()
+        # snapshots {events, plan, metrics, bundles} into history_dir
+        # under the app's name (JobConfig.history_dir wires this)
+        self.history_dir = history_dir
+        self.app = app
         self.level = (level if level is not None
                       else int(os.environ.get("DRYAD_LOGGING_LEVEL", "2")))
 
@@ -66,19 +82,35 @@ class EventLog:
             return
         e = dict(event)
         e.setdefault("ts", round(time.time(), 4))
-        self.events.append(e)
-        # write-after-close guard: a straggler's late losing-duplicate
-        # reply may still emit after the job closed the log — keep the
-        # in-memory record, never touch the closed handle
-        if self._f is not None and not self.closed:
-            self._f.write(json.dumps(e) + "\n")
-            self._f.flush()
+        with self._lock:
+            self.events.append(e)
+            # write-after-close guard: a straggler's late losing-
+            # duplicate reply may still emit after the job closed the
+            # log — keep the in-memory record, never touch the closed
+            # handle
+            if self._f is not None and not self.closed:
+                self._f.write(json.dumps(e) + "\n")
+                self._f.flush()
 
     def close(self):
-        self.closed = True
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        if self.closed:
+            return
+        if self.history_dir:
+            # archive BEFORE closing so the job_archived pointer also
+            # lands in this log's own JSONL; archiving must never turn
+            # a successful job into a failed close
+            try:
+                from dryad_tpu.obs.history import archive_job
+                self({"event": "job_archived",
+                      "path": archive_job(self.history_dir, self.events,
+                                          app=self.app)})
+            except Exception:
+                pass
+        with self._lock:
+            self.closed = True
+            if self._f is not None:
+                self._f.close()
+                self._f = None
         # a closed log must stop being the process span sink, or later
         # jobs' spans would silently pile into this dead in-memory list
         from dryad_tpu.obs import trace

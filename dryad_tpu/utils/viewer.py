@@ -25,7 +25,7 @@ import html
 import json
 from typing import Any, Dict, List, Optional
 
-__all__ = ["job_report_html", "diagnose", "serve_live"]
+__all__ = ["job_report_html", "diagnose", "serve_live", "serve_history"]
 
 # palette roles (light, dark) — single accent series + reserved status hues
 _ROLES = {
@@ -280,10 +280,14 @@ def _stage_details(stages, order, events) -> str:
 
 def diagnose(events) -> List[Dict[str, Any]]:
     """Failure-diagnosis records from the event stream: what failed,
-    where, why, and what the runtime did about it (replay/teardown)."""
+    where, why, and what the runtime did about it (replay/teardown) —
+    plus the Artemis-style sibling-relative findings (data skew, slow
+    workers, obs/profile.diagnose_events) and forensics-bundle
+    breadcrumbs (task_forensics, obs/flight.py)."""
     from dryad_tpu.utils.events import EventLog
     if isinstance(events, EventLog):
         events = events.events
+    events = list(events)
     out: List[Dict[str, Any]] = []
     for e in events:
         k = e.get("event")
@@ -313,6 +317,38 @@ def diagnose(events) -> List[Dict[str, Any]]:
                         "headline": f"stage {e.get('stage')} replayed "
                                     f"(attempt {e.get('attempt', '?')})",
                         "detail": "", "log_tails": ""})
+        elif k == "task_forensics":
+            out.append({
+                "kind": "forensics bundle",
+                "workers": ([e.get("worker")]
+                            if e.get("worker") is not None else None),
+                "headline": f"{e.get('error_type', 'failure')}: "
+                            f"{e.get('error', '')} — reproduce with "
+                            f"python -m dryad_tpu.obs replay "
+                            f"{e.get('path', '<bundle>')}",
+                "detail": "", "log_tails": ""})
+    from dryad_tpu.obs.profile import diagnose_events
+    for e in diagnose_events(events):
+        if e["event"] == "diagnosis_skew":
+            out.append({
+                "kind": "data skew", "workers": None,
+                "stage": e.get("stage"),
+                "headline": f"stage {e.get('stage')} "
+                            f"({e.get('label')}): partition "
+                            f"{e.get('partition')} holds "
+                            f"{e.get('ratio')}x the rows/bytes of its "
+                            f"sibling median ({e.get('rows_max')} vs "
+                            f"{e.get('rows_sibling_median')})",
+                "detail": "", "log_tails": ""})
+        elif e["event"] == "diagnosis_slow_worker":
+            out.append({
+                "kind": "slow worker", "workers": [e.get("worker")],
+                "headline": f"worker {e.get('worker')} averaged "
+                            f"{e.get('mean_s')}s/task over "
+                            f"{e.get('tasks')} task(s) — "
+                            f"{e.get('ratio')}x its siblings' median "
+                            f"({e.get('sibling_median_s')}s)",
+                "detail": "", "log_tails": ""})
     return out
 
 
@@ -407,8 +443,8 @@ def _diagnosis_html(events) -> str:
         if r["log_tails"]:
             body += (f"<details><summary>worker log tails</summary>"
                      f"<pre>{html.escape(r['log_tails'])}</pre></details>")
-        link = (f' <a href="#stage-{r["stage"]}">replay attempt '
-                f'&#8594; stage {r["stage"]}</a>'
+        link = (f' <a href="#stage-{r["stage"]}">&#8594; stage '
+                f'{r["stage"]}</a>'
                 if r.get("stage") is not None else "")
         blocks.append(
             f'<div class="diag"><b>{html.escape(r["kind"])}</b>'
@@ -567,17 +603,62 @@ def serve_live(jsonl_path: str, port: int = 0,
     return srv, srv.server_address[1]
 
 
+def serve_history(history_dir: str, port: int = 0):
+    """Serve the job-history index page (obs/history.py), re-rendered
+    from the directory on every request — the JobBrowser job-list view.
+    Returns (server, port)."""
+    import http.server
+
+    def render() -> bytes:
+        from dryad_tpu.obs.history import history_index, index_html
+        return index_html(history_index(history_dir),
+                          title=history_dir).encode()
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = render()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), H)
+    return srv, srv.server_address[1]
+
+
 def main(argv=None) -> int:
     import argparse
+    import os
 
     ap = argparse.ArgumentParser(
         description="dryad_tpu job viewer: render an EventLog JSONL to "
-                    "HTML, or serve it live")
-    ap.add_argument("events", help="EventLog JSONL path")
+                    "HTML (or a job-history DIRECTORY to its index "
+                    "page), or serve it live")
+    ap.add_argument("events", help="EventLog JSONL path, or a job "
+                                   "history directory "
+                                   "(JobConfig.history_dir)")
     ap.add_argument("-o", "--out", help="write static HTML here")
     ap.add_argument("--serve", type=int, metavar="PORT",
                     help="serve live (re-rendered per refresh)")
     args = ap.parse_args(argv)
+    if os.path.isdir(args.events):
+        # job-history index mode (obs/history.py)
+        if args.serve is not None:
+            srv, port = serve_history(args.events, args.serve)
+            print(f"history index: http://127.0.0.1:{port}/", flush=True)
+            srv.serve_forever()
+            return 0
+        from dryad_tpu.obs.history import history_index, index_html
+        out = args.out or os.path.join(args.events, "index.html")
+        with open(out, "w") as f:
+            f.write(index_html(history_index(args.events),
+                               title=args.events))
+        print(out)
+        return 0
     if args.serve is not None:
         srv, port = serve_live(args.events, args.serve)
         print(f"live viewer: http://127.0.0.1:{port}/", flush=True)

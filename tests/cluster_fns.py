@@ -7,6 +7,18 @@ def double_v(cols):
     return dict(cols, v=cols["v"] * 2)
 
 
+def poison_wide_lines(cols):
+    """Deterministically raises for the partition whose packed string
+    column is wider than 64 bytes (StringColumn.max_len is static, so
+    the raise fires identically at trace time on the worker AND under
+    `python -m dryad_tpu.obs replay` — the forensics-reproduction
+    fixture)."""
+    w = cols["line"].max_len
+    if w > 64:
+        raise ValueError(f"poison partition: line bytes {w} > 64")
+    return cols
+
+
 def keep_positive(cols):
     return cols["v"] > 0
 

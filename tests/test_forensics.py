@@ -1,0 +1,454 @@
+"""Failure forensics + continuous profiling + job history tests
+(dryad_tpu/obs flight/profile/history and their runtime wiring).
+
+Covers: the resource sampler (gating + sample content), skew and
+slow-worker diagnosis (synthetic and from a REAL local run), forensics
+bundle capture/persist/load/replay, the job history archive + index +
+cross-run deltas + BENCH_trend trajectory, every `python -m
+dryad_tpu.obs` subcommand on fixture data (non-zero exit on malformed
+input), and the E2E acceptance run: a wordcount with a UDF that raises
+on one partition over a real LocalCluster produces a persisted bundle,
+`obs replay` reproduces the exception locally, resource samples from
+both workers export as Chrome counter tracks, and the history index
+lists the failed job."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import cluster_fns  # noqa: E402
+
+from dryad_tpu.api.dataset import Context  # noqa: E402
+from dryad_tpu.obs import flight, history, profile, trace  # noqa: E402
+from dryad_tpu.obs.__main__ import main as obs_main  # noqa: E402
+from dryad_tpu.obs.chrome import chrome_trace  # noqa: E402
+from dryad_tpu.plan.planner import plan_query  # noqa: E402
+from dryad_tpu.runtime.shiplan import serialize_for_cluster  # noqa: E402
+from dryad_tpu.runtime.sources import columns_spec  # noqa: E402
+from dryad_tpu.utils.config import JobConfig  # noqa: E402
+from dryad_tpu.utils.events import EventLog  # noqa: E402
+from dryad_tpu.utils.viewer import diagnose  # noqa: E402
+
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TESTS)
+
+
+@pytest.fixture(autouse=True)
+def _detach_tracer():
+    yield
+    trace.install(None)
+
+
+# -- resource sampler --------------------------------------------------------
+
+def test_resource_sampler_emits_and_gates():
+    log = EventLog()
+    s = profile.start(log, 0.05, worker_pid=3)
+    time.sleep(0.15)
+    profile.stop(s)
+    samples = log.of_type("resource_sample")
+    assert len(samples) >= 3          # immediate + periodic + final
+    last = samples[-1]
+    assert last["worker_pid"] == 3
+    assert last.get("rss_bytes", 0) > 0
+    assert "gc_counts" in last and len(last["gc_counts"]) == 3
+    # CPU% needs a previous sample; present from the second one on
+    assert any("cpu_pct" in e for e in samples[1:])
+    # no leaked private state
+    assert all("_cpu_state" not in e for e in samples)
+    # gating: no sink, zero interval, or a level<2 sink -> no sampler
+    assert profile.start(None, 0.05) is None
+    assert profile.start(log, 0.0) is None
+    assert profile.start(EventLog(level=0), 0.05) is None
+    profile.stop(None)                # None-safe
+
+
+def test_chrome_trace_counter_tracks():
+    events = [
+        {"event": "resource_sample", "ts": 1000.0, "rss_bytes": 1 << 20,
+         "device_bytes": 2 << 20, "cpu_pct": 50.0, "worker": 0},
+        {"event": "resource_sample", "ts": 1000.5, "rss_bytes": 2 << 20,
+         "worker": 1},
+        {"event": "resource_sample", "ts": 1000.2, "rss_bytes": 3 << 20},
+    ]
+    doc = chrome_trace(events)
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert {e["pid"] for e in cs} == {0, 1, 2}   # driver + 2 workers
+    mem = next(e for e in cs if e["pid"] == 1 and e["name"] == "memory")
+    assert mem["args"] == {"rss_mb": 1.0, "device_mb": 2.0}
+    cpu = [e for e in cs if e["name"] == "cpu"]
+    assert len(cpu) == 1 and cpu[0]["args"]["cpu_pct"] == 50.0
+    # counter pids are named processes too
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["pid"] for m in metas} == {0, 1, 2}
+
+
+# -- skew / slow-worker diagnosis --------------------------------------------
+
+def test_diagnose_events_skew_and_slow_worker():
+    events = [
+        {"event": "stage_done", "stage": 0, "label": "grp",
+         "rows": [10, 10, 10, 80]},
+        {"event": "stage_done", "stage": 1, "label": "even",
+         "rows": [10, 10, 10, 11]},          # not skewed
+        {"event": "task_done", "task": 0, "worker": 1, "wall_s": 1.0},
+        {"event": "task_done", "task": 1, "worker": 1, "wall_s": 1.2},
+        {"event": "task_done", "task": 2, "worker": 2, "wall_s": 0.2},
+        {"event": "task_done", "task": 3, "worker": 2, "wall_s": 0.3},
+    ]
+    recs = profile.diagnose_events(events)
+    kinds = [r["event"] for r in recs]
+    assert kinds == ["diagnosis_skew", "diagnosis_slow_worker"]
+    skew = recs[0]
+    assert skew["stage"] == 0 and skew["partition"] == 3
+    assert skew["ratio"] >= 4.0
+    slow = recs[1]
+    assert slow["worker"] == 1 and slow["ratio"] >= 2.0
+    # the viewer renders both finding kinds
+    vrecs = diagnose(events)
+    vkinds = [r["kind"] for r in vrecs]
+    assert "data skew" in vkinds and "slow worker" in vkinds
+
+
+def test_diagnose_flags_real_skewed_partition():
+    """Acceptance: an artificially skewed partition (>=4x its siblings'
+    rows/bytes) in a REAL local run is flagged as a skew finding."""
+    log = EventLog()
+    ctx = Context(event_log=log)
+    P = ctx.nparts
+    per = 64
+    v = np.arange(per * P, dtype=np.int32)
+    # block partitioning: partition 0 holds v in [0, 64) — keep ALL of
+    # it, and every 8th row elsewhere -> rows per partition [64, 8, ...]
+    q = ctx.from_columns({"v": v}).where(
+        lambda c: (c["v"] < per) | (c["v"] % 8 == 0))
+    out = q.collect()
+    assert len(out["v"]) == per + (P - 1) * (per // 8)
+    skews = [r for r in diagnose(log.events) if r["kind"] == "data skew"]
+    assert skews, "skewed partition was not flagged"
+    assert "partition 0" in skews[0]["headline"]
+
+
+# -- forensics bundles -------------------------------------------------------
+
+def _tiny_bundle(exc=None):
+    """A real, replayable bundle from an in-process plan (no cluster):
+    the same envelope shape the worker captures."""
+
+    class _FakeCluster:
+        def __init__(self, nparts):
+            self.nparts = nparts
+            self.n_processes = 1
+
+    import jax
+    n = len(jax.devices())
+    ctx = Context(cluster=_FakeCluster(n))
+    q = ctx.from_columns(
+        {"v": np.arange(4 * n, dtype=np.int32)}).select(
+        cluster_fns.double_v)
+    graph = plan_query(q.node, n, hosts=1)
+    plan_json, specs = serialize_for_cluster(graph, ctx.fn_table)
+    msg = {"plan": plan_json, "sources": specs, "task": 0, "job": 1,
+           "config": None}
+    return flight.capture_bundle(
+        msg, exc or ValueError("fixture"), kind="task", worker=0)
+
+
+def test_bundle_roundtrip_and_replay_success(tmp_path):
+    bundle = _tiny_bundle()
+    assert bundle["error"]["type"] == "ValueError"
+    assert bundle["source_digests"]       # every source digested
+    path = flight.persist_bundle(bundle, str(tmp_path / "b"))
+    loaded = flight.load_bundle(path)
+    assert loaded["plan"] == bundle["plan"]
+    assert loaded["source_digests"] == bundle["source_digests"]
+    # the fixture's task is healthy: replay completes and returns data
+    pd = flight.replay_bundle(loaded)
+    assert pd is not None
+
+
+def test_flight_ring_is_bounded():
+    for i in range(flight._RING_CAP + 50):
+        flight.record({"event": "progress", "i": i})
+    ring = flight.ring_events()
+    assert len(ring) == flight._RING_CAP
+    assert ring[-1]["i"] == flight._RING_CAP + 49
+
+
+def test_load_bundle_rejects_non_bundles(tmp_path):
+    p = str(tmp_path / "junk")
+    with open(p, "wb") as f:
+        f.write(b"\x00\x01 not a pickle")
+    with pytest.raises(Exception):
+        flight.load_bundle(p)
+    import pickle
+    p2 = str(tmp_path / "notbundle")
+    with open(p2, "wb") as f:
+        pickle.dump({"some": "dict"}, f)
+    with pytest.raises(flight.BundleError):
+        flight.load_bundle(p2)
+
+
+# -- job history -------------------------------------------------------------
+
+def _fake_run_events(wall=1.0, fail=False, bundle_path=None):
+    now = time.time()
+    ev = [
+        {"event": "stage_done", "stage": 0, "label": "wc",
+         "wall_s": wall, "compile_s": 0.2, "ts": now},
+        {"event": "span", "kind": "io", "name": "http.get",
+         "dur_s": 0.05, "ts": now},
+        {"event": "job_done", "wall_s": wall, "ts": now + wall},
+    ]
+    if fail:
+        ev.append({"event": "task_forensics", "task": 3, "worker": 1,
+                   "path": bundle_path or "/nope",
+                   "error_type": "ValueError", "error": "poison",
+                   "ts": now + wall})
+    return ev
+
+
+def test_history_archive_index_and_deltas(tmp_path):
+    hist = str(tmp_path / "hist")
+    d1 = history.archive_job(hist, _fake_run_events(wall=1.0),
+                             app="wc")
+    time.sleep(0.002)   # distinct archive-dir timestamps
+    d2 = history.archive_job(hist, _fake_run_events(wall=2.0),
+                             app="wc")
+    history.archive_job(hist, _fake_run_events(wall=5.0), app="sort")
+    assert os.path.isfile(os.path.join(d1, "events.jsonl"))
+    assert os.path.isfile(os.path.join(d2, "summary.json"))
+    entries = history.history_index(hist)
+    assert len(entries) == 3
+    wc = [e for e in entries if e["app"] == "wc"]
+    assert wc[0]["d_wall_pct"] is None           # first run: no delta
+    assert wc[1]["d_wall_pct"] == pytest.approx(100.0, abs=5.0)
+    srt = next(e for e in entries if e["app"] == "sort")
+    assert srt["d_wall_pct"] is None             # other app unaffected
+    txt = history.render_history_text(entries)
+    assert "wc" in txt and "sort" in txt and "Δwall%" in txt
+    html = history.index_html(entries)
+    assert "wc" in html and "+100" in html
+    # archived stream carries the job_archived pointer
+    with open(os.path.join(d1, "events.jsonl")) as f:
+        kinds = [json.loads(line)["event"] for line in f]
+    assert "job_archived" in kinds
+
+
+def test_history_folds_bench_trend(tmp_path):
+    hist = str(tmp_path / "hist")
+    os.makedirs(hist)
+    with open(os.path.join(hist, "BENCH_trend.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": 100.0, "app": "bench-smoke",
+                            "wall_s": 1.0, "compile_s": 0.5,
+                            "run_s": 0.1, "io_s": 0.0}) + "\n")
+        f.write(json.dumps({"ts": 200.0, "app": "bench-smoke",
+                            "wall_s": 1.5, "compile_s": 0.5,
+                            "run_s": 0.1, "io_s": 0.0}) + "\n")
+    entries = history.history_index(hist)
+    assert len(entries) == 2
+    assert entries[1]["d_wall_pct"] == pytest.approx(50.0, abs=1.0)
+
+
+def test_eventlog_archives_on_close(tmp_path):
+    hist = str(tmp_path / "hist")
+    with EventLog(str(tmp_path / "ev.jsonl"), history_dir=hist,
+                  app="myapp") as log:
+        for e in _fake_run_events():
+            log(e)
+    entries = history.history_index(hist)
+    assert len(entries) == 1 and entries[0]["app"] == "myapp"
+    # the live JSONL got the job_archived pointer too
+    assert log.of_type("job_archived")
+
+
+def test_context_wires_history_dir_from_config(tmp_path):
+    hist = str(tmp_path / "hist")
+    log = EventLog()
+    Context(event_log=log, config=JobConfig(history_dir=hist))
+    assert log.history_dir == hist
+    explicit = EventLog(history_dir=str(tmp_path / "other"))
+    Context(event_log=explicit, config=JobConfig(history_dir=hist))
+    assert explicit.history_dir == str(tmp_path / "other")
+
+
+# -- CLI smoke: every subcommand, malformed input -> non-zero exit -----------
+
+def test_obs_cli_all_subcommands_and_malformed_input(tmp_path, capsys):
+    # fixture events
+    p = str(tmp_path / "ev.jsonl")
+    with EventLog(p) as log:
+        trace.install(log)
+        with trace.span("job 1", "job"):
+            time.sleep(0.005)
+        trace.install(None)
+        log({"event": "task_done", "task": 0, "worker": 0,
+             "wall_s": 0.1})
+        log({"event": "resource_sample", "rss_bytes": 1 << 20,
+             "worker": 0})
+    out = str(tmp_path / "trace.json")
+    assert obs_main(["trace", p, "-o", out]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert any(e["ph"] == "C" for e in doc["traceEvents"])
+    assert obs_main(["critical-path", p]) == 0
+    assert "critical path" in capsys.readouterr().out
+    assert obs_main(["metrics", p]) == 0
+    assert "dryad_farm_tasks_total 1" in capsys.readouterr().out
+    # replay: a healthy fixture bundle completes -> exit 0
+    bundle = _tiny_bundle()
+    bundle["error"] = {}
+    bp = flight.persist_bundle(bundle, str(tmp_path / "b"))
+    assert obs_main(["replay", bp]) == 0
+    assert "without error" in capsys.readouterr().out
+    # history: archived fixture -> exit 0 + html page
+    hist = str(tmp_path / "hist")
+    history.archive_job(hist, _fake_run_events(), app="fix")
+    page = str(tmp_path / "index.html")
+    assert obs_main(["history", hist, "--html", page]) == 0
+    assert "fix" in capsys.readouterr().out
+    assert os.path.isfile(page)
+
+    # malformed inputs: every subcommand exits non-zero
+    garbage = str(tmp_path / "garbage.jsonl")
+    with open(garbage, "wb") as f:
+        f.write(b"\x00\x01not json at all")
+    missing = str(tmp_path / "nope.jsonl")
+    assert obs_main(["trace", garbage]) != 0
+    assert obs_main(["trace", missing]) != 0
+    assert obs_main(["critical-path", garbage]) != 0
+    assert obs_main(["metrics", missing]) != 0
+    assert obs_main(["replay", garbage]) != 0
+    assert obs_main(["history", str(tmp_path / "nodir")]) != 0
+    capsys.readouterr()
+
+
+def test_viewer_renders_history_directory(tmp_path, capsys):
+    from dryad_tpu.utils.viewer import main as viewer_main
+    hist = str(tmp_path / "hist")
+    history.archive_job(hist, _fake_run_events(fail=True), app="wc")
+    assert viewer_main([hist]) == 0
+    out = capsys.readouterr().out.strip()
+    with open(out) as f:
+        doc = f.read()
+    assert "wc" in doc and "failed" in doc
+
+
+# -- E2E acceptance: poison task -> bundle -> replay -> history --------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    from dryad_tpu.runtime import LocalCluster
+    old = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = _TESTS + os.pathsep + (old or "")
+    cl = LocalCluster(n_processes=2, devices_per_process=2)
+    yield cl
+    cl.shutdown()
+    if old is None:
+        os.environ.pop("PYTHONPATH", None)
+    else:
+        os.environ["PYTHONPATH"] = old
+
+
+def test_e2e_forensics_bundle_replay_and_history(tmp_path, cluster):
+    """The acceptance run: a farm wordcount whose UDF raises on ONE
+    partition (the wide-string task) over a real LocalCluster.  The
+    failure persists a forensics bundle; `python -m dryad_tpu.obs
+    replay` (real subprocess) reproduces the same exception type and
+    message locally; resource samples from both workers export as
+    Chrome counter tracks; the history index lists the failed job."""
+    from dryad_tpu.apps.wordcount import wordcount_query
+    from dryad_tpu.runtime.farm import FarmError, TaskFarm
+
+    cl = cluster
+    jsonl = str(tmp_path / "events.jsonl")
+    hist = str(tmp_path / "history")
+    bundles = str(tmp_path / "bundles")
+    cfg = JobConfig(resource_sample_s=0.1, forensics_dir=bundles,
+                    history_dir=hist)
+    err_msg = None
+    with EventLog(jsonl, app="wc-poison") as log:
+        cl.event_log = log
+        ctx = Context(cluster=cl, event_log=log, config=cfg)
+        ds = ctx.from_columns({"line": ["seed"]}, str_max_len=64)
+        q = wordcount_query(ds.select(cluster_fns.poison_wide_lines),
+                            tokens_per_partition=4096)
+        graph = plan_query(q.node, cl.devices_per_process, hosts=1)
+        plan_json, specs = serialize_for_cluster(graph, ctx.fn_table)
+        (src_key,) = specs.keys()
+        lines = ["alpha beta gamma", "alpha alpha", "beta gamma",
+                 "gamma gamma gamma"]
+        good = [{src_key: columns_spec({"line": [ln]}, 2,
+                                       str_max_len=64)}
+                for ln in lines]
+        farm = TaskFarm(cl, min_samples=10**9, config=cfg)
+        # phase 1: a healthy run — resource samples from BOTH workers
+        out = farm.run(plan_json, good)
+        assert len(out) == len(lines)
+        # phase 2: same plan, one POISON task (wider string column)
+        poison = dict(good[0])
+        poison[src_key] = columns_spec({"line": [lines[0]]}, 2,
+                                       str_max_len=128)
+        with pytest.raises(FarmError) as ei:
+            farm.run(plan_json, good[:3] + [poison])
+        err_msg = str(ei.value)
+        cl.event_log = None
+    assert "poison partition: line bytes 128 > 64" in err_msg
+    assert "forensics bundle: " in err_msg
+    assert "python -m dryad_tpu.obs replay" in err_msg
+
+    # the bundle was persisted where the config pointed
+    bundle_files = sorted(os.listdir(bundles))
+    assert len(bundle_files) == 1
+    bpath = os.path.join(bundles, bundle_files[0])
+    bundle = flight.load_bundle(bpath)
+    assert bundle["error"]["type"] == "ValueError"
+    assert "poison partition" in bundle["error"]["message"]
+    assert bundle["n_devices"] == 2
+    assert bundle["events"], "flight ring shipped empty"
+
+    events = [json.loads(line) for line in open(jsonl)]
+    # the task_forensics breadcrumb points at the bundle
+    tf = [e for e in events if e.get("event") == "task_forensics"]
+    assert tf and tf[0]["path"] == bpath
+    # resource samples from >=2 worker processes -> counter tracks
+    workers = {e.get("worker") for e in events
+               if e.get("event") == "resource_sample"
+               and e.get("worker") is not None}
+    assert len(workers) >= 2, f"samples only from workers {workers}"
+    doc = chrome_trace(events)
+    counter_pids = {e["pid"] for e in doc["traceEvents"]
+                    if e["ph"] == "C"}
+    assert len(counter_pids - {0}) >= 2
+    # the viewer diagnosis names the bundle
+    recs = diagnose(events)
+    assert any(r["kind"] == "forensics bundle" for r in recs)
+
+    # REPLAY (real subprocess, fresh jax): same exception type+message
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + _TESTS + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)   # the CLI sizes the device count itself
+    p = subprocess.run(
+        [sys.executable, "-m", "dryad_tpu.obs", "replay", bpath],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "REPRODUCED" in p.stdout
+    assert "ValueError: poison partition: line bytes 128 > 64" \
+        in p.stdout
+
+    # HISTORY: the job archived on log close and lists as failed
+    entries = history.history_index(hist)
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["app"] == "wc-poison" and e["status"] == "failed"
+    assert "poison" in (e.get("failure") or "")
+    assert e["bundles"], "bundle was not archived with the job"
+    page = history.index_html(entries)
+    assert "wc-poison" in page and "failed" in page
