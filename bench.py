@@ -246,6 +246,94 @@ def smoke(out_path="BENCH_obs.json", n_lines=None, reps=None):
     return out
 
 
+def smoke_adapt(out_path="BENCH_adapt.json", n_rows=None, reps=None,
+                quiet=False):
+    """Adaptive-execution smoke (``python bench.py --smoke`` /
+    ``--smoke-adapt``): a SKEWED SHUFFLE — a 90%-hot-key group_by whose
+    ~1k-row output carries a conservative static capacity bound
+    (``with_capacity``, the DTA010-recommended pattern for unknown
+    fan-outs) into a global sort, so the downstream range exchange +
+    sort run over the full padded envelope unless adaptation right-sizes
+    them from the MEASURED rows — run adapt-on vs adapt-off,
+    INTERLEAVED >=3 reps, median walls (the PR-4 protocol: both sides
+    get the same scheduler weather).  The adaptive run must record a
+    ``graph_rewrite`` and produce identical output rows; the wall delta
+    is the value of right-sizing the downstream exchange from observed
+    stats (adapt/rules.SkewRepartition).  Written to
+    ``BENCH_adapt.json`` and appended to ``BENCH_trend.jsonl`` (app
+    ``bench-adapt``)."""
+    import statistics
+
+    from dryad_tpu import Context
+    from dryad_tpu.utils.config import JobConfig
+
+    n_rows = n_rows or int(os.environ.get("BENCH_ADAPT_ROWS", "50000"))
+    reps = max(3, reps or int(os.environ.get("BENCH_ADAPT_REPS", "5")))
+    rng = np.random.RandomState(0)
+    # 90% of rows on one key, the rest over 1k cold keys: the group
+    # output is ~1k rows; the declared downstream bound is 131072
+    k = np.where(rng.rand(n_rows) < 0.9, 0,
+                 rng.randint(1, 1000, n_rows)).astype(np.int32)
+    v = rng.randint(0, 10, n_rows).astype(np.int32)
+
+    def make(adaptive, events):
+        ctx = Context(event_log=events.append,
+                      config=JobConfig(adaptive=adaptive))
+        return (ctx.from_columns({"k": k, "v": v})
+                .group_by(["k"], {"s": ("sum", "v")})
+                .with_capacity(1 << 17)
+                .order_by([("s", False)]))
+
+    ev_on, ev_off = [], []
+    q_on, q_off = make("on", ev_on), make("off", ev_off)
+    out_on, out_off = q_on.collect(), q_off.collect()   # warmup+verify
+    # rewrite count for ONE run (the warmup): later reps replan and
+    # re-fire the same rewrites, which would inflate the figure reps-fold
+    rewrites = [e for e in ev_on if e.get("event") == "graph_rewrite"]
+    rows_identical = (
+        sorted(zip(out_on["k"].tolist(), out_on["s"].tolist()))
+        == sorted(zip(out_off["k"].tolist(), out_off["s"].tolist())))
+    walls_on, walls_off = [], []
+    for _ in range(reps):
+        t0 = time.time()
+        q_off.collect()
+        walls_off.append(time.time() - t0)
+        t0 = time.time()
+        q_on.collect()
+        walls_on.append(time.time() - t0)
+    on_s = statistics.median(walls_on)
+    off_s = statistics.median(walls_off)
+    out = {
+        "metric": "adapt smoke (skewed shuffle, adapt-on vs adapt-off)",
+        "rows": n_rows,
+        "reps": reps,
+        "wall_s_adapt_on": round(on_s, 4),
+        "wall_s_adapt_off": round(off_s, 4),
+        "wall_s_adapt_on_all": [round(w, 4) for w in walls_on],
+        "wall_s_adapt_off_all": [round(w, 4) for w in walls_off],
+        "speedup_pct": (round(100.0 * (off_s - on_s) / off_s, 1)
+                        if off_s > 0 else None),
+        "graph_rewrites": len(rewrites),
+        "rewrite_kinds": sorted({e.get("kind", "?") for e in rewrites}),
+        "rows_identical": rows_identical,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-adapt",
+            "wall_s": round(on_s, 4),
+            "adapt_off_wall_s": round(off_s, 4),
+            "speedup_pct": out["speedup_pct"],
+            "graph_rewrites": len(rewrites), "rows": n_rows,
+            "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def main():
     import jax
 
@@ -809,8 +897,19 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--smoke" in sys.argv:
+    if "--smoke-adapt" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-adapt"]
+        smoke_adapt(out_path=args[0] if args else "BENCH_adapt.json")
+    elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
-        smoke(out_path=args[0] if args else "BENCH_obs.json")
+        obs_out = args[0] if args else "BENCH_obs.json"
+        smoke(out_path=obs_out)
+        # the adapt case rides --smoke: output lands NEXT TO the
+        # requested obs path (an explicit path keeps the cwd clean) and
+        # stdout stays ONE JSON document — existing json.loads(stdout)
+        # consumers of --smoke keep working
+        smoke_adapt(out_path=os.path.join(
+            os.path.dirname(os.path.abspath(obs_out)),
+            "BENCH_adapt.json"), quiet=True)
     else:
         main()

@@ -1,0 +1,31 @@
+"""Adaptive query execution: stage-boundary graph rewriting.
+
+The reference's defining runtime capability — graph-rewriting
+"connection managers" that restructure the DAG mid-job from observed
+data sizes (``DrDynamicAggregateManager`` machine->pod->overall trees,
+``DrDynamicDistributionManager``, ``DrDynamicBroadcastManager``; Dryad
+EuroSys'07 §5.2, DryadLINQ OSDI'08 §4.3) — as a subsystem over the
+StageGraph executor:
+
+* ``adapt/thresholds.py`` — the shared skew constants (diagnosis and
+  action single-sourced);
+* ``adapt/stats.py`` — observed per-stage stats (rows/bytes/capacity);
+* ``adapt/rewrite.py`` — the unexecuted-suffix mutation window with
+  stable stage-id remapping;
+* ``adapt/rules.py`` — the three connection-manager rules behind the
+  ``ConnectionManager`` plug-in interface;
+* ``adapt/manager.py`` — the boundary driver ``exec/recovery.Run``
+  invokes after each synchronous stage materialization.
+
+Enabled by ``JobConfig(adaptive="on")``; off (the default) constructs
+nothing and leaves plans byte-identical.
+
+This ``__init__`` stays import-light on purpose: ``utils/config.py``
+and ``obs/profile.py`` import ``adapt.thresholds`` at module load, so
+pulling the rule machinery in here would create an import cycle.
+"""
+
+from dryad_tpu.adapt.thresholds import (SKEW_SIBLING_MEDIAN_FACTOR,
+                                        sibling_median, skew_ratio)
+
+__all__ = ["SKEW_SIBLING_MEDIAN_FACTOR", "sibling_median", "skew_ratio"]

@@ -1,0 +1,112 @@
+"""Adaptive execution manager: the stage-boundary rewrite driver.
+
+``exec/recovery.Run`` calls :meth:`AdaptiveManager.on_stage_materialized`
+after every synchronous stage completion (the one host-sync point where
+measured per-partition rows exist); the manager records the
+:class:`~dryad_tpu.adapt.stats.StageStats`, opens a
+:class:`~dryad_tpu.adapt.rewrite.PlanRewriter` window over the
+unexecuted suffix, and runs the registered
+:class:`~dryad_tpu.adapt.rules.ConnectionManager` rules — the
+counterpart of the reference GM dispatching
+``NotifyUpstreamVertexCompleted`` to each stage's attached
+DrConnectionManager.
+
+Contract:
+
+* ``JobConfig.adaptive == "off"`` means this object is never
+  constructed — zero plan mutation, byte-identical serialized plans,
+  and the deferred-needs fast path stays on (adaptation requires the
+  per-stage stats sync, so ``"on"`` trades the O(1)-round-trip
+  optimization for observability — exactly the reference's
+  stage-boundary barrier cost).
+* A rule failure must never fail the job: rules raising (including
+  :class:`~dryad_tpu.adapt.rewrite.RewriteError` guard trips) are
+  reported as ``adapt_skipped`` events and the plan proceeds
+  un-rewritten.
+* Determinism across a gang: rules are pure functions of
+  (graph, stats, config, topology); stats arrive replicated on
+  multi-process meshes, so every worker rewrites identically — the
+  mirrored-execution contract of ``runtime/exec_common.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from dryad_tpu.adapt.rewrite import PlanRewriter, RewriteError
+from dryad_tpu.adapt.rules import RuleContext, default_rules
+from dryad_tpu.adapt.stats import StageStats
+from dryad_tpu.plan.stages import StageGraph
+
+__all__ = ["AdaptiveManager", "levels_of_mesh"]
+
+
+def levels_of_mesh(mesh) -> tuple:
+    """Mesh -> ((axis, size), ...) INNERMOST FIRST — the planner's
+    ``levels`` orientation.  On a worker gang the outermost axis is the
+    process boundary (dcn), so topology-aware rules see the host
+    structure the driver-side ``cluster.worker_hosts()`` exposes."""
+    if mesh is None:
+        return ()
+    names = tuple(mesh.axis_names)
+    shape = tuple(mesh.devices.shape)
+    return tuple(zip(reversed(names), reversed(shape)))
+
+
+class AdaptiveManager:
+    """One per :class:`~dryad_tpu.exec.recovery.Run` when
+    ``JobConfig.adaptive == "on"``."""
+
+    def __init__(self, graph: StageGraph, config, nparts: int,
+                 levels: tuple = (),
+                 event: Optional[Callable[[dict], None]] = None,
+                 rules=None):
+        self.graph = graph
+        self.config = config
+        self.nparts = nparts
+        self.levels = tuple(levels)
+        self._event = event or (lambda e: None)
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.stats: Dict[int, StageStats] = {}
+        self.applied: List[dict] = []   # graph_rewrite payloads, in order
+
+    @property
+    def rewrite_count(self) -> int:
+        return len(self.applied)
+
+    def on_stage_materialized(self, st: StageStats,
+                              executed: Set[int]) -> None:
+        """The boundary hook.  ``executed`` is the set of stage ids with
+        materialized results (including ``st.stage``)."""
+        import time as _time
+        self.stats[st.stage] = st
+
+        def emit(e: dict) -> None:
+            # stamp emission time here: bare-callable sinks (a list
+            # append) don't, and the Chrome exporter draws rewrites as
+            # instants at their timestamp
+            e.setdefault("ts", round(_time.time(), 4))
+            self._event(e)
+
+        emit(st.event())
+        rw = PlanRewriter(self.graph, executed)
+        ctx = RuleContext(rw=rw, stats=self.stats, config=self.config,
+                          nparts=self.nparts, levels=self.levels)
+        from dryad_tpu.obs.metrics import REGISTRY, family_counter
+        for rule in self.rules:
+            try:
+                events = rule.on_stage_done(ctx, st)
+            except RewriteError as e:
+                events = [{"event": "adapt_skipped", "rule": rule.name,
+                           "stage": st.stage, "reason": str(e)}]
+            except Exception as e:   # a rule bug must not fail the job
+                events = [{"event": "adapt_skipped", "rule": rule.name,
+                           "stage": st.stage,
+                           "reason": f"rule error: {e!r}"}]
+            for ev in events:
+                emit(ev)
+                if ev.get("event") == "graph_rewrite":
+                    self.applied.append(ev)
+                    family_counter(REGISTRY, "graph_rewrites",
+                                   rule=ev.get("rule", "?"),
+                                   kind=ev.get("kind", "?")).inc()

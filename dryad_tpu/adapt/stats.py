@@ -1,0 +1,70 @@
+"""Observed per-stage statistics driving adaptive rewrites.
+
+The executor already measures per-partition output row counts (the
+``info`` vector it fetches once per stage) and output bytes on every
+synchronous stage completion; :class:`StageStats` is that measurement as
+a value object the connection managers consume.  This is the counterpart
+of the reference's vertex-completion size reports that
+``DrConnectionManager`` subclasses receive
+(``NotifyUpstreamVertexCompleted``): observed sizes, not estimates.
+
+Mirrored determinism: on a multi-process gang the rows arrive replicated
+(``exec/data.replicate_tree``), so every worker constructs the identical
+StageStats and therefore applies the identical rewrites — the same
+contract runtime salting already relies on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from dryad_tpu.adapt.thresholds import sibling_median, skew_ratio
+
+__all__ = ["StageStats"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StageStats:
+    """One materialized stage's observed output.
+
+    ``rows`` is per-partition valid row counts; ``capacity`` the static
+    per-partition batch capacity the output was materialized at (the
+    padding envelope downstream exchanges inherit); ``out_bytes`` the
+    device bytes of the materialized output.  A key sketch (per-key
+    heavy-hitter evidence) can ride in a future field — rules must treat
+    absent evidence as "unknown", never as "balanced"."""
+
+    stage: int
+    rows: Tuple[int, ...]
+    capacity: int = 0
+    out_bytes: int = 0
+    wall_s: float = 0.0
+
+    @property
+    def total_rows(self) -> int:
+        return int(sum(self.rows))
+
+    @property
+    def peak_rows(self) -> int:
+        return int(max(self.rows)) if self.rows else 0
+
+    @property
+    def sibling_median(self) -> int:
+        return sibling_median(self.rows)
+
+    @property
+    def skew_ratio(self) -> float:
+        return skew_ratio(self.rows)
+
+    def is_skewed(self, factor: float) -> bool:
+        """Same predicate as ``obs/profile.diagnose_events``: peak >=
+        factor x sibling median, with the same tiny-partition guard."""
+        return self.peak_rows >= 2 and self.skew_ratio >= factor
+
+    def event(self) -> dict:
+        """The ``adapt_stats`` event payload (level 2)."""
+        return {"event": "adapt_stats", "stage": self.stage,
+                "rows": list(self.rows), "capacity": self.capacity,
+                "out_bytes": self.out_bytes,
+                "skew_ratio": round(self.skew_ratio, 2)}

@@ -1,0 +1,41 @@
+"""Shared adaptivity thresholds — single-sourced so detection and action
+cannot drift.
+
+``obs/profile.diagnose_events`` FLAGS data skew (a partition holding
+>= factor x its sibling median) and ``adapt/rules.SkewRepartition`` ACTS
+on the same condition; both import :data:`SKEW_SIBLING_MEDIAN_FACTOR`
+from here.  A diagnosis the runtime would not act on — or an action the
+diagnosis would not explain — is a bug class this module removes.
+
+Dependency-free by design: ``utils/config.py`` (JobConfig defaults) and
+``obs/profile.py`` both import it, so it must sit below everything.
+"""
+
+from __future__ import annotations
+
+__all__ = ["SKEW_SIBLING_MEDIAN_FACTOR", "sibling_median", "skew_ratio"]
+
+# a partition is SKEWED when it holds at least this multiple of the
+# median of its sibling partitions' row counts (reference: the
+# DrDynamicDistributionManager splits a part when it exceeds its
+# per-bucket target the same relative way)
+SKEW_SIBLING_MEDIAN_FACTOR = 4.0
+
+
+def sibling_median(rows) -> int:
+    """Median of ``rows`` EXCLUDING the peak entry — the denominator of
+    the skew ratio used by both diagnosis and the adapt rules."""
+    rows = [int(r) for r in rows]
+    if len(rows) < 2:
+        return rows[0] if rows else 0
+    peak_i = rows.index(max(rows))
+    sib = sorted(r for i, r in enumerate(rows) if i != peak_i)
+    return sib[len(sib) // 2]
+
+
+def skew_ratio(rows) -> float:
+    """peak / sibling-median (>= 1.0); 1.0 for degenerate inputs."""
+    rows = [int(r) for r in rows]
+    if len(rows) < 2:
+        return 1.0
+    return max(rows) / max(sibling_median(rows), 1)

@@ -44,6 +44,8 @@ CODES = {
     "DTA014": "UDF is not cluster-shippable (lambda/closure)",
     "DTA015": "source is not cluster-shippable (non-deferred)",
     "DTA016": "op param is not serializable for cluster execution",
+    "DTA017": "pinned partitioning (assume_*/explicit repartition) "
+              "blocks adaptive repartitioning of an elided consumer",
     # -- UDF lint (DTA1xx) -------------------------------------------------
     "DTA101": "nondeterministic call in UDF (time/random/uuid/urandom)",
     "DTA102": "object-identity dependence in UDF (id()/salted hash())",

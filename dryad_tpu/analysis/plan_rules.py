@@ -271,6 +271,89 @@ def _rule_unsound_assume(c: PlanCheck) -> List[Diagnostic]:
     return out
 
 
+_PINNING_NODES = (E.AssumePartitioning, E.HashRepartition,
+                  E.RangeRepartition)
+
+
+def _pinning_ancestor(n: E.Node, claim) -> Optional[E.Node]:
+    """Walk the primary-parent chain while it carries ``claim``
+    unchanged; return the assume_*/repartition node the claim
+    originates from, or None when it arose naturally (e.g. a group_by
+    output's placement)."""
+    cur = n
+    for _ in range(10_000):           # cycle guard (DAGs only, but cheap)
+        if isinstance(cur, _PINNING_NODES):
+            return cur
+        if not cur.parents:
+            return None
+        nxt = cur.parents[0]
+        if nxt.partitioning != claim:
+            return None
+        cur = nxt
+    return None
+
+
+def _rule_pinned_partitioning(c: PlanCheck) -> List[Diagnostic]:
+    """DTA017: an assume_*/explicit repartition pins the placement a
+    downstream consumer's exchange elimination trusts — the planner
+    emits no exchange there and marks the chain placement-dependent, so
+    adaptive execution (JobConfig.adaptive) has nothing left to
+    repartition, salt, or right-size if that consumer skews.  The span
+    points at the PINNING op (the thing to relax), not the consumer."""
+    out = []
+    for n in c.nodes:
+        # (parent, the claim whose match makes the planner elide that
+        # consumer's exchange) — hash claims for the co-location family,
+        # range claims for ascending prefix sorts (planner.py OrderBy)
+        sides: List[Tuple[E.Node, E.Partitioning]] = []
+        if isinstance(n, (E.GroupByAgg, E.GroupApply, E.GroupTopK,
+                          E.GroupRankSelect, E.Distinct)):
+            if tuple(n.keys):
+                sides = [(n.parents[0],
+                          E.Partitioning("hash", tuple(n.keys)))]
+        elif isinstance(n, E.Join):
+            if n.broadcast_right:
+                # broadcast joins never consult the placement claims:
+                # lex is dropped and rex replicates regardless, so no
+                # exchange elision happens for a pin to block
+                continue
+            sides = [(n.parents[0],
+                      E.Partitioning("hash", tuple(n.left_keys))),
+                     (n.parents[1],
+                      E.Partitioning("hash", tuple(n.right_keys)))]
+        elif isinstance(n, E.OrderBy):
+            have = n.parents[0].partitioning
+            sort_keys = tuple(k for k, _ in n.keys)
+            if (have.kind == "range" and have.keys
+                    and all(not d for _, d in n.keys)
+                    and sort_keys == have.keys[:len(sort_keys)]):
+                sides = [(n.parents[0], have)]
+        if not sides:
+            continue
+        for parent, claim in sides:
+            if not claim.keys or parent.partitioning != claim:
+                continue           # no elision -> nothing pinned
+            keys = claim.keys
+            pin = _pinning_ancestor(parent, claim)
+            if pin is None:
+                continue
+            what = (f"assume_{pin.kind}_partition"
+                    if isinstance(pin, E.AssumePartitioning)
+                    else ("hash_partition"
+                          if isinstance(pin, E.HashRepartition)
+                          else "range_partition"))
+            out.append(Diagnostic(
+                "DTA017", "warn",
+                f"{what}({', '.join(keys)}) pins the placement "
+                f"{_node_label(n)} relies on: the planner elides that "
+                f"consumer's exchange, so adaptive execution cannot "
+                f"repartition, salt, or right-size it under skew — drop "
+                f"the pin (let the consumer own its exchange) if the "
+                f"key distribution is not known to be balanced",
+                _span(pin), _node_label(pin)))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # shippability rules — mirror every PlanShipError raise site
 
@@ -357,6 +440,7 @@ RULES: List[Rule] = [
     Rule("DTA014", "udf-not-shippable", _rule_ship_udfs),
     Rule("DTA015", "source-not-shippable", _rule_ship_sources),
     Rule("DTA016", "param-not-serializable", _rule_ship_params),
+    Rule("DTA017", "pinned-partitioning", _rule_pinned_partitioning),
     # the UDF determinism rule fans out to DTA101..DTA104
     Rule("DTA101", "udf-determinism", _rule_udf_determinism),
 ]

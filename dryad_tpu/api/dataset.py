@@ -948,11 +948,15 @@ class Dataset:
                            levels=self.ctx.levels,
                            config=self.ctx.config)
         pd = self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
-        # runtime hot-key salting changes the OUTPUT PLACEMENT: any
+        # runtime hot-key salting — and adaptive broadcast flips
+        # (dryad_tpu/adapt) — change the OUTPUT PLACEMENT: any
         # partitioning claim persisted from this materialization
         # (cache/to_store) must drop or a later shuffle-elided read
         # would silently mis-group
-        self._last_salted = any(st._salted for st in graph.stages)
+        self._last_salted = (any(st._salted for st in graph.stages)
+                             or getattr(self.ctx.executor,
+                                        "_last_run_placement_changed",
+                                        False))
         return pd
 
     def collect(self) -> Dict[str, Any]:

@@ -450,6 +450,9 @@ class Executor:
         # keyed ids still name the probed arrays — a dead ref evicts the
         # entry instead of replaying a stale hint for different data.
         self._slot_probe_cache: "OrderedDict[Any, tuple]" = OrderedDict()
+        # last synchronous stage's observed stats (adapt/stats.StageStats)
+        # — consumed by exec/recovery.Run's adaptive boundary hook
+        self._last_stage_stats = None
 
     def apply_config(self, config) -> None:
         """Re-point a persistent executor at a new job's JobConfig (worker
@@ -746,6 +749,10 @@ class Executor:
 
     def _run_stage(self, stage: Stage, results, bindings,
                    defer: Optional[list] = None) -> PData:
+        # observed-stats slot for the adaptive manager (exec/recovery):
+        # cleared per stage so a deferred or failed attempt can never
+        # leak a previous stage's measurement into a rewrite decision
+        self._last_stage_stats = None
         inputs = [self._leg_input(leg, results, bindings)
                   for leg in stage.legs]
         bounds = None
@@ -862,7 +869,17 @@ class Executor:
                 stage._capacity_scale = scale
                 stage._send_slack = slack
                 stage._salted = salted
-                return PData(out_batch, self.nparts)
+                pd = PData(out_batch, self.nparts)
+                if getattr(self.config, "adaptive", "off") == "on":
+                    # rows arrived replicated on multi-process meshes,
+                    # so every gang member records identical stats and
+                    # the rewrite rules stay mirrored
+                    from dryad_tpu.adapt.stats import StageStats
+                    self._last_stage_stats = StageStats(
+                        stage.id, tuple(int(r) for r in rows),
+                        capacity=int(pd.capacity), out_bytes=out_bytes,
+                        wall_s=round(wall, 4))
+                return pd
             # right-size from the measured requirements (the dynamic
             # distribution managers' size feedback, DrDynamicDistributor
             # .cpp:388): ONE retry at the exact need instead of a blind

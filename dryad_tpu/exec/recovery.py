@@ -58,8 +58,22 @@ class Run:
         # syncs each stage, and a truncated output must not be persisted
         # as good) and on multi-process gangs (workers advance in
         # lockstep; the sync path keeps their retry decisions identical).
+        # adaptive execution (dryad_tpu/adapt): stage-boundary graph
+        # rewriting needs the per-stage stats sync, so it forces the
+        # synchronous path — the observability-for-round-trips trade the
+        # reference GM makes at every vertex completion
+        adaptive_on = bool(cfg) and getattr(cfg, "adaptive", "off") == "on"
+        self.adapt = None
+        if adaptive_on:
+            from dryad_tpu.adapt.manager import (AdaptiveManager,
+                                                 levels_of_mesh)
+            self.adapt = AdaptiveManager(
+                graph, cfg, executor.nparts,
+                levels=levels_of_mesh(getattr(executor, "mesh", None)),
+                event=executor._event)
         defer_ok = (getattr(cfg, "deferred_needs", True) if cfg else True)
         self._defer = ([] if defer_ok and not spill_dir
+                       and not adaptive_on
                        and not getattr(executor, "_multiproc", False)
                        else None)
         if spill_dir:
@@ -102,13 +116,44 @@ class Run:
             # of the driver's job span — obs/trace.py propagation)
             with trace.span("run", "job", sink=self.ex._event,
                             stages=len(self.graph.stages)):
-                out = self.result(self.graph.out_stage)
+                # re-read out_stage after the walk: an adaptive rewrite
+                # (agg-tree expansion) may have redirected it to an
+                # appended finalizing stage mid-run
+                while True:
+                    out_sid = self.graph.out_stage
+                    out = self.result(out_sid)
+                    if self.graph.out_stage == out_sid:
+                        break
                 if self._defer:
                     out = self._settle()
         finally:
             _profile.stop(sampler)
-        self.ex._event({"event": "progress", "done": len(self._results),
-                        "total": len(self.graph.stages), "pct": 100.0})
+        # surfaced per run so the cluster/farm reply path can report how
+        # adaptive this job was without re-scanning the event stream
+        self.ex._last_run_rewrites = (self.adapt.rewrite_count
+                                      if self.adapt else 0)
+        # a broadcast flip changes the job output's PLACEMENT (a
+        # promoted join keeps the left producer's distribution, not the
+        # planned hash claim) — persisted partitioning claims must drop,
+        # same contract as runtime salting (test_skew.py)
+        self.ex._last_run_placement_changed = bool(self.adapt) and any(
+            ev.get("kind") in ("broadcast_promote", "broadcast_demote")
+            for ev in self.adapt.applied)
+        # the final progress record counts the stages the finished DAG
+        # actually NEEDED (reachable from out_stage): adaptive rewrites
+        # may orphan ladder levels or append stages, so len(stages)
+        # would contradict pct=100 (done < total) on a completed job
+        reach = set()
+        frontier = [self.graph.out_stage]
+        while frontier:
+            sid = frontier.pop()
+            if sid in reach:
+                continue
+            reach.add(sid)
+            frontier.extend(self.graph.stage(sid).input_stage_ids())
+        self.ex._event({"event": "progress",
+                        "done": len(reach & set(self._results)),
+                        "total": len(reach), "pct": 100.0})
         # job-end metrics snapshot.  "metrics" carries CUMULATIVE
         # process counters (the Prometheus model: monotone since process
         # start), not per-job deltas.  Farm workers suppress this event
@@ -207,16 +252,37 @@ class Run:
         return self.result(self.graph.out_stage)
 
     def result(self, sid: int) -> PData:
-        if sid in self._results:
-            return self._results[sid]
-        spilled = self._load_spill(sid)
-        if spilled is not None:
-            self._results[sid] = spilled
-            return spilled
+        """Materialize stage ``sid`` demand-driven.
+
+        Each outer iteration walks from ``sid`` to its DEEPEST
+        unmaterialized ancestor and computes exactly that one stage,
+        re-reading the graph's edges on every step: an adaptive rewrite
+        fired by a completed ancestor (``self.adapt``) may have
+        redirected legs mid-walk, and a stage orphaned by a rewrite
+        must not be computed just because a pre-rewrite edge pointed at
+        it.  The walk is O(depth) per materialization — noise next to a
+        stage launch — and replays lost ancestors exactly like the old
+        recursive form."""
+        while sid not in self._results:
+            cur = sid
+            while True:
+                spilled = self._load_spill(cur)
+                if spilled is not None:
+                    self._results[cur] = spilled
+                    break
+                missing = [d for d in
+                           self.graph.stage(cur).input_stage_ids()
+                           if d not in self._results]
+                if not missing:
+                    self._compute(cur)
+                    break
+                cur = missing[0]
+        return self._results[sid]
+
+    def _compute(self, sid: int) -> None:
+        """Run one ready stage (all inputs materialized) and fire the
+        adaptive boundary hook."""
         stage = self.graph.stage(sid)
-        # ensure inputs (recursively replays lost ancestors)
-        for dep in stage.input_stage_ids():
-            self.result(dep)
         from dryad_tpu.obs import trace
         # one span per stage execution (compile + run attempts; on the
         # deferred path this covers the enqueue only — the device time
@@ -235,7 +301,14 @@ class Run:
         self.ex._event({"event": "progress", "done": len(self._results),
                         "total": total,
                         "pct": round(100.0 * len(self._results) / total, 1)})
-        return out
+        # adaptive boundary: the unexecuted suffix may be rewritten from
+        # this stage's observed stats BEFORE any dependent runs (the
+        # connection-manager hook, DrConnectionManager
+        # NotifyUpstreamVertexCompleted parity)
+        if self.adapt is not None:
+            st = getattr(self.ex, "_last_stage_stats", None)
+            if st is not None and st.stage == sid:
+                self.adapt.on_stage_materialized(st, set(self._results))
 
     def invalidate(self, sid: int, count_failure: bool = True,
                    drop_spill: bool = False) -> None:
@@ -261,12 +334,26 @@ class Run:
     def _spill_path(self, sid: int) -> str:
         return os.path.join(self.spill_dir, f"stage-{sid:04d}")
 
+    def _stage_fp(self, sid: int) -> str:
+        import hashlib
+        return hashlib.sha256(
+            self.graph.stage(sid).fingerprint().encode()).hexdigest()
+
     def _save_spill(self, sid: int, pd: PData) -> None:
         if not self.spill_dir:
             return
         from dryad_tpu.io.store import write_store
         write_store(self._spill_path(sid), pd,
                     compression=self.spill_compression)
+        if self.adapt is not None:
+            # adaptive runs may reshape a stage before it executes; a
+            # later resume replans WITHOUT the rewrite (no stats yet),
+            # so a bare stage-id spill could restore rewrite-shaped
+            # data into a differently-shaped plan (e.g. an expanded
+            # merge's PARTIAL output as the finalized result).  Record
+            # the executed shape so loads can refuse mismatches.
+            with open(self._spill_path(sid) + ".fp", "w") as f:
+                f.write(self._stage_fp(sid))
         self.ex._event({"event": "stage_spilled", "stage": sid})
 
     def _load_spill(self, sid: int) -> Optional[PData]:
@@ -274,6 +361,25 @@ class Run:
             return None
         p = self._spill_path(sid)
         if not os.path.exists(p):
+            return None
+        # refuse shape-mismatched spills (see _save_spill); a miss just
+        # recomputes — conservative, never wrong.  A recorded .fp is
+        # checked by EVERY run (a non-adaptive resume must not swallow
+        # an adaptive run's rewrite-shaped output either); an adaptive
+        # run refuses bare spills outright (this run may already have
+        # rewritten the stage).  Fingerprints of UDF-bearing stages
+        # embed callable ids, so a NEW-process adaptive resume
+        # recomputes those too (by design).
+        fp_file = p + ".fp"
+        if os.path.exists(fp_file):
+            try:
+                with open(fp_file) as f:
+                    ok = f.read().strip() == self._stage_fp(sid)
+            except OSError:
+                ok = False
+        else:
+            ok = self.adapt is None
+        if not ok:
             return None
         from dryad_tpu.io.store import read_store
         pd = read_store(p, self.ex.mesh)

@@ -10,6 +10,9 @@ greedily-allocated tracks so sibling tasks render side by side instead
 of on top of each other.  ``resource_sample`` events (obs/profile.py)
 become per-process COUNTER tracks ("ph": "C"): memory (RSS + jax
 device-buffer MiB) and CPU%, drawn above each process's span lanes.
+``graph_rewrite`` events (dryad_tpu/adapt) render as instant events
+("ph": "i") on the emitting process's lane, marking the moments the
+running DAG changed shape.
 """
 
 from __future__ import annotations
@@ -79,6 +82,22 @@ def chrome_trace(events) -> Dict[str, Any]:
                     "ts": round(t0 * 1e6, 1),
                     "dur": max(round(dur * 1e6, 1), 1.0),
                     "pid": pid, "tid": tid, "args": args})
+    # adaptive rewrites -> instant events on the emitting process's job
+    # lane (a rewrite is a point decision, not a duration): the viewer
+    # shows WHEN the graph changed shape relative to the stage spans
+    rewrites = [e for e in events
+                if e.get("event") == "graph_rewrite"
+                and e.get("ts") is not None]
+    for e in sorted(rewrites, key=lambda e: float(e["ts"])):
+        pid = _pid_of(e)
+        ensure_name(pid)
+        out.append({"name": f"rewrite:{e.get('kind', '?')}",
+                    "cat": "adapt", "ph": "i", "s": "p",
+                    "ts": round(float(e["ts"]) * 1e6, 1),
+                    "pid": pid, "tid": 0,
+                    "args": {"rule": e.get("rule"),
+                             "stage": e.get("stage"),
+                             "trigger_stage": e.get("trigger_stage")}})
     # resource samples -> per-process counter tracks
     for e in sorted(samples, key=lambda e: float(e["ts"])):
         pid = _pid_of(e)

@@ -49,6 +49,8 @@ FAMILIES = {
     "cap_retries": ("dryad_stage_capacity_retries_total",
                     "capacity-overflow retries"),
     "stage_replays": ("dryad_stage_replays_total", "lineage replays"),
+    "graph_rewrites": ("dryad_graph_rewrites_total",
+                       "adaptive stage-graph rewrites applied"),
     "shuffle_bytes": ("dryad_shuffle_bytes_total",
                       "bytes materialized by stage outputs"),
     "compile_seconds": ("dryad_compile_seconds_total",
@@ -313,6 +315,10 @@ def metrics_from_events(events, registry: Optional[Registry] = None
                                ).inc(0 if e["cache_hit"] else 1)
         elif k in ("stage_replay", "settle_replay"):
             family_counter(r, "stage_replays").inc()
+        elif k == "graph_rewrite":
+            family_counter(r, "graph_rewrites",
+                           rule=e.get("rule", "?"),
+                           kind=e.get("kind", "?")).inc()
         elif k == "stream_tee_spill":
             family_counter(r, "tee_spills").inc()
         elif k == "job_done":

@@ -34,6 +34,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from dryad_tpu.adapt.thresholds import (SKEW_SIBLING_MEDIAN_FACTOR,
+                                        sibling_median, skew_ratio)
+
 __all__ = ["ResourceSampler", "start", "stop", "sample_now",
            "diagnose_events"]
 
@@ -161,10 +164,16 @@ def stop(sampler: Optional[ResourceSampler]) -> None:
 
 # -- sibling-relative diagnosis ----------------------------------------------
 
-def diagnose_events(events, skew_factor: float = 4.0,
+def diagnose_events(events, skew_factor: float = SKEW_SIBLING_MEDIAN_FACTOR,
                     slow_factor: float = 2.0,
                     min_tasks: int = 2) -> List[Dict[str, Any]]:
     """Skew / slow-worker findings from a recorded event stream.
+
+    The skew threshold is the SHARED constant
+    ``adapt.thresholds.SKEW_SIBLING_MEDIAN_FACTOR`` — the same multiple
+    the adaptive runtime ACTS on (``adapt/rules.SkewRepartition``), so a
+    flagged partition is exactly one an adaptive run would have
+    repartitioned for, and vice versa.
 
     Returns event-shaped records (kinds ``diagnosis_skew`` and
     ``diagnosis_slow_worker``); callers may render them
@@ -182,16 +191,18 @@ def diagnose_events(events, skew_factor: float = 4.0,
             continue
         rows = [int(r) for r in rows]
         peak = max(rows)
-        sib = sorted(r for i, r in enumerate(rows)
-                     if i != rows.index(peak))
-        med = sib[len(sib) // 2] if sib else 0
-        if peak < skew_factor * max(med, 1) or peak < 2:
+        # the SHARED median/ratio math (adapt/thresholds.py): detection
+        # here and action (adapt/rules.SkewRepartition via
+        # StageStats.is_skewed) must compute the same number
+        med = sibling_median(rows)
+        ratio = skew_ratio(rows)
+        if ratio < skew_factor or peak < 2:
             continue
         rec = {"event": "diagnosis_skew", "stage": e.get("stage"),
                "label": e.get("label", "?"),
                "partition": rows.index(peak), "rows_max": peak,
                "rows_sibling_median": med,
-               "ratio": round(peak / max(med, 1), 1)}
+               "ratio": round(ratio, 1)}
         prev = worst.get(e.get("stage"))
         if prev is None or rec["ratio"] > prev["ratio"]:
             worst[e.get("stage")] = rec

@@ -211,6 +211,9 @@ class Planner:
                         changed = True
         for sid in dependent:
             self.stages[sid].salt_ok = False
+            # the reliance itself is recorded for the adaptive rewriter:
+            # rules that would change output placement must refuse here
+            self.stages[sid].placement_relied = True
         return StageGraph(self.stages, out_id)
 
     def _lower_group_decomposable(self, n: "E.GroupByAgg", f: Fragment,

@@ -124,10 +124,15 @@ def graph_to_json(graph: StageGraph,
                          "ops": [_op_to_json(o, fn_names, shared)
                                  for o in leg.ops],
                          "exchange": ex})
-        stages.append({"id": st.id, "label": st.label, "legs": legs,
-                       "salt_ok": st.salt_ok,
-                       "body": [_op_to_json(o, fn_names, shared)
-                                for o in st.body]})
+        sd = {"id": st.id, "label": st.label, "legs": legs,
+              "salt_ok": st.salt_ok,
+              "body": [_op_to_json(o, fn_names, shared)
+                       for o in st.body]}
+        # emitted only when set: plans without placement reliance stay
+        # byte-identical to the pre-adaptive wire format
+        if st.placement_relied:
+            sd["placement_relied"] = True
+        stages.append(sd)
     return json.dumps({"version": 1, "stages": stages,
                        "out_stage": graph.out_stage}, indent=1)
 
@@ -164,5 +169,7 @@ def graph_from_json(s: str, fn_table: Optional[Dict[str, Callable]] = None,
                             body=[_op_from_json(o, fn_table, shared)
                                   for o in sd["body"]],
                             label=sd["label"],
-                            salt_ok=sd.get("salt_ok", False)))
+                            salt_ok=sd.get("salt_ok", False),
+                            placement_relied=sd.get("placement_relied",
+                                                    False)))
     return StageGraph(stages, d["out_stage"])

@@ -90,6 +90,14 @@ class Stage:
     # clears it wherever partition elimination relied on the claim).
     # Reference: DrDynamicDistributor.h:79 dynamic redistribution.
     salt_ok: bool = False
+    # True when a LATER lowering elided an exchange by trusting this
+    # stage's output placement (the planner's placement_dependent
+    # closure).  Adaptive rewrites that would change the output
+    # placement (broadcast demotion, adapt/rules.BroadcastManager) must
+    # refuse on these stages — the downstream elision would silently
+    # mis-group.  salt_ok=False alone cannot encode this: broadcast
+    # joins are born salt_ok=False without any reliance.
+    placement_relied: bool = False
     _salted: bool = False   # executor runtime state (sticky per stage)
 
     def fingerprint(self) -> str:

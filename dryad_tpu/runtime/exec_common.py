@@ -82,10 +82,17 @@ def execute_plan(plan_json: str, fn_table: Dict[str, Callable],
     pd = ex.run(graph)
 
     extras: Dict[str, Any] = {}
+    # adaptive rewrites are mirrored across the gang (replicated stats
+    # drive deterministic rules), so every worker reports the same count
+    rewrites = getattr(ex, "_last_run_rewrites", 0)
+    if rewrites:
+        extras["graph_rewrites"] = rewrites
     # runtime salting decisions are mirrored across processes (pmax'd
     # info), so every worker computes the same flag; placement claims
-    # persisted from a salted run must drop
-    salted = any(st._salted for st in graph.stages)
+    # persisted from a salted run — or one whose output placement an
+    # adaptive broadcast flip changed — must drop
+    salted = (any(st._salted for st in graph.stages)
+              or getattr(ex, "_last_run_placement_changed", False))
     if salted:
         extras["salted"] = True
         if store_partitioning:

@@ -442,6 +442,12 @@ class TaskFarm:
                         done_ev = {"event": "task_done", "task": t.idx,
                                    "worker": pid,
                                    "wall_s": round(took, 3)}
+                        if reply.get("rewrites"):
+                            # adaptive rewrites the worker applied while
+                            # running this task (dryad_tpu/adapt); the
+                            # per-rewrite graph_rewrite events were
+                            # forwarded worker-tagged above
+                            done_ev["rewrites"] = reply["rewrites"]
                         if t.duplicated:
                             # which copy won (straggler metrics —
                             # DrStageStatistics outcome accounting);

@@ -211,6 +211,12 @@ def main(argv=None) -> int:
                                             fn_table=fn_table,
                                             sources=sources)
                     pd = local_ex.run(graph)
+                    # adaptive rewrites applied inside this task's run
+                    # (JobConfig.adaptive rides the shipped config);
+                    # the farm folds the count into task_done
+                    _rw = getattr(local_ex, "_last_run_rewrites", 0)
+                    if _rw:
+                        reply["rewrites"] = _rw
                     reply["table"] = pdata_to_host(
                         maybe_shrink_for_collect(pd, config=cfg))
             except Exception as e:

@@ -14,6 +14,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+from dryad_tpu.adapt.thresholds import (
+    SKEW_SIBLING_MEDIAN_FACTOR as _SKEW_FACTOR)
 from dryad_tpu.utils.compile_cache import (
     DEFAULT_CACHE_DIR as _DEFAULT_COMPILE_CACHE_DIR)
 
@@ -205,6 +207,33 @@ class JobConfig:
     # `python -m dryad_tpu.obs history <dir>`
     history_dir: Optional[str] = None
 
+    # -- adaptive execution (dryad_tpu/adapt) ------------------------------
+    # stage-boundary graph rewriting from observed per-partition stats
+    # (the reference's DrDynamicAggregate/Distribution/BroadcastManager
+    # roles).  "off" (default): the adapt subsystem is never constructed
+    # — byte-identical plans and results to the non-adaptive runtime.
+    # "on": the not-yet-executed suffix of the StageGraph may be
+    # rewritten at each stage materialization; requires the per-stage
+    # stats sync, so deferred-needs batching is disabled for the run.
+    adaptive: str = "off"
+    # a partition is skewed at >= this multiple of its sibling median —
+    # SAME constant diagnose_events flags on (adapt/thresholds.py), so
+    # detection and action cannot drift
+    adapt_skew_factor: float = _SKEW_FACTOR
+    # collapse a hierarchical aggregation tree to one global exchange
+    # when the measured upstream rows are at most this many
+    adapt_agg_collapse_rows: int = 4096
+    # expand a flat merge into per-axis hops (multi-level meshes) when
+    # measured upstream rows reach this many
+    adapt_agg_expand_rows: int = 1 << 20
+    # shrink a downstream exchange's capacity when the static plan
+    # capacity exceeds this multiple of the measured row bound
+    adapt_shrink_factor: float = 2.0
+    # broadcast joins: measured build side must stay within this
+    # fraction of the probe side's rows — above it a planned broadcast
+    # demotes to hash exchange, below it a saltable hash join promotes
+    adapt_broadcast_max_ratio: float = 0.25
+
     # -- pre-submit static analysis (dryad_tpu/analysis) -------------------
     # gate every executor/cluster/stream submission through the plan
     # verifier + UDF lint (the reference's phase-1 static validation,
@@ -267,6 +296,17 @@ class JobConfig:
             (self.max_loop_iterations >= 1, "max_loop_iterations >= 1"),
             (self.lint in ("off", "warn", "error"),
              "lint in ('off', 'warn', 'error')"),
+            (self.adaptive in ("off", "on"),
+             "adaptive in ('off', 'on')"),
+            (self.adapt_skew_factor >= 1.0, "adapt_skew_factor >= 1.0"),
+            (self.adapt_agg_collapse_rows >= 1,
+             "adapt_agg_collapse_rows >= 1"),
+            (self.adapt_agg_expand_rows >= 1,
+             "adapt_agg_expand_rows >= 1"),
+            (self.adapt_shrink_factor >= 1.0,
+             "adapt_shrink_factor >= 1.0"),
+            (self.adapt_broadcast_max_ratio > 0,
+             "adapt_broadcast_max_ratio > 0"),
             (self.resource_sample_s >= 0, "resource_sample_s >= 0"),
         ]
         for ok, msg in checks:

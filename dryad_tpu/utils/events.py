@@ -35,11 +35,16 @@ _LEVELS = {
     "lint_finding": 1, "settle_replay": 1, "stage_retry": 1,
     "stream_stage_done": 1, "stream_tee_spill": 1, "job_done": 1,
     "job_archived": 1, "diagnosis_skew": 1, "diagnosis_slow_worker": 1,
+    # adaptive execution: an applied stage-graph rewrite is a scheduling
+    # decision (level 1, dryad_tpu/adapt)
+    "graph_rewrite": 1,
     # chatter: progress ticks, losing duplicates, locality notes, spans,
-    # periodic resource samples (obs/profile.py)
+    # periodic resource samples (obs/profile.py), per-stage adapt stats
+    # and declined rewrites (dryad_tpu/adapt)
     "progress": 2, "task_duplicate_ignored": 2,
     "task_duplicate_failed_ignored": 2, "task_locality_dispatch": 2,
     "span": 2, "resource_sample": 2,
+    "adapt_stats": 2, "adapt_skipped": 2,
 }
 
 

@@ -453,6 +453,46 @@ def _diagnosis_html(events) -> str:
     return "<h2>Diagnosis</h2>" + "".join(blocks)
 
 
+def _adaptive_html(events) -> str:
+    """"Adaptive rewrites" section: one row per applied graph_rewrite
+    (dryad_tpu/adapt), with the before/after stage topology behind a
+    disclosure — the JobBrowser's dynamic-manager decisions view."""
+    rewrites = [e for e in events if e.get("event") == "graph_rewrite"]
+    skipped = [e for e in events if e.get("event") == "adapt_skipped"]
+    if not rewrites and not skipped:
+        return ""
+    rows = []
+    for e in rewrites:
+        topo = json.dumps({"before": e.get("before"),
+                            "after": e.get("after")}, indent=1)
+        detail = {k: v for k, v in e.items()
+                  if k not in ("event", "rule", "kind", "stage",
+                               "trigger_stage", "before", "after", "ts",
+                               "worker")}
+        rows.append(
+            f"<tr><td>{html.escape(str(e.get('rule', '?')))}</td>"
+            f"<td>{html.escape(str(e.get('kind', '?')))}</td>"
+            f"<td>{e.get('stage', '?')}</td>"
+            f"<td>{e.get('trigger_stage', '?')}</td>"
+            f"<td>{html.escape(json.dumps(detail))}</td>"
+            f"<td><details><summary>topology</summary>"
+            f"<pre>{html.escape(topo)}</pre></details></td></tr>")
+    out = ("<h2>Adaptive rewrites</h2>"
+           "<table class='lint'><tr><th>rule</th><th>kind</th>"
+           "<th>stage</th><th>trigger</th><th>detail</th>"
+           "<th>before &#8594; after</th></tr>"
+           + "".join(rows) + "</table>") if rows else ""
+    if skipped:
+        li = "".join(
+            f"<li><b>{html.escape(str(e.get('rule', '?')))}</b> "
+            f"stage {e.get('stage', '?')}: "
+            f"{html.escape(str(e.get('reason', '')))}</li>"
+            for e in skipped)
+        out += (f"<details><summary>{len(skipped)} declined "
+                f"rewrite(s)</summary><ul>{li}</ul></details>")
+    return out
+
+
 def job_report_html(events, plan_json: Optional[str] = None,
                     path: Optional[str] = None, title: str = "dryad job",
                     live_refresh_s: Optional[float] = None) -> str:
@@ -529,6 +569,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
 <div class="tiles">{tile_html}</div>
 {_diagnosis_html(events)}
 {_lint_html(events)}
+{_adaptive_html(events)}
 {_critical_path_html(events)}
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}
 <h2>Gantt (time from job start)</h2>{_svg_gantt(stages, order)}

@@ -152,6 +152,50 @@ def test_dta013_unsound_assume(ctx):
     assert "DTA013" not in ok.check().codes()
 
 
+def test_dta017_pinned_partitioning_blocks_adaptation(ctx):
+    # explicit repartition whose placement a group_by elides: the
+    # adaptive runtime has no exchange left to rewrite there
+    q = _kv(ctx).hash_partition(["k"]).group_by(
+        ["k"], {"s": ("sum", "v")})
+    rep = q.check()
+    assert "DTA017" in rep.codes()
+    d = rep.by_code("DTA017")[0]
+    assert d.severity == "warn" and d.span is not None
+    assert "test_analysis.py" in d.span.file   # points at the PIN
+    # assume_* flavor
+    qa = _kv(ctx).assume_hash_partition(["k"]).distinct(["k"])
+    assert "DTA017" in qa.check().codes()
+    # range flavor: a pinned range placement an ascending sort elides
+    qr = _kv(ctx).range_partition(["k"]).order_by([("k", False)])
+    assert "DTA017" in qr.check().codes()
+    # descending sort keeps its exchange -> nothing pinned
+    qrd = _kv(ctx).range_partition(["k"]).order_by([("k", True)])
+    assert "DTA017" not in qrd.check().codes()
+    # join: a pinned side whose exchange elides is flagged too
+    other = _kv(ctx).select(doubler)
+    qj = _kv(ctx).hash_partition(["k"]).join(other, ["k"], ["k"])
+    assert "DTA017" in qj.check().codes()
+
+
+def test_dta017_absent_without_pin_or_elision(ctx):
+    # natural placement (a group_by output) is not a pin
+    q = (_kv(ctx).group_by(["k"], {"s": ("sum", "v")})
+         .group_by(["k"], {"n": ("count", None)}))
+    assert "DTA017" not in q.check().codes()
+    # a pin whose keys the consumer does NOT elide on is fine
+    q2 = _kv(ctx).hash_partition(["v"]).group_by(
+        ["k"], {"s": ("sum", "v")})
+    assert "DTA017" not in q2.check().codes()
+    # a pin with no consumer at all is fine
+    q3 = _kv(ctx).hash_partition(["k"])
+    assert "DTA017" not in q3.check().codes()
+    # a broadcast join never consults the claims (no elision to block)
+    other = _kv(ctx).select(doubler)
+    q4 = _kv(ctx).hash_partition(["k"]).join(other, ["k"], ["k"],
+                                             broadcast=True)
+    assert "DTA017" not in q4.check().codes()
+
+
 def test_dta014_unshippable_udf(ctx):
     q = _kv(ctx).select(lambda c: {"k": c["k"]})
     rep = q.check(cluster=True)

@@ -54,6 +54,11 @@ def test_every_emitted_event_kind_is_registered():
     missing = {k: v for k, v in found.items() if k not in _LEVELS}
     assert not missing, (f"event kinds emitted but not registered in "
                          f"utils.events._LEVELS: {missing}")
+    # adaptive-execution kinds (dryad_tpu/adapt): an applied rewrite is
+    # stage-lifecycle-grade; stats and declined rewrites are chatter
+    assert _LEVELS["graph_rewrite"] == 1
+    assert _LEVELS["adapt_stats"] == 2
+    assert _LEVELS["adapt_skipped"] == 2
 
 
 # -- satellite: EventLog lifecycle -------------------------------------------
