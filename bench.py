@@ -334,6 +334,382 @@ def smoke_adapt(out_path="BENCH_adapt.json", n_rows=None, reps=None,
     return out
 
 
+def smoke_kernels(out_path="BENCH_kernels.json", n=None, quiet=False):
+    """Data-plane kernel micro-bench smoke (``python bench.py
+    --smoke-kernels``, also rides ``--smoke``): DEVICE-TRUTH rows for the
+    round-6 data-plane kernels, each an A/B of the shipped lowering vs
+    the pre-kernel one it replaced (kept live behind
+    ``DRYAD_NO_SORT_OPT`` / reconstructed verbatim here), slope-measured
+    (benchmarks.micro.slope_time: in-program repetition, fetch-fenced,
+    dispatch floor cancels) with the two sides' slope calls INTERLEAVED
+    (A, B, A, B; best-of per side) so both read the same box weather.
+
+    Rows:
+      * multikey_sort   — sort_by_columns, 2 i32 keys: runtime key-lane
+                          fusion (_sort_fused2) vs the general 3-lane
+                          carry sort; roofline_pct against this
+                          backend's measured copy rate.
+      * exchange_pack   — send-side slot build: tile-histogram +
+                          unstable (dest, idx) carry sort + slot
+                          expansion vs stable argsort + bincount +
+                          composed random gather.
+      * exchange_unpack — receive-side: slot compaction vs stable
+                          valid-first sort + gather.
+      * join_gather     — the join's output materialization: ONE packed
+                          word-matrix gather (_packed_gather) vs one
+                          random gather per column; plus the full
+                          hash_join's absolute device-truth rows/s.
+      * wire_utilization_inmem — NOT a timing: the measured-slot wire
+                          arithmetic of a real multi-exchange in-memory
+                          stage (both join legs carry ops, so only the
+                          round-6 slot FEEDBACK can size them): slots
+                          needed / slots shipped on the discovery wave
+                          (structural slack) vs the steady state
+                          (measured exact slots).
+
+    Backend honesty: the slot kernels compile on TPU only — on other
+    backends slot_expand/slot_compact take their XLA fallback (exercised
+    bit-exactly by tests/test_pallas_kernels.py force_interpret rows),
+    so a CPU capture's pack/unpack delta reflects the sort-path changes
+    only; the ``backend`` field says which chip the row describes."""
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.micro import bench_hbm_copy, slope_time
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as K
+    from dryad_tpu.ops.pallas_kernels import (pallas_active, slot_compact,
+                                              slot_expand)
+
+    n = n or int(os.environ.get("BENCH_KERNEL_ROWS", str(1 << 17)))
+    k_lo = int(os.environ.get("BENCH_KERNEL_KLO", "2"))
+    k_hi = int(os.environ.get("BENCH_KERNEL_KHI", "10"))
+    rng = np.random.RandomState(6)
+    backend = jax.default_backend()
+
+    def ab(body_new, body_old, make_carry, rounds=2, khi=None):
+        """Interleaved slope pairs: A,B,A,B — best-of per side.
+        ``khi`` widens the repetition spread for cheap bodies whose
+        per-pass device time would drown in call-wall jitter."""
+        ts_new, ts_old = [], []
+        for _ in range(rounds):
+            ts_new.append(slope_time(body_new, make_carry,
+                                     k_lo=k_lo, k_hi=khi or k_hi,
+                                     iters=2))
+            ts_old.append(slope_time(body_old, make_carry,
+                                     k_lo=k_lo, k_hi=khi or k_hi,
+                                     iters=2))
+        return min(ts_new), min(ts_old)
+
+    def fold(tree):
+        """Reduce EVERY output element into one i32 — the timed body's
+        carry must consume the whole result or XLA dead-code-eliminates
+        the work down to the slice the carry actually reads (measured:
+        an unconsumed unpack body 'ran' in 0.0 s)."""
+        tot = jnp.zeros((), jnp.int32)
+        for leaf in jax.tree.leaves(tree):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                leaf = jax.lax.bitcast_convert_type(
+                    leaf.astype(jnp.float32), jnp.int32)
+            tot = tot + leaf.astype(jnp.int32).sum()
+        return tot
+
+    rows = {}
+
+    # -- multikey sort: runtime key-lane fusion vs general 3-lane ------
+    k1 = jnp.asarray(rng.randint(0, 1000, n).astype(np.int32))
+    k2 = jnp.asarray(rng.randint(0, 1000, n).astype(np.int32))
+    pv = jnp.asarray(rng.rand(n).astype(np.float32))
+    pw = jnp.asarray(rng.randint(0, 1 << 30, n).astype(np.int32))
+    cnt = jnp.asarray(n, jnp.int32)
+    keys = [("k1", False), ("k2", False)]
+
+    # the fused path ships on the TPU tier (sort_by_columns gates it by
+    # pallas_active); the A/B here measures the two DESIGNS directly on
+    # identical lanes, whatever tier this backend rides in production
+    inv0 = jnp.zeros((n,), jnp.uint32)
+    la0 = k1.astype(jnp.uint32)
+    lb0 = k2.astype(jnp.uint32)
+    packed0 = [jax.lax.bitcast_convert_type(pv, jnp.uint32),
+               pw.astype(jnp.uint32)]
+
+    def sort_fused_body(i, carry):
+        a, b = carry
+        lanes = [inv0, la0 ^ (a[0] & 1), lb0]
+        sk, sv = K._sort_fused2(lanes, [x ^ a[0] for x in packed0], n)
+        return (a ^ fold(sk).astype(jnp.uint32)
+                ^ fold(sv).astype(jnp.uint32), b)
+
+    def sort_general_body(i, carry):
+        a, b = carry
+        lanes = [inv0, la0 ^ (a[0] & 1), lb0]
+        sk, sv = K._sort_carrying(lanes, [x ^ a[0] for x in packed0], n)
+        return (a ^ fold(list(sk)).astype(jnp.uint32)
+                ^ fold(list(sv)).astype(jnp.uint32), b)
+
+    def mk_carry(j):
+        seed = jnp.asarray(
+            rng.randint(0, 1 << 30, n).astype(np.uint32))
+        return (seed, jnp.zeros((), jnp.uint32))
+
+    t_new, t_old = ab(sort_fused_body, sort_general_body, mk_carry)
+    hbm = bench_hbm_copy(mb=int(os.environ.get("BENCH_KERNEL_COPY_MB",
+                                               "64")))
+    copy_gbps = hbm["hbm_copy_gbps"]
+    row_bytes = 16       # k1+k2+v+w, 4 B each
+    t_copy = 2 * n * row_bytes / (copy_gbps * 1e9)
+    rows["multikey_sort"] = {
+        "rows": n, "new_s": round(t_new, 6), "old_s": round(t_old, 6),
+        "speedup_pct": round(100 * (t_old - t_new) / t_old, 1),
+        "rows_per_s": round(n / t_new),
+        "roofline_pct": round(100 * t_copy / t_new, 2),
+        "copy_gbps_basis": round(copy_gbps, 2),
+        "prod_lowering": ("fused" if pallas_active() == "compiled"
+                          else "general"),
+    }
+
+    # -- exchange pack/unpack: slot build + compaction A/B -------------
+    D, W = 8, 4
+    C = -(-2 * n // D)           # the structural slack-2 slot width
+    dest0 = jnp.asarray(rng.randint(0, D, n).astype(np.int32))
+    lanes0 = [jnp.asarray(rng.randint(0, 1 << 30, n)
+                          .astype(np.uint32)) for _ in range(W)]
+
+    from dryad_tpu.ops.pallas_kernels import hist_buckets
+
+    def pack_new(i, carry):
+        d, acc = carry
+        counts = hist_buckets(d, D)
+        offsets = jnp.cumsum(counts) - counts
+        iota = jnp.arange(n, dtype=jnp.uint32)
+        _, sl = K._sort_carrying([d.astype(jnp.uint32), iota],
+                                 [x ^ acc[0] for x in lanes0], n,
+                                 stable=False)
+        words = jnp.stack(sl, axis=1)
+        send = slot_expand(words, offsets.astype(jnp.int32), C)
+        return (d ^ (fold(send) & 1), acc)
+
+    def pack_old(i, carry):
+        d, acc = carry
+        order = jnp.argsort(d, stable=True)
+        counts = jnp.bincount(jnp.minimum(jnp.take(d, order), D),
+                              length=D + 1)[:D]
+        offsets = jnp.cumsum(counts) - counts
+        d_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
+        j_idx = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
+        src = jnp.clip(jnp.take(offsets, d_idx) + j_idx, 0, n - 1)
+        comp = jnp.take(order, src)
+        send = jnp.stack([jnp.take(x ^ acc[0], comp)
+                          for x in lanes0], axis=1)
+        return (d ^ (fold(send) & 1), acc)
+
+    def mk_pack_carry(j):
+        return (dest0, (jnp.asarray(
+            rng.randint(0, 1 << 30, n).astype(np.uint32)),))
+
+    t_new, t_old = ab(pack_new, pack_old, mk_pack_carry)
+    rows["exchange_pack"] = {
+        "rows": n, "dests": D, "slot_rows": C,
+        "new_s": round(t_new, 6), "old_s": round(t_old, 6),
+        "speedup_pct": round(100 * (t_old - t_new) / t_old, 1),
+        "rows_per_s": round(n / t_new),
+        "slot_kernels_engaged": pallas_active() == "compiled",
+        # the pack lowering ships ONLY where the slot kernels engage
+        # (TPU); elsewhere _exchange_one_axis keeps the gather form —
+        # a negative delta here on cpu is the PROVENANCE for that gate
+        "prod_lowering": ("pack" if pallas_active() == "compiled"
+                          else "gather"),
+    }
+
+    recv0 = jnp.asarray(rng.randint(0, 1 << 30, (D * C, W))
+                        .astype(np.uint32))
+    counts0 = jnp.asarray(
+        rng.randint(0, max(n // D, 1), D).astype(np.int32))
+
+    def unpack_new(i, carry):
+        r, acc = carry
+        out = slot_compact(r ^ acc[0], counts0, C, n)
+        return (r ^ (fold(out) & 1).astype(jnp.uint32), acc)
+
+    def unpack_old(i, carry):
+        r, acc = carry
+        rr = r ^ acc[0]
+        idx = jnp.arange(D * C, dtype=jnp.int32)
+        rvalid = (idx % C) < jnp.take(counts0, idx // C)
+        perm = jnp.argsort(~rvalid, stable=True)
+        g = jnp.take(rr, perm[:n], axis=0)
+        total = rvalid.sum(dtype=jnp.int32)
+        gmask = jnp.arange(n, dtype=jnp.int32) < total
+        out = jnp.where(gmask[:, None], g, 0)
+        return (r ^ (fold(out) & 1).astype(jnp.uint32), acc)
+
+    def mk_unpack_carry(j):
+        return (recv0, (jnp.asarray(
+            rng.randint(0, 1 << 30, (1,)).astype(np.uint32)),))
+
+    t_new, t_old = ab(unpack_new, unpack_old, mk_unpack_carry,
+                      khi=max(k_hi, 64))
+    rows["exchange_unpack"] = {
+        "rows": n, "dests": D,
+        "new_s": round(t_new, 6), "old_s": round(t_old, 6),
+        "speedup_pct": round(100 * (t_old - t_new) / t_old, 1),
+        "rows_per_s": round(n / t_new),
+        "slot_kernels_engaged": pallas_active() == "compiled",
+        "prod_lowering": ("pack" if pallas_active() == "compiled"
+                          else "gather"),
+    }
+
+    # -- join gather: packed single-gather vs per-column gathers -------
+    nl, nright = n, max(n // 8, 1024)
+    jcols = {"a": jnp.asarray(rng.rand(nl).astype(np.float32)),
+             "b": jnp.asarray(rng.randint(0, 1 << 30, nl)
+                              .astype(np.int32)),
+             "c": jnp.asarray(rng.randint(0, 1 << 30, nl)
+                              .astype(np.int64)),
+             "d": jnp.asarray(rng.rand(nl).astype(np.float32))}
+    idx0 = jnp.asarray(rng.randint(0, nl, nl).astype(np.int32))
+
+    def jg_new(i, carry):
+        ix, acc = carry
+        # the packed design, measured raw (its prod entry point
+        # _packed_gather gates to per-column off-TPU)
+        lanes, spec = K._pack_columns_u32(jcols)
+        w = jnp.stack(lanes, axis=1)
+        g = jnp.take(w, ix, axis=0)
+        out = K._unpack_columns_u32(
+            [g[:, j] for j in range(len(lanes))], spec)
+        return (ix ^ (fold(out) & 1), acc)
+
+    def jg_old(i, carry):
+        ix, acc = carry
+        out = {k: jnp.take(v, ix, axis=0) for k, v in jcols.items()}
+        return (ix ^ (fold(out) & 1), acc)
+
+    def mk_jg_carry(j):
+        return (idx0, ())
+
+    t_new, t_old = ab(jg_new, jg_old, mk_jg_carry, khi=max(k_hi, 32))
+    lk = jnp.asarray(rng.randint(0, nright, nl).astype(np.int32))
+    rk = jnp.arange(nright, dtype=jnp.int32)
+    rv = jnp.asarray(rng.rand(nright).astype(np.float32))
+    lb = Batch({"k": lk, "a": jcols["a"], "b": jcols["b"]},
+               jnp.asarray(nl, jnp.int32))
+    right_b = Batch({"k": rk, "rv": rv}, jnp.asarray(nright, jnp.int32))
+
+    def join_body(i, carry):
+        kk, acc = carry
+        out, _need = K.hash_join(
+            Batch({"k": kk, "a": jcols["a"], "b": jcols["b"]},
+                  jnp.asarray(nl, jnp.int32)),
+            right_b, ["k"], ["k"], nl)
+        return (kk ^ (fold(dict(out.columns)) & 1), acc)
+
+    t_join = slope_time(join_body, lambda j: (lk, ()),
+                        k_lo=k_lo, k_hi=k_hi, iters=2)
+    rows["join_gather"] = {
+        "rows": nl, "right_rows": nright,
+        "new_s": round(t_new, 6), "old_s": round(t_old, 6),
+        "speedup_pct": round(100 * (t_old - t_new) / t_old, 1),
+        "join_rows_per_s_chip": round(nl / t_join),
+        "join_s": round(t_join, 6),
+        "prod_lowering": ("packed" if pallas_active() == "compiled"
+                          else "per_column"),
+    }
+
+    # -- wire utilization: measured slots on a multi-exchange stage ----
+    from dryad_tpu import Context
+    from dryad_tpu.exec.executor import _quantize_slot_rows
+    from dryad_tpu.utils.config import JobConfig
+
+    un = 20_000
+    uk1 = rng.randint(0, 500, un).astype(np.int32)
+    uv1 = rng.randint(0, 1 << 20, un).astype(np.int32)
+    uk2 = np.arange(500, dtype=np.int32)
+    uv2 = rng.randint(0, 1 << 20, 500).astype(np.int32)
+    from dryad_tpu.exec.executor import Executor
+
+    ctx = Context(config=JobConfig(exchange_probe_min_mb=1e9))
+    leg_caps = {}                     # (fingerprint, leg) -> input cap
+    orig_hints = Executor._slot_hints
+
+    def spy(self, stage, inputs, slack, salted):
+        fp = stage.fingerprint()
+        for li, inp in enumerate(inputs):
+            if stage.legs[li].exchange is not None:
+                leg_caps[(fp, li)] = inp.capacity   # per-partition rows
+        return orig_hints(self, stage, inputs, slack, salted)
+
+    Executor._slot_hints = spy
+    try:
+        qleft = (ctx.from_columns({"k": uk1, "v": uv1})
+                 .where(lambda c: c["v"] >= 0))
+        qright = (ctx.from_columns({"k": uk2, "w": uv2})
+                  .where(lambda c: c["w"] >= 0))
+        qj = qleft.join(qright, ["k"])
+        qj.collect()                   # wave 1: structural slack
+        qj.collect()                   # wave 2: measured exact slots
+    finally:
+        Executor._slot_hints = orig_hints
+    ex = ctx.executor
+    slack = ctx.config.initial_send_slack
+    Dm = ex.nparts
+    needed = shipped_struct = shipped_meas = 0
+    for key, slot in ex._slot_feedback.items():
+        cap = leg_caps.get(key)
+        if cap is None:
+            continue
+        needed += slot
+        # the structural discovery slot (_exchange_one_axis formula)
+        shipped_struct += max(1, min(cap, -(-slack * cap // Dm)))
+        shipped_meas += _quantize_slot_rows(slot)
+    util_struct = (round(100.0 * needed / shipped_struct, 1)
+                   if shipped_struct else None)
+    util_meas = (round(100.0 * needed / shipped_meas, 1)
+                 if shipped_meas else None)
+    rows["wire_utilization_inmem"] = {
+        "rows": un, "exchange_legs": len(ex._slot_feedback),
+        "wave1_structural_pct": util_struct,
+        "wave2_measured_pct": util_meas,
+    }
+
+    out = {
+        "metric": "kernel smoke (data-plane A/B device-truth rows)",
+        "backend": backend,
+        "n_devices": jax.device_count(),
+        "slope_k": [k_lo, k_hi],
+        "rows": rows,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-kernels",
+            "backend": backend,
+            "multikey_sort_speedup_pct":
+                rows["multikey_sort"]["speedup_pct"],
+            "multikey_sort_roofline_pct":
+                rows["multikey_sort"]["roofline_pct"],
+            "exchange_pack_speedup_pct":
+                rows["exchange_pack"]["speedup_pct"],
+            "exchange_unpack_speedup_pct":
+                rows["exchange_unpack"]["speedup_pct"],
+            "join_gather_speedup_pct":
+                rows["join_gather"]["speedup_pct"],
+            "join_rows_per_s_chip":
+                rows["join_gather"]["join_rows_per_s_chip"],
+            "wire_util_inmem_wave1_pct":
+                rows["wire_utilization_inmem"]["wave1_structural_pct"],
+            "wire_util_inmem_wave2_pct":
+                rows["wire_utilization_inmem"]["wave2_measured_pct"],
+            "kernel_rows": n}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def main():
     import jax
 
@@ -900,16 +1276,21 @@ if __name__ == "__main__":
     if "--smoke-adapt" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-adapt"]
         smoke_adapt(out_path=args[0] if args else "BENCH_adapt.json")
+    elif "--smoke-kernels" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-kernels"]
+        smoke_kernels(out_path=args[0] if args else "BENCH_kernels.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
         smoke(out_path=obs_out)
-        # the adapt case rides --smoke: output lands NEXT TO the
-        # requested obs path (an explicit path keeps the cwd clean) and
-        # stdout stays ONE JSON document — existing json.loads(stdout)
-        # consumers of --smoke keep working
-        smoke_adapt(out_path=os.path.join(
-            os.path.dirname(os.path.abspath(obs_out)),
-            "BENCH_adapt.json"), quiet=True)
+        # the adapt + kernel cases ride --smoke: outputs land NEXT TO
+        # the requested obs path (an explicit path keeps the cwd clean)
+        # and stdout stays ONE JSON document — existing
+        # json.loads(stdout) consumers of --smoke keep working
+        base = os.path.dirname(os.path.abspath(obs_out))
+        smoke_adapt(out_path=os.path.join(base, "BENCH_adapt.json"),
+                    quiet=True)
+        smoke_kernels(out_path=os.path.join(base, "BENCH_kernels.json"),
+                      quiet=True)
     else:
         main()

@@ -19,6 +19,36 @@ ops/pallas_kernels — the module docstring there carries the verdicts):
                          pallas compaction's 0.45)
   * cumsum_pallas vs cumsum_xla — the shipped streaming prefix-scan vs
                          XLA's log-depth cumsum (4.5x at 512k)
+
+Round-6 probes (exchange pack/unpack + sort/join fusions — the shipped
+vs REJECTED verdicts live in the ops/pallas_kernels docstring):
+  * compact_unstable_rank vs compact_sort — the rank-fused UNSTABLE
+                         compaction (row index as second sort KEY)
+                         that replaced the stable 1-key form in
+                         kernels.compact
+  * slot_expand_dma vs slot_expand_gather — the send-slot block-DMA
+                         kernel vs the D*C-row random-gather form (the
+                         kernel compiles on TPU; elsewhere both sides
+                         measure the same XLA fallback — run this one
+                         on the chip)
+  * pack_sort_unstable vs pack_argsort — the exchange pack pipeline's
+                         sort: unstable (dest, idx) value-carry vs
+                         stable argsort + composed gather.  REJECTED on
+                         cpu (-56% at 262k, BENCH_r06) -> the pack
+                         lowering is gated to the TPU tier
+                         (parallel/shuffle._exchange_one_axis).
+  * packed_gather vs percol_gather — the join output materialization:
+                         one [cap, W] word-matrix gather vs one gather
+                         per column.  REJECTED on cpu (~2x slower at
+                         262k; the stack/unpack copies dominate) -> 
+                         kernels._packed_gather gates to the TPU tier.
+  Rejected WITHOUT shipping anywhere (probe-refuted designs, r06): a
+  pallas MULTI-KEY bitonic sort (wider comparator, identical network —
+  no headroom vs XLA's, same verdict as the 1-key probe above; the
+  multi-key win ships as runtime key-lane FUSION, kernels._sort_fused2)
+  and a per-row-DMA join gather (one async copy per matched row: the
+  descriptor cost >> the ~20 B payload, ~3x worse than the batched XLA
+  gather — the exchange's DMAs stay BLOCK-sized instead).
 """
 
 from __future__ import annotations
@@ -155,6 +185,103 @@ def probe_cumsum_pallas(n: int = 1 << 19) -> dict:
     return {"cumsum_pallas_n": n, "cumsum_pallas_ms": t * 1e3}
 
 
+def probe_compact_unstable_rank(n: int = 1 << 21, W: int = 5) -> dict:
+    """The rank-fused UNSTABLE compaction that replaced compact's stable
+    1-key sort: (drop, row index) is a total order, so the unstable
+    network reproduces the stable result without XLA's stability
+    machinery (same operand set — the index replaces the iota a stable
+    sort materializes internally)."""
+    keep = jnp.asarray((np.random.RandomState(9).rand(n) < 0.5))
+    lanes = [_mk_u32(n, 10 + i) for i in range(W)]
+    iota = jnp.arange(n, dtype=jnp.uint32)
+    vary = jax.jit(lambda a, s: a ^ (s > 0))
+
+    def body(i, kp):
+        out = jax.lax.sort(
+            ((~kp).astype(jnp.uint32), iota) + tuple(lanes),
+            num_keys=2, is_stable=False)
+        return kp ^ (out[2] > 0)
+
+    t = slope_time(body, lambda j: vary(keep, jnp.int32(next(_salt) % 2)),
+                   k_hi=8)
+    return {"compact_unstable_n": n, "compact_unstable_ms": t * 1e3,
+            "compact_unstable_grows_s": n / t / 1e9}
+
+
+def _slot_fixture(n, D, C, W):
+    rng = np.random.RandomState(11)
+    words = jnp.asarray(rng.randint(0, 1 << 30, (n, W)).astype(np.uint32))
+    cuts = np.sort(rng.randint(0, n + 1, D - 1))
+    counts = np.diff(np.concatenate([[0], cuts, [n]])).astype(np.int32)
+    offsets = jnp.asarray((np.cumsum(counts) - counts).astype(np.int32))
+    return words, offsets
+
+
+def probe_slot_expand_dma(n: int = 1 << 20, D: int = 8,
+                          W: int = 4) -> dict:
+    """The shipped send-slot block-DMA kernel (slot_expand).  On
+    non-TPU backends this measures its XLA fallback — compare against
+    probe_slot_expand_gather ON THE CHIP."""
+    from dryad_tpu.ops.pallas_kernels import slot_expand
+    C = -(-2 * n // D)
+    words, offsets = _slot_fixture(n, D, C, W)
+    vary = jax.jit(lambda w, s: w ^ s)
+
+    def body(i, w):
+        send = slot_expand(w, offsets, C)
+        return w ^ (send[:n] & 1)
+
+    t = slope_time(body, lambda j: vary(words, jnp.uint32(next(_salt))),
+                   k_hi=8)
+    return {"slot_expand_dma_n": n, "slot_expand_dma_ms": t * 1e3}
+
+
+def probe_slot_expand_gather(n: int = 1 << 20, D: int = 8,
+                             W: int = 4) -> dict:
+    """The pre-kernel D*C-row random-gather slot expansion."""
+    C = -(-2 * n // D)
+    words, offsets = _slot_fixture(n, D, C, W)
+    d_idx = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
+    j_idx = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
+    vary = jax.jit(lambda w, s: w ^ s)
+
+    def body(i, w):
+        src = jnp.clip(jnp.take(offsets, d_idx) + j_idx, 0, n - 1)
+        send = jnp.take(w, src, axis=0)
+        return w ^ (send[:n] & 1)
+
+    t = slope_time(body, lambda j: vary(words, jnp.uint32(next(_salt))),
+                   k_hi=8)
+    return {"slot_expand_gather_n": n, "slot_expand_gather_ms": t * 1e3}
+
+
+def probe_packed_gather(n: int = 1 << 20, W: int = 5) -> dict:
+    """One [n, W] word-matrix gather (the join's packed output
+    materialization, TPU tier) vs one gather per column."""
+    lanes = [_mk_u32(n, 20 + i) for i in range(W)]
+    idx = jnp.asarray(
+        np.random.RandomState(21).randint(0, n, n).astype(np.int32))
+    vary = jax.jit(lambda ix, s: (ix + s) % n)
+
+    def packed(i, ix):
+        w = jnp.stack(lanes, axis=1)
+        g = jnp.take(w, ix, axis=0)
+        return (ix + (g.sum(dtype=jnp.uint32) & 1)).astype(jnp.int32) % n
+
+    def percol(i, ix):
+        tot = jnp.zeros((), jnp.uint32)
+        for ln in lanes:
+            tot = tot + jnp.take(ln, ix).sum(dtype=jnp.uint32)
+        return (ix + (tot & 1)).astype(jnp.int32) % n
+
+    tp = slope_time(packed, lambda j: vary(idx, jnp.int32(next(_salt))),
+                    k_hi=16)
+    tc = slope_time(percol, lambda j: vary(idx, jnp.int32(next(_salt))),
+                    k_hi=16)
+    return {"packed_gather_n": n, "packed_gather_ms": tp * 1e3,
+            "percol_gather_ms": tc * 1e3}
+
+
 def run_all() -> dict:
     out = {}
     for name, fn in [("sort", probe_sort_stages),
@@ -162,6 +289,10 @@ def run_all() -> dict:
                      ("hist_sort", probe_hist_sort),
                      ("hist_pallas", probe_hist_pallas),
                      ("compact_sort", probe_compact_sort),
+                     ("compact_unstable", probe_compact_unstable_rank),
+                     ("slot_expand_dma", probe_slot_expand_dma),
+                     ("slot_expand_gather", probe_slot_expand_gather),
+                     ("packed_gather", probe_packed_gather),
                      ("cumsum_xla", probe_cumsum_xla),
                      ("cumsum_pallas", probe_cumsum_pallas)]:
         try:

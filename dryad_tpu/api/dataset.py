@@ -807,12 +807,16 @@ class Dataset:
         unique-keyed (lookup/dimension table) and routes matching through
         the gather-free merge-fill kernel (ops/kernels._lookup_join).
         Uniqueness itself is runtime-verified (duplicates fall back to
-        the general kernel in the same compiled program), but MATCHING on
-        that path is by 64-bit key hash ONLY — two distinct keys
-        agreeing in all 64 hash bits would mis-join, a ~n^2/2^-64
-        probability budget (the same one group_by/distinct document).
-        The default path compares true key bytes; keep right_unique off
-        for adversarially constructed keys."""
+        the general kernel in the same compiled program).  When both
+        sides' key columns pack to the SAME lane layout (same dtype /
+        string max_len — the common case), matches are byte-verified
+        against the carried key lanes, exactly like the default path;
+        when the layouts differ (e.g. an i32 key joined to an i64
+        column) verification falls back to the 64-bit key hash pair —
+        two distinct keys agreeing in all 64 hash bits would mis-join,
+        a ~n^2/2^64 probability budget (the same one group_by/distinct
+        document).  Keep right_unique off for adversarially constructed
+        keys with mismatched key dtypes."""
         return Dataset(self.ctx, E.Join(
             parents=(self.node, other.node), left_keys=tuple(left_keys),
             right_keys=tuple(right_keys or left_keys),

@@ -185,6 +185,10 @@ class Run:
         bad: Dict[int, tuple] = {}
         for rec, info in zip(deferred, infos):
             stage = rec["stage"]
+            # exchange slot feedback rides the batched fetch: the next
+            # run of each stage (iterative supersteps, re-collects, and
+            # the overflow replay below) ships measured exact slots
+            self.ex._note_slot_feedback(stage, info)
             need_scale = int(info[:, 0].max())
             need_slack = int(info[:, 1].max())
             need_exch = int(info[:, 2].max())

@@ -20,6 +20,7 @@ Key idioms:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Sequence, Tuple
 
 import jax
@@ -241,6 +242,12 @@ def _sort_segments_dense(key_lane: jax.Array, valid: jax.Array, n_valid,
     return skey, sorted_vals, is_start, is_end, num_groups
 
 
+# multi-key sorts with exactly two u32 key lanes runtime-fuse them into
+# ONE lane when the measured lane spans allow (span_a * span_b <= 2^32);
+# both lowerings live in one lax.cond, so the gate bounds the doubled
+# sort-program size (XLA unrolls sort networks — see _VALOPS_MAX_ELEMS)
+_SORT_FUSE_MAX_CAP = 1 << 21
+
 # value-carry beats lexsort+gather until the packed row is so wide that
 # carrying it through every compare-exchange pass costs more than one
 # ~9 ns/row random gather (measured crossover ~32 words = 128 B/row)
@@ -280,15 +287,65 @@ def _sort_carrying(key_lanes, value_lanes, cap: int, stable: bool = True):
             [g[:, j] for j in range(len(value_lanes))])
 
 
+def _sort_fused2(lanes: List[jax.Array], packed: List[jax.Array],
+                 cap: int):
+    """Runtime key-lane fusion for 2-key-lane sorts (multi-key sort key
+    packing): when the VALID rows' lane spans satisfy
+    span_a * span_b <= 2^32, the two lex lanes collapse into ONE fused
+    lane ``(la - la_min) * span_b + (lb - lb_min)`` — the sort network's
+    cost is linear in operands (measured, see sort_by_columns), so the
+    fused program runs one comparator lane where the general one runs
+    two.  The spans are runtime values, so the choice is a lax.cond
+    between the two lowerings (the _group_aggregate_smallkey pattern);
+    wide-span inputs pay two tiny reductions and ride the general path.
+    ``lanes`` is [invalid, la, lb]; returns the same
+    ([sinv, sla, slb], svals) structure either way (the fused branch
+    rebuilds the sorted lanes from the fused lane — exact for valid
+    rows; invalid rows' lanes are garbage both ways and every caller
+    masks them)."""
+    inv, la, lb = lanes
+    valid = inv == 0
+    big = jnp.uint32(0xFFFFFFFF)
+    zero = jnp.uint32(0)
+    la_min = jnp.min(jnp.where(valid, la, big))
+    la_max = jnp.max(jnp.where(valid, la, zero))
+    lb_min = jnp.min(jnp.where(valid, lb, big))
+    lb_max = jnp.max(jnp.where(valid, lb, zero))
+    any_valid = valid.any()
+    span_a = la_max - la_min + 1
+    span_b = lb_max - lb_min + 1
+    # fused max = span_a*span_b - 1 must fit u32; the conservative test
+    # span_a <= big // span_b never wraps (off by < span_b rows)
+    ok = (any_valid & (la_max >= la_min) & (lb_max >= lb_min)
+          & (span_a != 0) & (span_b != 0)
+          & (span_a <= big // jnp.maximum(span_b, 1)))
+
+    def fused(args):
+        inv, la, lb, packed = args
+        f = (la - la_min) * span_b + (lb - lb_min)
+        (sinv, sf), svals = _sort_carrying([inv, f], list(packed), cap)
+        sla = sf // span_b + la_min
+        slb = sf % span_b + lb_min
+        return [sinv, sla, slb], list(svals)
+
+    def general(args):
+        inv, la, lb, packed = args
+        skeys, svals = _sort_carrying([inv, la, lb], list(packed), cap)
+        return list(skeys), list(svals)
+
+    return jax.lax.cond(ok, fused, general, (inv, la, lb, tuple(packed)))
+
+
 def permute_by_sort(batch: Batch, key_lanes: Sequence[jax.Array],
-                    count=None) -> Batch:
-    """Stably sort the batch's rows by the given uint32 key lanes (most
-    significant first), moving ALL columns as packed value operands of one
-    variadic lax.sort — zero random gathers.  Falls back to
-    lexsort+single-packed-gather for very wide rows."""
+                    count=None, stable: bool = True) -> Batch:
+    """Sort the batch's rows by the given uint32 key lanes (most
+    significant first; stable by default), moving ALL columns as packed
+    value operands of one variadic lax.sort — zero random gathers.
+    Falls back to lexsort+single-packed-gather for very wide rows."""
     lanes, spec = _pack_columns_u32(dict(batch.columns))
     new_count = batch.count if count is None else count
-    _, svals = _sort_carrying(list(key_lanes), lanes, batch.capacity)
+    _, svals = _sort_carrying(list(key_lanes), lanes, batch.capacity,
+                              stable=stable)
     return Batch(_unpack_columns_u32(svals, spec), new_count)
 
 
@@ -298,10 +355,22 @@ def permute_by_sort(batch: Batch, key_lanes: Sequence[jax.Array],
 
 def compact(batch: Batch, keep: jax.Array) -> Batch:
     """Move rows where ``keep`` (and valid) to the front, preserving order.
-    One stable value-carry sort of the "drop" bool (keepers first)."""
+
+    Rank-fused UNSTABLE value-carry sort: the row index rides as a
+    second sort KEY, so (drop, index) is a total order — the unstable
+    network produces exactly the stable compaction without paying XLA's
+    stable-sort machinery (measured ~2x on the same operand set; the
+    index operand replaces the iota a stable sort materializes
+    internally anyway).  ``DRYAD_NO_SORT_OPT=1`` restores the stable
+    1-key form (A/B lever for benchmarks/pallas_probe provenance)."""
     keep = keep & batch.valid_mask()
-    return permute_by_sort(batch, ((~keep).astype(jnp.uint32),),
-                           count=keep.sum(dtype=jnp.int32))
+    n_keep = keep.sum(dtype=jnp.int32)
+    if os.environ.get("DRYAD_NO_SORT_OPT"):
+        return permute_by_sort(batch, ((~keep).astype(jnp.uint32),),
+                               count=n_keep)
+    iota = jnp.arange(batch.capacity, dtype=jnp.uint32)
+    return permute_by_sort(batch, ((~keep).astype(jnp.uint32), iota),
+                           count=n_keep, stable=False)
 
 
 def filter_rows(batch: Batch, predicate) -> Batch:
@@ -461,6 +530,10 @@ def sort_by_columns(batch: Batch, keys: Sequence[Tuple[str, bool]]) -> Batch:
     key + i32 payload) this halves the variadic sort from 8 operands
     (3 key lanes + 5 packed) to 4 (3 key lanes + payload), and the sort
     network's cost is linear in operands (measured ~2x end-to-end).
+    Two-key-lane sorts additionally RUNTIME-fuse their lanes into one
+    when the measured spans fit 32 bits (_sort_fused2 — multi-key key
+    packing; e.g. two small-span ints, or an i64 whose values span
+    < 2^32), dropping another comparator lane.
     Reference role: the vertex sorter reads each record once
     (DryadVertex/.../recorditem.cpp:1-1140); carrying a second copy of the
     key bytes through every compare-exchange pass has no analogue there.
@@ -493,7 +566,20 @@ def sort_by_columns(batch: Batch, keys: Sequence[Tuple[str, bool]]) -> Batch:
         base = 1
     carry_cols = {k: v for k, v in batch.columns.items() if k not in recon}
     packed, spec = _pack_columns_u32(carry_cols)
-    skeys, svals = _sort_carrying(lanes, packed, batch.capacity)
+    from dryad_tpu.ops.pallas_kernels import pallas_active
+    if (base == 1 and len(lanes) == 3
+            and batch.capacity <= _SORT_FUSE_MAX_CAP
+            and pallas_active() is not None
+            and not os.environ.get("DRYAD_NO_SORT_OPT")):
+        # multi-key sort key packing: two key lanes runtime-fuse into
+        # one when the measured spans allow (see _sort_fused2).  The
+        # comparator-lane cost model is the TPU sort network's (cost
+        # linear in operands); on cpu the fusion measured a wash
+        # (BENCH_kernels r06), so it rides the same backend tier as the
+        # pallas kernels.
+        skeys, svals = _sort_fused2(lanes, packed, batch.capacity)
+    else:
+        skeys, svals = _sort_carrying(lanes, packed, batch.capacity)
     cols = _unpack_columns_u32(svals, spec)
     valid_sorted = jnp.arange(batch.capacity, dtype=jnp.int32) < batch.count
     for name, (off, cnt, desc) in recon.items():
@@ -1632,6 +1718,33 @@ def _keys_equal(a: Batch, a_idx, a_names, b: Batch, b_idx, b_names) -> jax.Array
     return eq
 
 
+def _packed_gather(cols: Dict[str, Any], idx: jax.Array) -> Dict[str, Any]:
+    """Gather rows of several columns with ONE fused word-matrix gather:
+    pack the columns to u32 lanes, take the stacked [cap, W] matrix
+    once, unpack.  TPU random gathers pay a per-ROW cost (~10.7 ns
+    measured, benchmarks/pallas_probe), so fetching each output row's
+    whole packed payload in one gather beats one gather per column —
+    the join probe's dominant cost (probe + verify + gather fuse into
+    one program around this).  The per-row-cost model is TPU-specific:
+    on cpu the stack/unpack copies made the packed form ~2x SLOWER
+    (BENCH_kernels r06 join_gather at 262k rows), so other backends
+    keep one take per column — the same backend tier gating the pallas
+    kernels (force_interpret() routes tests through the packed form)."""
+    from dryad_tpu.ops.pallas_kernels import pallas_active
+    if pallas_active() is None:
+        out: Dict[str, Any] = {}
+        for k, v in cols.items():
+            out[k] = v.gather(idx) if isinstance(v, StringColumn) \
+                else jnp.take(v, idx, axis=0)
+        return out
+    lanes, spec = _pack_columns_u32(cols)
+    if not lanes:
+        return {}
+    w = jnp.stack(lanes, axis=1)
+    g = jnp.take(w, idx, axis=0)
+    return _unpack_columns_u32([g[:, j] for j in range(len(lanes))], spec)
+
+
 def _join_out_names(left: Batch, right: Batch, right_keys, suffix: str):
     """Output column name plan shared by both join lowerings (the
     lax.cond pair must produce identical pytrees)."""
@@ -1662,11 +1775,17 @@ def _lookup_join(left: Batch, right: Batch, left_keys: Sequence[str],
     at most one right per segment, everything else contributes zero), and
     compact the left rows.  Zero gathers.
 
-    Match verification is the 64-bit hash pair itself (two distinct keys
-    colliding in all 64 bits mis-join — the same ~n^2/2^64 budget every
-    hash group documents); the caller-facing ``right_unique`` path
-    RUNTIME-verifies uniqueness and falls back to the general kernel,
-    which also covers hash-collision-induced apparent duplicates.
+    Match verification: when the two sides' key columns pack to the SAME
+    u32 lane layout (same dtype / string max_len — the common case), the
+    right row's packed key lanes ride the fill and each left row
+    byte-compares them against its own carried key lanes, so a 64-bit
+    hash collision is caught exactly like the general kernel's
+    _keys_equal.  When the layouts differ (e.g. joining an i32 key to an
+    i64 key column), verification falls back to the 64-bit hash pair
+    itself — the same ~n^2/2^64 budget every hash group documents.  The
+    caller-facing ``right_unique`` path also RUNTIME-verifies right-side
+    uniqueness and falls back to the general kernel on duplicates
+    (covering hash-collision-induced apparent duplicates).
     """
     lhi, llo = hash_batch_keys(left, left_keys)
     rhi, rlo = hash_batch_keys(right, right_keys)
@@ -1688,12 +1807,37 @@ def _lookup_join(left: Batch, right: Batch, left_keys: Sequence[str],
     rmap = _join_out_names(left, right, right_keys, suffix)
     rpack, rspec = _pack_columns_u32(
         {name: right.columns[k] for k, name in rmap})
+    # byte verification (carried packed key lanes): only when both
+    # sides' key columns pack identically — offsets of the left key
+    # lanes within lpack, and the right keys packed under the left
+    # names so the specs are directly comparable
+    loff: Dict[str, Tuple[int, Tuple]] = {}
+    off = 0
+    for entry in lspec:
+        loff[entry[0]] = (off, entry[1:])
+        off += entry[3]
+    vpack: List[jax.Array] = []
+    lkey_lane_idx: List[int] = []
+    vlanes, vspec = _pack_columns_u32(
+        {ln: right.columns[rn]
+         for ln, rn in zip(left_keys, right_keys)})
+    verify = (len(set(left_keys)) == len(left_keys)
+              and len(vspec) == len(left_keys)
+              and all(ln in loff and loff[ln][1] == entry[1:]
+                      for ln, entry in zip(left_keys, vspec)))
+    if verify:
+        vpack = vlanes
+        for ln, entry in zip(left_keys, vspec):
+            o = loff[ln][0]
+            lkey_lane_idx.extend(range(o, o + entry[3]))
+    nv = len(vpack)
     zl = jnp.zeros((cr,), jnp.uint32)
     zr = jnp.zeros((cl,), jnp.uint32)
     lanes = [jnp.concatenate([l, zl]) for l in lpack]
     nr = len(rpack)
     lanes += [jnp.concatenate([zr, r]) for r in rpack]
     lanes.append(jnp.concatenate([zr, rvalid.astype(jnp.uint32)]))
+    lanes += [jnp.concatenate([zr, v]) for v in vpack]
 
     skeys, sl = _sort_carrying([hi, lo, side], lanes, n, stable=False)
     shi, slo, sside = skeys
@@ -1701,12 +1845,20 @@ def _lookup_join(left: Batch, right: Batch, left_keys: Sequence[str],
     is_start, _is_end, _ng = _segment_flags(
         _lane_differs(shi, slo), n_valid)
 
-    # forward-fill the right payload + presence within each key segment:
-    # one fused multi-scan of max ops (<=1 right per segment, zeros
-    # elsewhere, so max IS the fill)
-    fill_in = [(sl[len(lpack) + j], jnp.maximum) for j in range(nr + 1)]
+    # forward-fill the right payload + presence (+ the verify key lanes)
+    # within each key segment: one fused multi-scan of max ops (<=1
+    # right per segment, zeros elsewhere, so max IS the fill)
+    fill_in = [(sl[len(lpack) + j], jnp.maximum)
+               for j in range(nr + 1 + nv)]
     filled = _seg_scan_multi(fill_in, is_start) if fill_in else []
-    present = filled[-1] > 0
+    present = filled[nr] > 0
+    if verify:
+        # byte-equality of the filled right key lanes vs each left
+        # row's own carried key lanes — exact collision rejection
+        eq = jnp.ones((n,), jnp.bool_)
+        for j, li in enumerate(lkey_lane_idx):
+            eq = eq & (filled[nr + 1 + j] == sl[li])
+        present = present & eq
 
     idx = jnp.arange(n, dtype=jnp.int32)
     is_left = (sside == 1) & (idx < n_valid)
@@ -1715,7 +1867,8 @@ def _lookup_join(left: Batch, right: Batch, left_keys: Sequence[str],
 
     out_lanes = list(sl[:len(lpack)])
     for j in range(nr):
-        # unmatched left rows (how="left") zero-fill the right columns
+        # unmatched (or collision-rejected) left rows zero-fill the
+        # right columns (how="left")
         out_lanes.append(jnp.where(present, filled[j], 0))
     _, dl = _sort_carrying([(~keep).astype(jnp.uint32)], out_lanes, n)
 
@@ -1799,7 +1952,14 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
     # sort right by hash, invalid last.  The sorted batch is never
     # materialized: every sorted-row access composes the permutation
     # (order) with its index — one full-batch gather saved per join.
-    order = jnp.lexsort((rh, (~rvalid).astype(jnp.uint32)))
+    # (invalid, rh, iota) rides ONE unstable 3-key sort: the iota is
+    # both the tiebreak (deterministic candidate order) and the
+    # permutation payload — the same operand set lexsort's stable
+    # machinery pays for, without the stability passes.
+    _, _, order = jax.lax.sort(
+        ((~rvalid).astype(jnp.uint32), rh,
+         jnp.arange(right.capacity, dtype=jnp.int32)),
+        num_keys=3, is_stable=False)
     rkey = jnp.take(rh, order)
     # mark invalid rows with sentinel max keys so searchsorted excludes them;
     # valid rows hashing to the sentinel just become extra candidates.
@@ -1839,30 +1999,21 @@ def hash_join(left: Batch, right: Batch, left_keys: Sequence[str],
         synth_slot = slot_valid & jnp.take(synth_row, lid_c)
         keep = keep | synth_slot
 
-    out_cols = {}
-    for k, v in left.columns.items():
-        out_cols[k] = v.gather(lid_c) if isinstance(v, StringColumn) \
-            else jnp.take(v, lid_c, axis=0)
+    # one packed gather per side (probe + verify + gather fused around
+    # it — see _packed_gather) instead of one random gather per column
+    out_cols = _packed_gather(dict(left.columns), lid_c)
     rkeyset = set(right_keys)
+    rpayload = {}
     for k, v in right.columns.items():
         if k in rkeyset:
             continue
         name = k if k not in out_cols else k + suffix
-        if isinstance(v, StringColumn):
-            g = v.gather(rid_abs)
-            if left_synth:
-                z = synth_slot
-                g = StringColumn(
-                    jnp.where(z[:, None], 0, g.data),
-                    jnp.where(z, 0, g.lengths))
-            out_cols[name] = g
-        else:
-            g = jnp.take(v, rid_abs, axis=0)
-            if left_synth:
-                z = synth_slot.reshape(
-                    synth_slot.shape + (1,) * (g.ndim - 1))
-                g = jnp.where(z, 0, g)
-            out_cols[name] = g
+        rpayload[name] = v
+    for name, g in _packed_gather(rpayload, rid_abs).items():
+        if left_synth:
+            # unmatched left rows zero-fill the right columns
+            g = _mask_rows(g, ~synth_slot)
+        out_cols[name] = g
     # compaction by value-carry sort, not argsort+gather: the full-batch
     # gather alone measured ~22 ms at 400k rows x 5 columns
     joined = Batch(out_cols, jnp.asarray(out_capacity, jnp.int32))

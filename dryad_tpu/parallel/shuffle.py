@@ -21,6 +21,7 @@ DrDynamicDistributionManager).
 
 from __future__ import annotations
 
+import os
 from typing import Sequence, Tuple
 
 import jax
@@ -28,7 +29,10 @@ import jax.numpy as jnp
 
 from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.ops.hashing import hash_batch_keys
-from dryad_tpu.ops.kernels import sort_lanes_for
+from dryad_tpu.ops.kernels import (_pack_columns_u32, _unpack_columns_u32,
+                                   _sort_carrying, sort_lanes_for)
+from dryad_tpu.ops.pallas_kernels import (hist_buckets, pallas_active,
+                                          slot_compact, slot_expand)
 from dryad_tpu.parallel.mesh import PARTITION_AXIS
 
 __all__ = ["exchange_by_dest", "hash_exchange", "range_exchange",
@@ -72,6 +76,80 @@ def _exchange_one_axis(batch: Batch, dest: jax.Array, axis: str,
     else:
         C = max(1, min(cap, -(-send_slack * cap // D)))
 
+    if os.environ.get("DRYAD_NO_SORT_OPT") or pallas_active() is None:
+        # the pack pipeline is shaped for the TPU data plane (tile
+        # histogram + value-carry sort + block-DMA slot expansion); on
+        # backends where the slot kernels don't engage it measured ~3x
+        # SLOWER than the gather lowering (cpu, BENCH_kernels r06:
+        # XLA's stable argsort + composed gather wins there), so
+        # non-TPU backends keep the plain-XLA form — the module
+        # contract's fallback tier.  force_interpret() routes tests
+        # through the pack path on CPU.
+        return _exchange_one_axis_gather(batch, dest, axis, out_capacity,
+                                         C, all_axes)
+
+    # PACK: one tile-histogram for the per-destination counts (pallas —
+    # XLA's bincount lowers to sort+segment machinery, measured 72x
+    # slower at 2M), one UNSTABLE value-carry sort by (dest, row index)
+    # moving every column's packed u32 words (the index operand makes
+    # the unstable network exactly stable — no stable-sort machinery),
+    # then slot expansion as D dynamic-offset block DMAs
+    # (pallas_kernels.slot_expand): each destination's run is CONTIGUOUS
+    # in the sorted buffer, so the send grid is block copies, not the
+    # fallback's D*C-row random gather.
+    lanes, spec = _pack_columns_u32(dict(batch.columns))
+    counts = hist_buckets(dest, D)                      # full counts [D]
+    offsets = jnp.cumsum(counts) - counts               # exclusive prefix
+    iota = jnp.arange(cap, dtype=jnp.uint32)
+    _, slanes = _sort_carrying([dest.astype(jnp.uint32), iota], lanes,
+                               cap, stable=False)
+    words = jnp.stack(slanes, axis=1)                   # [cap, W] u32
+    send_words = slot_expand(words, offsets.astype(jnp.int32), C)
+    send_counts = jnp.minimum(counts, C)
+
+    # ONE all_to_all moves the whole packed matrix (the per-column form
+    # issued one collective per column, two per StringColumn)
+    recv_words = jax.lax.all_to_all(send_words, axis, 0, 0, tiled=True)
+    recv_counts = jax.lax.all_to_all(send_counts, axis, 0, 0, tiled=True)
+
+    # UNPACK: the valid rows of each received source block are a prefix,
+    # so compaction is D more block DMAs (pallas_kernels.slot_compact)
+    # instead of a stable valid-first sort + gather
+    # every sender clamped its send_counts to C already
+    total = recv_counts.sum(dtype=jnp.int32)
+    out_words = slot_compact(recv_words, recv_counts, C, out_capacity)
+    W = len(slanes)
+    out = Batch(_unpack_columns_u32(
+        [out_words[:, j] for j in range(W)], spec),
+        jnp.minimum(total, out_capacity))
+
+    # measured requirements (pre-truncation, so they are exact even when
+    # this run dropped rows): true rows per destination over this axis...
+    totals = jax.lax.psum(counts, axis)  # [D], same on every shard
+    max_total = jnp.max(totals).astype(jnp.int32)
+    need_recv = jnp.where(max_total > out_capacity, max_total, 0)
+    # ...and the send-slot slack that would have fit the largest slot
+    max_cnt = jnp.max(counts).astype(jnp.int32)
+    need_slack_l = jnp.where(max_cnt > C, -(-max_cnt * D // cap), 0)
+    # any shard's shortfall poisons the whole exchange
+    need_recv = jax.lax.pmax(need_recv, all_axes)
+    need_slack = jax.lax.pmax(need_slack_l, all_axes)
+    slot_used = jax.lax.pmax(max_cnt, all_axes)
+    return out, need_recv, need_slack, slot_used
+
+
+def _exchange_one_axis_gather(batch: Batch, dest: jax.Array, axis: str,
+                              out_capacity: int, C: int, all_axes: tuple
+                              ) -> Tuple[Batch, jax.Array, jax.Array,
+                                         jax.Array]:
+    """The pre-kernel exchange lowering (stable dest argsort + composed
+    random gather + per-column all_to_all + stable valid-sort unpack) —
+    kept verbatim behind ``DRYAD_NO_SORT_OPT`` as the A/B reference for
+    benchmarks/pallas_probe provenance and as a belt-and-braces escape
+    hatch."""
+    D = jax.lax.axis_size(axis)
+    cap = batch.capacity
+
     order = jnp.argsort(dest, stable=True)
     sdest = jnp.take(dest, order)
     counts = jnp.bincount(jnp.minimum(sdest, D), length=D + 1)[:D]
@@ -109,15 +187,11 @@ def _exchange_one_axis(batch: Batch, dest: jax.Array, axis: str,
         out = recv.gather(perm[:out_capacity])
     out = out.with_count(jnp.minimum(total, out_capacity))
 
-    # measured requirements (pre-truncation, so they are exact even when
-    # this run dropped rows): true rows per destination over this axis...
     totals = jax.lax.psum(counts, axis)  # [D], same on every shard
     max_total = jnp.max(totals).astype(jnp.int32)
     need_recv = jnp.where(max_total > out_capacity, max_total, 0)
-    # ...and the send-slot slack that would have fit the largest slot
     max_cnt = jnp.max(counts).astype(jnp.int32)
     need_slack_l = jnp.where(max_cnt > C, -(-max_cnt * D // cap), 0)
-    # any shard's shortfall poisons the whole exchange
     need_recv = jax.lax.pmax(need_recv, all_axes)
     need_slack = jax.lax.pmax(need_slack_l, all_axes)
     slot_used = jax.lax.pmax(max_cnt, all_axes)
@@ -411,7 +485,12 @@ def broadcast_gather(batch: Batch, out_capacity: int,
     rvalid = jj < jnp.take(counts, s_idx)
     total = rvalid.sum(dtype=jnp.int32)
     merged = Batch(cols, total)
-    perm = jnp.argsort(~rvalid, stable=True)
+    # unstable 2-key sort (valid flag, row index): stable-equivalent
+    # order without the stable machinery (see ops/kernels.compact)
+    _, perm = jax.lax.sort(
+        ((~rvalid).astype(jnp.uint32),
+         jnp.arange(D * cap, dtype=jnp.int32)),
+        num_keys=2, is_stable=False)
     if out_capacity >= D * cap:
         out = merged.gather(perm).pad_to(out_capacity)
         need = jnp.zeros((), jnp.int32)

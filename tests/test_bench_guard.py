@@ -1,0 +1,109 @@
+"""Committed-roofline regression guard + kernel-smoke wiring.
+
+Two jobs:
+  * hold the COMMITTED device-truth rows to a no-regression bar: any
+    future ``BENCH_r*.json`` with a ``device_truth`` section (the r06+
+    format) must not lose >20% relative on a comparable row (same
+    metric, same backend) vs the best previously committed round.  The
+    legacy r03-r05 wrappers (driver-captured stdout tails, v5e-tunnel
+    backend) carry no parseable device_truth section and a different
+    chip — they are documented baselines, not comparable rows.
+  * keep ``bench.py --smoke-kernels`` runnable as a fast pytest so the
+    kernel A/B rows (and the measured-slot wire arithmetic) can't rot
+    between full captures.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REL_TOL = 0.20          # fail on >20% relative regression
+
+
+def _committed_rounds():
+    """[(round_tag, backend, {metric: value})] for r06+ format files."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))):
+        try:
+            doc = json.load(open(path))
+        except Exception:
+            continue
+        dt = doc.get("device_truth")
+        if not isinstance(dt, dict):
+            continue          # legacy wrapper (r01-r05) — not comparable
+        rows = {k: v for k, v in dt.items()
+                if isinstance(v, (int, float))
+                and ("roofline" in k or "utilization" in k
+                     or "rows_per_s" in k or "speedup" in k)}
+        out.append((os.path.basename(path), doc.get("backend", "?"),
+                    rows))
+    return out
+
+
+def test_committed_device_truth_no_regression():
+    rounds = _committed_rounds()
+    assert rounds, "no BENCH_r*.json with a device_truth section"
+    failures = []
+    for i, (tag, backend, rows) in enumerate(rounds):
+        for key, val in rows.items():
+            prev = [r[key] for t, b, r in rounds[:i]
+                    if b == backend and key in r]
+            if not prev:
+                continue
+            best = max(prev)
+            # all guarded metrics are higher-is-better (pcts, rates);
+            # negative provenance rows (losing designs, kept for the
+            # record) are exempt — they document a gate, not a target
+            if best <= 0:
+                continue
+            if val < (1.0 - _REL_TOL) * best:
+                failures.append(
+                    f"{tag} [{backend}] {key}: {val} < 80% of "
+                    f"best committed {best}")
+    assert not failures, "\n".join(failures)
+
+
+def test_r06_device_truth_shape():
+    """The committed r06 capture carries the rows the round claims:
+    measured-slot wire utilization beats the structural-slack wave, and
+    at least two quotable device-truth improvements are positive."""
+    doc = json.load(open(os.path.join(_REPO, "BENCH_r06.json")))
+    dt = doc["device_truth"]
+    w1 = dt["wire_utilization_inmem_wave1_structural_pct"]
+    w2 = dt["wire_utilization_inmem_wave2_measured_pct"]
+    assert w2 > w1, (w1, w2)
+    positives = [k for k, v in dt.items()
+                 if k.endswith("speedup_pct") and v > 0]
+    assert len(positives) + (1 if w2 > w1 else 0) >= 2, dt
+
+
+@pytest.mark.slow
+def test_smoke_kernels_runs(tmp_path, monkeypatch):
+    """bench.py --smoke-kernels end-to-end at toy size: every row
+    present and finite, wire utilization improves wave-1 -> wave-2, and
+    the trend record lands."""
+    import bench
+
+    monkeypatch.setenv("BENCH_KERNEL_ROWS", "8192")
+    monkeypatch.setenv("BENCH_KERNEL_KLO", "2")
+    monkeypatch.setenv("BENCH_KERNEL_KHI", "6")
+    monkeypatch.setenv("BENCH_KERNEL_COPY_MB", "16")
+    monkeypatch.setenv("BENCH_TREND_PATH", str(tmp_path / "trend.jsonl"))
+    out = bench.smoke_kernels(
+        out_path=str(tmp_path / "BENCH_kernels.json"), quiet=True)
+    rows = out["rows"]
+    for name in ("multikey_sort", "exchange_pack", "exchange_unpack",
+                 "join_gather"):
+        assert np.isfinite(rows[name]["new_s"]), name
+        assert rows[name]["new_s"] >= 0, name
+    wu = rows["wire_utilization_inmem"]
+    assert wu["exchange_legs"] >= 2
+    assert wu["wave2_measured_pct"] > wu["wave1_structural_pct"]
+    trend = [json.loads(ln) for ln in
+             open(tmp_path / "trend.jsonl").read().splitlines()]
+    assert trend and trend[-1]["app"] == "bench-kernels"

@@ -1,0 +1,188 @@
+"""Exact send slots in the in-memory executor (ARCHITECTURE Known-limit
+#5): multi-exchange stages ship the exchanges' own measured slot
+feedback after wave 1 (no structural slack factor in the stage key), and
+iterative jobs issue ZERO probe host-syncs after the first wave — the
+ADVICE probe-slot fix (cache per stage fingerprint + reuse the
+exchange's own feedback)."""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context
+from dryad_tpu.exec.executor import Executor, _quantize_slot_rows
+from dryad_tpu.utils.config import JobConfig
+
+
+def _spy_slot_hints(monkeypatch, record):
+    orig = Executor._slot_hints
+
+    def spy(self, stage, inputs, slack, salted):
+        hints = orig(self, stage, inputs, slack, salted)
+        record.append((stage.label,
+                       [leg.exchange.kind if leg.exchange else None
+                        for leg in stage.legs], hints))
+        return hints
+
+    monkeypatch.setattr(Executor, "_slot_hints", spy)
+
+
+def _count_probes(monkeypatch):
+    orig = Executor._probe_slot_rows
+    calls = []
+
+    def spy(self, pd, keys, slack):
+        calls.append(tuple(keys))
+        return orig(self, pd, keys, slack)
+
+    monkeypatch.setattr(Executor, "_probe_slot_rows", spy)
+    return calls
+
+
+def _join_query(ctx, k1, v1, k2, v2):
+    left = (ctx.from_columns({"k": k1, "v": v1})
+            .where(lambda c: c["v"] >= 0))
+    right = (ctx.from_columns({"k": k2, "w": v2})
+             .where(lambda c: c["w"] >= 0))
+    return left.join(right, ["k"])
+
+
+def test_multi_exchange_stage_ships_measured_slots(monkeypatch):
+    """A join stage whose BOTH legs carry ops (so the counts-only probe
+    cannot run) ships structural slack on wave 1 and the exchanges' own
+    measured slots — per leg — on wave 2, with identical results."""
+    rng = np.random.RandomState(0)
+    n = 8_192
+    k1 = rng.randint(0, 500, n).astype(np.int32)
+    v1 = rng.randint(0, 1 << 20, n).astype(np.int32)
+    k2 = np.arange(500, dtype=np.int32)
+    v2 = rng.randint(0, 1 << 20, 500).astype(np.int32)
+
+    record = []
+    _spy_slot_hints(monkeypatch, record)
+    probes = _count_probes(monkeypatch)
+    ctx = Context(config=JobConfig(exchange_probe_min_mb=1e9))
+    q = _join_query(ctx, k1, v1, k2, v2)
+    out1 = q.collect()
+    mark = len(record)
+    out2 = q.collect()
+
+    def join_stages(recs):
+        return [(label, kinds, hints) for label, kinds, hints in recs
+                if sum(k is not None for k in kinds) >= 2]
+
+    wave1 = join_stages(record[:mark])
+    wave2 = join_stages(record[mark:])
+    assert wave1 and wave2
+    # wave 1 FIRST attempt: legs have ops and the probe threshold is
+    # sky-high -> no hints, structural slack (the true discovery wave).
+    # A capacity RETRY within wave 1 may already carry feedback hints —
+    # the retry's info fetch happened, and riding it is the point.
+    assert wave1[0][2] == (), wave1
+    # wave 2: EVERY exchange leg hinted from the wave-1 slot feedback —
+    # no probe ran (the threshold gates only the probe, not feedback)
+    for _label, kinds, hints in wave2:
+        assert hints != ()
+        for li, kind in enumerate(kinds):
+            if kind in ("hash", "range"):
+                assert hints[li] is not None, (kinds, hints)
+    assert probes == []
+    # identical results: slot sizing changes wire bytes, never rows
+    a = sorted(zip(out1["k"].tolist(), out1["v"].tolist(),
+                   out1["w"].tolist()))
+    b = sorted(zip(out2["k"].tolist(), out2["v"].tolist(),
+                   out2["w"].tolist()))
+    assert a == b
+
+
+def test_feedback_slots_cover_measured_need(monkeypatch):
+    """The quantized feedback hint is always >= the measured slot need
+    (never truncates a steady-state wave) and well under the structural
+    slack slot for a balanced exchange."""
+    rng = np.random.RandomState(1)
+    n = 8_192
+    k = rng.randint(0, 10_000, n).astype(np.int32)
+    v = rng.randint(0, 100, n).astype(np.int32)
+
+    ctx = Context(config=JobConfig(exchange_probe_min_mb=1e9))
+    q = (ctx.from_columns({"k": k, "v": v})
+         .where(lambda c: c["v"] >= 0)       # leg op: probe can't run
+         .hash_partition(["k"])
+         .group_by(["k"], {"s": ("sum", "v")}))
+    q.collect()
+    ex = ctx.executor
+    assert ex._slot_feedback, "no slot feedback recorded"
+    D = ex.nparts
+    for (_fp, _li), slot in ex._slot_feedback.items():
+        hint = _quantize_slot_rows(slot)
+        assert hint >= slot
+        assert hint <= 2 * slot + 16
+    # balanced keys: measured slots are ~cap/D; the structural discovery
+    # slot is slack*cap/D = 2x that — wave 2 halves the wire
+    out = q.collect()
+    assert int(np.asarray(out["s"]).shape[0]) > 0
+
+
+def test_iterative_zero_probe_syncs_after_wave1(monkeypatch):
+    """A do_while whose body repartitions every superstep: the probe
+    (forced on with min_mb=0) may sync on wave 1 only; every later
+    superstep rides the exchanges' own slot feedback."""
+    rng = np.random.RandomState(2)
+    n = 4_096
+    k = rng.randint(0, 1_000, n).astype(np.int32)
+    v = np.ones(n, np.int32)
+
+    probes = _count_probes(monkeypatch)
+    ctx = Context(config=JobConfig(exchange_probe_min_mb=0.0))
+    # 2x capacity headroom: the body's repartition must preserve
+    # per-partition capacity (do_while contract) even under key skew
+    init = ctx.from_columns({"k": k, "v": v}, capacity=1024)
+    out = ctx.do_while(init,
+                       lambda d: d.hash_partition(["k"]),
+                       n_iters=5).collect()
+    assert sorted(out["k"].tolist()) == sorted(k.tolist())
+    n_wave1 = len(probes)
+    assert n_wave1 <= 2, probes   # init + first body wave at most
+    # re-run the whole loop: stage fingerprints are identical, the
+    # feedback survives in the executor -> zero NEW probes
+    ctx.do_while(init, lambda d: d.hash_partition(["k"]),
+                 n_iters=5).collect()
+    assert len(probes) == n_wave1, probes
+
+
+def test_probe_disabled_master_switch(monkeypatch):
+    """exchange_probe_min_mb < 0 disables BOTH the probe and the
+    feedback path (the structural-slack A/B reference), with identical
+    results."""
+    rng = np.random.RandomState(3)
+    n = 4_096
+    k = rng.randint(0, 300, n).astype(np.int32)
+    v = rng.randint(0, 50, n).astype(np.int32)
+
+    record = []
+    _spy_slot_hints(monkeypatch, record)
+
+    def run(min_mb):
+        ctx = Context(config=JobConfig(exchange_probe_min_mb=min_mb))
+        q = (ctx.from_columns({"k": k, "v": v})
+             .hash_partition(["k"])
+             .group_by(["k"], {"n": ("count", None)}))
+        q.collect()
+        return q.collect()
+
+    out_off = run(-1.0)
+    off_hints = [h for _l, _k, h in record]
+    assert all(h == () for h in off_hints)
+    record.clear()
+    out_on = run(0.0)
+    assert any(h != () for _l, _k, h in record)
+    a = sorted(zip(out_off["k"].tolist(), out_off["n"].tolist()))
+    b = sorted(zip(out_on["k"].tolist(), out_on["n"].tolist()))
+    assert a == b
+
+
+@pytest.mark.parametrize("slot,lo", [(1, 16), (15, 16), (17, 32),
+                                     (1000, 1000), (100_000, 100_000)])
+def test_quantize_slot_rows(slot, lo):
+    q = _quantize_slot_rows(slot)
+    assert q >= slot and q >= lo
+    assert q <= max(2 * slot, 16)

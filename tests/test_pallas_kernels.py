@@ -9,7 +9,8 @@ import pytest
 import jax.numpy as jnp
 
 from dryad_tpu.ops.pallas_kernels import (force_interpret, hist_buckets,
-                                          pallas_active, prefix_sum)
+                                          pallas_active, prefix_sum,
+                                          slot_compact, slot_expand)
 
 
 def _modes():
@@ -439,3 +440,271 @@ def test_exact_first_wave_probe_equivalence():
     b = run(0.0)
     for c in ("k", "n", "s"):
         np.testing.assert_array_equal(a[c], b[c])
+
+
+# ---------------------------------------------------------------------------
+# exchange pack/unpack: slot_expand / slot_compact
+
+
+def _oracle_expand(words, offsets, counts, C):
+    D = len(offsets)
+    out = np.zeros((D * C, words.shape[1]), np.uint32)
+    for d in range(D):
+        c = min(int(counts[d]), C)
+        out[d * C:d * C + c] = words[int(offsets[d]):int(offsets[d]) + c]
+    return out
+
+
+def _slot_layouts(rng, cap, D):
+    """Adversarial count layouts: balanced cuts (incl. empty runs), the
+    all-one-bucket skew, and sparse partial fills."""
+    cuts = np.sort(rng.randint(0, cap + 1, D - 1))
+    balanced = np.diff(np.concatenate([[0], cuts, [cap]]))
+    skew = np.zeros(D, np.int64)
+    skew[rng.randint(D)] = cap
+    sparse = rng.randint(0, max(cap // D, 1) + 1, D)
+    return [balanced, skew, sparse]
+
+
+@pytest.mark.parametrize("mode", _modes())
+def test_slot_expand_matches_oracle(mode):
+    """Valid slots (j < counts[d]) of every destination block equal the
+    dest-sorted run — including runs starting past cap-C (the last
+    destination of a FULL buffer: a start down-clamp would ship another
+    destination's rows) and empty runs."""
+    rng = np.random.RandomState(10)
+    for D, C, cap, W in [(4, 16, 64, 3), (8, 8, 96, 1), (2, 32, 32, 4),
+                         (5, 16, 61, 2)]:   # 61: non-multiple length
+        for counts in _slot_layouts(rng, cap, D):
+            counts = counts.astype(np.int32)
+            offsets = (np.cumsum(counts) - counts).astype(np.int32)
+            words = rng.randint(0, 1 << 30, (cap, W)).astype(np.uint32)
+            ref = _oracle_expand(words, offsets, counts, C)
+            got = np.asarray(_run(mode, lambda: slot_expand(
+                jnp.asarray(words), jnp.asarray(offsets), C)))
+            for d in range(D):
+                c = min(int(counts[d]), C)
+                assert (got[d * C:d * C + c] ==
+                        ref[d * C:d * C + c]).all(), (D, C, cap, d)
+
+
+@pytest.mark.parametrize("mode", _modes())
+def test_slot_compact_matches_oracle(mode):
+    """The first min(total, out_rows) rows are the concatenated valid
+    prefixes of the source blocks — exact truncation when out_rows <
+    total, zero-extended Batch padding contract past the total."""
+    rng = np.random.RandomState(11)
+    for D, C, W in [(4, 16, 2), (8, 8, 1), (3, 32, 3)]:
+        for trial in range(4):
+            counts = np.minimum(rng.randint(0, C + 1, D), C) \
+                .astype(np.int32)
+            if trial == 1:
+                counts[:] = 0
+                counts[rng.randint(D)] = C      # one full block
+            recv = rng.randint(0, 1 << 30, (D * C, W)).astype(np.uint32)
+            total = int(counts.sum())
+            dense = (np.concatenate(
+                [recv[s * C:s * C + counts[s]] for s in range(D)])
+                if total else np.zeros((0, W), np.uint32))
+            for out_rows in {max(total, C), total + C,
+                             max(total - 3, C), C}:
+                got = np.asarray(_run(mode, lambda: slot_compact(
+                    jnp.asarray(recv), jnp.asarray(counts), C,
+                    out_rows)))
+                m = min(total, out_rows)
+                assert (got[:m] == dense[:m]).all(), \
+                    (D, C, trial, out_rows)
+
+
+@pytest.mark.parametrize("mode", _modes())
+def test_slot_roundtrip(mode):
+    """expand -> (block transpose = simulated all_to_all) -> compact
+    round-trips every row to the right destination, D x D shards."""
+    rng = np.random.RandomState(12)
+    D, C, cap, W = 4, 16, 64, 2
+    shard_words, shard_counts, shard_offsets = [], [], []
+    for _s in range(D):
+        counts = _slot_layouts(rng, cap, D)[2].astype(np.int32)
+        offsets = (np.cumsum(counts) - counts).astype(np.int32)
+        shard_counts.append(counts)
+        shard_offsets.append(offsets)
+        shard_words.append(
+            rng.randint(0, 1 << 30, (cap, W)).astype(np.uint32))
+    sends = [np.asarray(_run(mode, lambda: slot_expand(
+        jnp.asarray(shard_words[s]), jnp.asarray(shard_offsets[s]), C)))
+        for s in range(D)]
+    for d in range(D):   # receiver d gets block d of every sender
+        recv = np.concatenate([sends[s][d * C:(d + 1) * C]
+                               for s in range(D)])
+        rc = np.array([min(int(shard_counts[s][d]), C)
+                       for s in range(D)], np.int32)
+        got = np.asarray(_run(mode, lambda: slot_compact(
+            jnp.asarray(recv), jnp.asarray(rc), C, cap)))
+        ref = np.concatenate(
+            [shard_words[s][shard_offsets[s][d]:
+                            shard_offsets[s][d] + rc[s]]
+             for s in range(D)])
+        assert (got[:len(ref)] == ref).all(), d
+
+
+def test_exchange_pack_ab_mixed_dtypes():
+    """End-to-end A/B: the packed-sort + slot-DMA exchange lowering
+    (force_interpret routes it onto this CPU backend, real kernel
+    bodies) vs the pre-kernel gather lowering (the non-TPU default,
+    also DRYAD_NO_SORT_OPT=1) produce identical rows through a real
+    repartition + group over a dtype mix (i32 / f32 / i64 / string)."""
+    import os
+    from dryad_tpu import Context
+    from dryad_tpu.utils.config import JobConfig
+
+    rng = np.random.RandomState(13)
+    n = 6_000
+    cols = {
+        "k": rng.randint(0, 700, n).astype(np.int32),
+        "f": rng.rand(n).astype(np.float32),
+        "b": rng.randint(0, 1 << 40, n).astype(np.int64),
+        "s": ["w%d" % (i % 97) for i in range(n)],
+    }
+
+    def run():
+        ctx = Context(config=JobConfig(exchange_probe_min_mb=-1.0))
+        q = (ctx.from_columns(cols)
+             .hash_partition(["k"])
+             .group_by(["k"], {"n": ("count", None), "mx": ("max", "f")}))
+        out = q.collect()
+        order = np.argsort(np.asarray(out["k"]))
+        return {c: np.asarray(out[c])[order] for c in ("k", "n", "mx")}
+
+    assert not os.environ.get("DRYAD_NO_SORT_OPT")
+    with force_interpret():
+        a = run()              # pack path, interpret-mode slot kernels
+    b = run()                  # gather path (non-TPU backend default)
+    np.testing.assert_array_equal(a["k"], b["k"])
+    np.testing.assert_array_equal(a["n"], b["n"])
+    np.testing.assert_allclose(a["mx"], b["mx"], rtol=0, atol=0)
+
+
+def test_group_minmax_nan_lowering_divergence_pinned():
+    """Regression-pins the documented NaN divergence (group_by docstring
+    / group_aggregate NaN note): the scan path's jnp.minimum/maximum
+    PROPAGATE any NaN into both extremes, while the boundary-carry path
+    ranks by IEEE totalOrder (-NaN < -inf < ... < +inf < +NaN), so a
+    +NaN surfaces only as the max and a -NaN only as the min.  NaN-free
+    groups agree exactly either way."""
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as k
+
+    n = 16
+    kcol = np.array([0] * 4 + [1] * 4 + [2] * 4 + [3] * 4, np.int32)
+    v = np.array([1., 2., 3., 4.,
+                  5., np.nan, 7., 8.,        # +NaN in group 1
+                  9., -np.nan, 11., 12.,     # -NaN in group 2
+                  13., 14., 15., 16.], np.float32)
+    b = Batch({"k": jnp.asarray(kcol), "v": jnp.asarray(v)},
+              jnp.asarray(n, jnp.int32))
+    aggs = {"lo": ("min", "v"), "hi": ("max", "v")}
+    ok, mm = k._boundary_eligible(b, aggs)
+    assert ok and mm == "v"
+
+    def rows(out):
+        ng = int(out.count)
+        kk = np.asarray(out.columns["k"])[:ng]
+        o = np.argsort(kk)
+        return (kk[o], np.asarray(out.columns["lo"])[:ng][o],
+                np.asarray(out.columns["hi"])[:ng][o])
+
+    bk, blo, bhi = rows(k._group_aggregate_boundary(b, ["k"], aggs, mm))
+    sk, slo, shi = rows(k._group_aggregate_scan(b, ["k"], aggs))
+    np.testing.assert_array_equal(bk, [0, 1, 2, 3])
+    np.testing.assert_array_equal(sk, [0, 1, 2, 3])
+    # NaN-free groups: exact agreement
+    for arr, want in [(blo, [1., 13.]), (bhi, [4., 16.]),
+                      (slo, [1., 13.]), (shi, [4., 16.])]:
+        np.testing.assert_array_equal([arr[0], arr[3]], want)
+    # boundary (totalOrder): +NaN is only the max, -NaN only the min
+    assert blo[1] == 5.0 and np.isnan(bhi[1])
+    assert np.isnan(blo[2]) and bhi[2] == 12.0
+    # scan (jnp.minimum/maximum): NaN propagates to BOTH extremes
+    assert np.isnan(slo[1]) and np.isnan(shi[1])
+    assert np.isnan(slo[2]) and np.isnan(shi[2])
+
+
+def test_sort_fused2_matches_general_and_oracle():
+    """The runtime key-lane fusion (sort_by_columns 2-key path, TPU
+    tier — force_interpret routes it here) agrees with the general
+    3-lane sort AND a numpy lexsort oracle, over adversarial spans:
+    small spans (fused branch), a span product past 2^32 (the runtime
+    cond falls back INSIDE the compiled fn), negatives, descending,
+    and a short valid prefix."""
+    from dryad_tpu.data.columnar import Batch
+    from dryad_tpu.ops import kernels as k
+
+    rng = np.random.RandomState(14)
+    n = 4_096
+    cases = [
+        (rng.randint(-500, 500, n), rng.randint(0, 1000, n)),    # fused
+        (rng.randint(-(1 << 30), 1 << 30, n),
+         rng.randint(0, 1 << 20, n)),                            # wide
+        (np.zeros(n, np.int64), rng.randint(0, 3, n)),           # ties
+    ]
+    for ci, (a, b) in enumerate(cases):
+        a = a.astype(np.int32 if ci != 2 else np.int64)
+        b = b.astype(np.int32)
+        v = rng.randint(0, 1 << 30, n).astype(np.int32)
+        cnt = n - 13
+        bt = Batch({"a": jnp.asarray(a), "b": jnp.asarray(b),
+                    "v": jnp.asarray(v)}, jnp.asarray(cnt, jnp.int32))
+        keys = [("a", False), ("b", ci == 1)]   # case 1: b descending
+        with force_interpret():
+            fused = k.sort_by_columns(bt, keys)
+        general = k.sort_by_columns(bt, keys)   # cpu tier: 3-lane sort
+        bs = b[:cnt] if ci != 1 else -b[:cnt].astype(np.int64)
+        # stable key-only lexsort: ties keep original order, like the
+        # stable carry sort (v is PAYLOAD, not a tiebreak)
+        order = np.lexsort((bs, a[:cnt]))
+        for name, src in (("a", a), ("b", b), ("v", v)):
+            ref = src[:cnt][order]
+            np.testing.assert_array_equal(
+                np.asarray(fused.columns[name])[:cnt], ref,
+                err_msg=f"case {ci} fused {name}")
+            np.testing.assert_array_equal(
+                np.asarray(general.columns[name])[:cnt], ref,
+                err_msg=f"case {ci} general {name}")
+
+
+def test_hash_join_packed_gather_ab():
+    """hash_join's output materialization: the packed single-gather
+    (TPU tier, force_interpret routes it here) and the per-column
+    gather tier produce identical rows — strings and i64 included."""
+    from dryad_tpu.data.columnar import batch_from_numpy
+    from dryad_tpu.ops import kernels as k
+
+    rng = np.random.RandomState(15)
+    nl, nr = 3_000, 500
+    lk = rng.randint(0, nr + 100, nl).astype(np.int32)   # some unmatched
+    left = batch_from_numpy(
+        {"k": lk,
+         "s": ["L%d" % (i % 53) for i in range(nl)],
+         "big": rng.randint(0, 1 << 40, nl).astype(np.int64)},
+        str_max_len=8)
+    right = batch_from_numpy(
+        {"k": np.arange(nr, dtype=np.int32),
+         "w": rng.rand(nr).astype(np.float32)}, str_max_len=8)
+
+    def rows(out):
+        ng = int(out.count)
+        sc = out.columns["s"]
+        ss = [bytes(np.asarray(sc.data)[i,
+                    :int(np.asarray(sc.lengths)[i])]).decode()
+              for i in range(ng)]
+        return sorted(zip(np.asarray(out.columns["k"])[:ng].tolist(),
+                          ss,
+                          np.asarray(out.columns["big"])[:ng].tolist(),
+                          np.asarray(out.columns["w"])[:ng].tolist()))
+
+    with force_interpret():
+        a, _ = k.hash_join(left, right, ["k"], ["k"], nl)
+        a_rows = rows(a)
+    b, _ = k.hash_join(left, right, ["k"], ["k"], nl)
+    assert a_rows == rows(b)
+    assert len(a_rows) == int((lk < nr).sum())
