@@ -60,7 +60,7 @@ class AdaptiveManager:
     def __init__(self, graph: StageGraph, config, nparts: int,
                  levels: tuple = (),
                  event: Optional[Callable[[dict], None]] = None,
-                 rules=None):
+                 rules=None, cost_report=None):
         self.graph = graph
         self.config = config
         self.nparts = nparts
@@ -68,6 +68,13 @@ class AdaptiveManager:
         self._event = event or (lambda e: None)
         self.rules = list(rules) if rules is not None else default_rules()
         self.stats: Dict[int, StageStats] = {}
+        # static per-stage bounds from the lint gate's cost pass
+        # (analysis/cost.CostReport) — rules consume them as PRIORS for
+        # stages that have not materialized yet (rules.rows_bounds);
+        # None when the cost pass didn't run (lint off), and always
+        # None on worker gangs (driver-side analysis), so gang members
+        # stay mirrored
+        self.cost = cost_report
         self.applied: List[dict] = []   # graph_rewrite payloads, in order
 
     @property
@@ -91,7 +98,8 @@ class AdaptiveManager:
         emit(st.event())
         rw = PlanRewriter(self.graph, executed)
         ctx = RuleContext(rw=rw, stats=self.stats, config=self.config,
-                          nparts=self.nparts, levels=self.levels)
+                          nparts=self.nparts, levels=self.levels,
+                          cost=self.cost)
         from dryad_tpu.obs.metrics import REGISTRY, family_counter
         for rule in self.rules:
             try:

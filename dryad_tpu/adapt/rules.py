@@ -38,9 +38,9 @@ from dryad_tpu.adapt.rewrite import PlanRewriter
 from dryad_tpu.adapt.stats import StageStats
 from dryad_tpu.plan.stages import Exchange, Leg, Stage, StageOp
 
-__all__ = ["ConnectionManager", "RuleContext", "DynamicAggregationTree",
-           "SkewRepartition", "BroadcastManager", "default_rules",
-           "NON_EXPANDING_OPS"]
+__all__ = ["ConnectionManager", "RuleContext", "rows_bounds",
+           "DynamicAggregationTree", "SkewRepartition",
+           "BroadcastManager", "default_rules", "NON_EXPANDING_OPS"]
 
 # op kinds that can only PRESERVE or REDUCE row counts: a producer's
 # measured rows upper-bound the exchange input through any chain of
@@ -81,6 +81,28 @@ class RuleContext:
     config: Any
     nparts: int
     levels: tuple  # ((axis_name, size), ...) innermost first
+    # static per-stage bounds from the pre-submit cost pass
+    # (analysis/cost.CostReport), or None: PRIORS for stages that have
+    # not materialized yet — see :func:`rows_bounds`
+    cost: Any = None
+
+
+def rows_bounds(ctx: RuleContext, sid: int):
+    """(lo, hi) total-row bounds for stage ``sid``: the MEASURED rows
+    when the stage has materialized (exact — lo == hi), else the static
+    cost analyzer's interval as a prior (analysis/cost.py), else None.
+    Rules that needed both join sides measured can act one boundary
+    earlier when the static bound for the other side is tight — the
+    'static plan optimizer seeds the dynamic managers' direction of the
+    reference's DrDynamicBroadcastManager."""
+    st = ctx.stats.get(sid)
+    if st is not None:
+        return (st.total_rows, st.total_rows)
+    if ctx.cost is not None:
+        b = ctx.cost.rows_bounds(sid)
+        if b is not None and b[1] is not None:
+            return (int(b[0]), int(b[1]))
+    return None
 
 
 class ConnectionManager:
@@ -322,6 +344,17 @@ class SkewRepartition(ConnectionManager):
 class BroadcastManager(ConnectionManager):
     name = "broadcast"
 
+    @staticmethod
+    def _cap_of(ctx: RuleContext, sid: int) -> int:
+        """Per-partition capacity of stage ``sid``'s output: measured
+        when available, else the static cost pass's prediction."""
+        st = ctx.stats.get(sid)
+        if st is not None and st.capacity:
+            return st.capacity
+        if ctx.cost is not None:
+            return ctx.cost.capacity_of(sid)
+        return 0
+
     def on_stage_done(self, ctx: RuleContext,
                       st: StageStats) -> List[dict]:
         out: List[dict] = []
@@ -331,20 +364,27 @@ class BroadcastManager(ConnectionManager):
                     or not c.body or c.body[0].kind != "join"):
                 continue
             lsrc, rsrc = c.legs[0].src, c.legs[1].src
-            # act only at the boundary that completed one of OUR inputs,
-            # and only once both sides are measured stages
+            # act only at the boundary that completed one of OUR inputs;
+            # the OTHER side may ride the static cost pass's bounds as a
+            # prior (rows_bounds) instead of waiting to be measured
             if st.stage not in (lsrc, rsrc):
                 continue
-            if not (isinstance(lsrc, int) and isinstance(rsrc, int)
-                    and lsrc in ctx.stats and rsrc in ctx.stats):
+            if not (isinstance(lsrc, int) and isinstance(rsrc, int)):
+                continue
+            lb, rb = rows_bounds(ctx, lsrc), rows_bounds(ctx, rsrc)
+            if lb is None or rb is None:
                 continue
             if not (_non_expanding(c.legs[0].ops)
                     and _non_expanding(c.legs[1].ops)):
                 continue
             jop = c.body[0]
             how = jop.params.get("how", "inner")
-            lt = ctx.stats[lsrc].total_rows
-            rt = ctx.stats[rsrc].total_rows
+            # conservative ends of the intervals: a flip must hold for
+            # EVERY row count the bounds admit (measured sides are
+            # exact, lo == hi)
+            lt_lo, lt_hi = lb
+            rt_lo, rt_hi = rb
+            lt, rt = lt_hi, rt_hi
             lex, rex = c.legs[0].exchange, c.legs[1].exchange
             if rex is not None and rex.kind == "broadcast":
                 # DEMOTE: the "small" side measured past the planner's
@@ -352,7 +392,10 @@ class BroadcastManager(ConnectionManager):
                 # of hash exchanges
                 if how not in ("inner", "left"):
                     continue
-                if rt <= ratio * max(lt, 1):
+                # demotion must hold at the interval ends that FAVOR
+                # keeping the broadcast: certainly-oversized build side
+                # (rt_lo) vs the largest possible probe side (lt_hi)
+                if rt_lo <= ratio * max(lt_hi, 1):
                     continue
                 if getattr(c, "placement_relied", False):
                     out.append({"event": "adapt_skipped",
@@ -363,12 +406,12 @@ class BroadcastManager(ConnectionManager):
                 before = ctx.rw.snapshot(c.id)
                 c.legs[1].exchange = Exchange(
                     "hash", keys=tuple(jop.params["right_keys"]),
-                    out_capacity=ctx.stats[rsrc].capacity
+                    out_capacity=self._cap_of(ctx, rsrc)
                     or _round_cap(rt))
                 if lex is None:
                     c.legs[0].exchange = Exchange(
                         "hash", keys=tuple(jop.params["left_keys"]),
-                        out_capacity=ctx.stats[lsrc].capacity
+                        out_capacity=self._cap_of(ctx, lsrc)
                         or _round_cap(lt))
                 # now the canonical 2-hash inner/left shape: the salted
                 # skew escape applies to it like any planned hash join
@@ -383,11 +426,14 @@ class BroadcastManager(ConnectionManager):
                   and lex is not None and rex is not None
                   and lex.kind == "hash" and rex.kind == "hash"
                   and how in ("inner", "left")):
-                # PROMOTE: measured build side is tiny — replicate it
-                # and keep the probe side IN PLACE (drops the expensive
+                # PROMOTE: the build side is tiny — replicate it and
+                # keep the probe side IN PLACE (drops the expensive
                 # big-side exchange entirely).  salt_ok guarantees no
                 # downstream stage assumed this join's output placement.
-                if not rt or rt > ratio * max(lt, 1):
+                # Conservative ends: the LARGEST possible build side
+                # (rt_hi) must stay within ratio of the SMALLEST
+                # possible probe side (lt_lo).
+                if not rt_hi or rt_hi > ratio * max(lt_lo, 1):
                     continue
                 before = ctx.rw.snapshot(c.id)
                 c.legs[1].exchange = Exchange(

@@ -1,18 +1,94 @@
-"""CLI: lint a serialized plan offline.
+"""CLI: lint serialized plans offline + the analyzer's own selfcheck.
 
 ``python -m dryad_tpu.analysis plan.json`` — run the structural subset of
 the plan verifier over a plan JSON artifact (plan/serialize.graph_to_json
 output, the artifact ``runtime/shiplan.serialize_for_cluster`` ships to
 workers).  Exit code 1 when error-severity findings exist, so CI can gate
 committed plan artifacts.
+
+``--cost`` appends the offline capacity/row cost table
+(analysis/cost.estimate_plan_json: callables and sources are gone from a
+serialized plan, so byte predictions are unavailable — but every
+capacity is structural, so the per-stage capacity/row-bound table still
+computes; size it with ``--nparts``).
+
+``python -m dryad_tpu.analysis --selfcheck`` — one fast gate over the
+analyzer itself: ruff (when installed) / the shared unused-import scan
+(analysis/selflint.py), the generated-docs drift check
+(docs/diagnostics.md vs diagnostics.render_code_table), and an analyzer
+smoke over the committed example plans (docs/plans/*.json).  Wired as a
+tier-1 pytest so analyzer rot is caught the day it lands.
 """
 
 from __future__ import annotations
 
 import argparse
+import pathlib
+import shutil
+import subprocess
 import sys
 
 from dryad_tpu.analysis import check_plan_json
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def _selfcheck() -> int:
+    from dryad_tpu.analysis.cost import estimate_plan_json
+    from dryad_tpu.analysis.diagnostics import render_code_table
+    from dryad_tpu.analysis.selflint import scan_package
+    failures = []
+
+    ruff = shutil.which("ruff")
+    if ruff is not None:
+        proc = subprocess.run(
+            [ruff, "check", "--no-cache", "dryad_tpu"], cwd=str(_REPO),
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            failures.append(f"ruff:\n{proc.stdout}{proc.stderr}")
+        else:
+            print("ruff: clean")
+    else:
+        print("ruff: not installed — AST fallback only")
+    findings = scan_package()
+    if findings:
+        failures.append("unused imports:\n" + "\n".join(findings))
+    else:
+        print("selflint (unused imports): clean")
+
+    docs = _REPO / "docs" / "diagnostics.md"
+    if not docs.exists():
+        failures.append(f"{docs}: missing (regenerate with "
+                        f"--selfcheck --write-docs)")
+    elif docs.read_text() != render_code_table():
+        failures.append(
+            f"{docs}: stale vs diagnostics.CODES — regenerate with "
+            f"`python -m dryad_tpu.analysis --selfcheck --write-docs`")
+    else:
+        print("docs/diagnostics.md: in sync with diagnostics.CODES")
+
+    plans = sorted((_REPO / "docs" / "plans").glob("*.json"))
+    plan_failures = []
+    if not plans:
+        plan_failures.append(f"{_REPO / 'docs' / 'plans'}: no committed "
+                             f"example plans to smoke the analyzer over")
+    for p in plans:
+        js = p.read_text()
+        rep = check_plan_json(js)
+        if rep.errors:
+            plan_failures.append(f"{p.name}: unexpected error "
+                                 f"findings:\n" + rep.render())
+        cost = estimate_plan_json(js, nparts=8)
+        if not cost.stages or not any(s.capacity for s in cost.stages):
+            plan_failures.append(f"{p.name}: offline cost pass produced "
+                                 f"no capacity table")
+    if plans and not plan_failures:
+        print(f"analyzer smoke: {len(plans)} committed plan(s) ok")
+    failures.extend(plan_failures)
+
+    for f in failures:
+        print(f"SELFCHECK FAILURE: {f}", file=sys.stderr)
+    return 1 if failures else 0
 
 
 def main(argv=None) -> int:
@@ -20,12 +96,35 @@ def main(argv=None) -> int:
         prog="python -m dryad_tpu.analysis",
         description="statically lint a serialized dryad_tpu plan "
                     "(graph_to_json / shiplan output)")
-    ap.add_argument("plan", help="plan JSON path ('-' for stdin)")
+    ap.add_argument("plan", nargs="?",
+                    help="plan JSON path ('-' for stdin)")
     ap.add_argument("--stream", action="store_true",
                     help="the plan will execute over cluster streams "
                          "(store_stream sources): apply the streamed-"
                          "mode op rules")
+    ap.add_argument("--cost", action="store_true",
+                    help="append the offline per-stage capacity/row "
+                         "cost table (analysis/cost.py)")
+    ap.add_argument("--nparts", type=int, default=1,
+                    help="partition count for --cost row bounds "
+                         "(default 1)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="lint the analyzer itself: ruff/selflint, "
+                         "docs drift, committed-plan smoke")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="with --selfcheck: (re)generate "
+                         "docs/diagnostics.md from diagnostics.CODES")
     args = ap.parse_args(argv)
+    if args.selfcheck:
+        if args.write_docs:
+            from dryad_tpu.analysis.diagnostics import render_code_table
+            out = _REPO / "docs" / "diagnostics.md"
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_text(render_code_table())
+            print(f"wrote {out}")
+        return _selfcheck()
+    if args.plan is None:
+        ap.error("a plan path is required (or --selfcheck)")
     if args.plan == "-":
         plan_json = sys.stdin.read()
     else:
@@ -33,6 +132,11 @@ def main(argv=None) -> int:
             plan_json = f.read()
     report = check_plan_json(plan_json, stream=args.stream)
     print(report.render())
+    if args.cost:
+        from dryad_tpu.analysis.cost import estimate_plan_json
+        print()
+        print(estimate_plan_json(plan_json,
+                                 nparts=args.nparts).render())
     return 1 if report.errors else 0
 
 

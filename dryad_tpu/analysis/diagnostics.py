@@ -12,6 +12,8 @@ asserts the mapping.
 Code space:
 * DTA0xx — plan verifier (structural rules over the logical Node DAG)
 * DTA1xx — UDF lint (determinism / shippability of user callables)
+* DTA2xx — cost & resource analyzer (analysis/cost.py: abstract
+  interpretation over the lowered plan; pre-submit OOM/spill forecasts)
 * DTA9xx — runtime-only conditions (data-dependent overflows, internal
   invariants, worker-side deploy errors) that no static rule can predict
 """
@@ -51,6 +53,18 @@ CODES = {
     "DTA102": "object-identity dependence in UDF (id()/salted hash())",
     "DTA103": "set-iteration-order dependence in UDF",
     "DTA104": "UDF mutates captured state",
+    "DTA105": "UDF closes over a device array / large ndarray constant "
+              "(ships the bytes with every task envelope)",
+    # -- cost & resource analyzer (DTA2xx) ---------------------------------
+    "DTA200": "cost analyzer internal failure — cost pass skipped",
+    "DTA201": "predicted per-device footprint provably exceeds "
+              "device_hbm_bytes",
+    "DTA202": "predicted per-device footprint may exceed "
+              "device_hbm_bytes (predicted spill)",
+    "DTA203": "unbounded fan-out reaches an exchange (buffer sized "
+              "blind)",
+    "DTA204": "cache() of edge-scale data that should be streamed",
+    "DTA205": "per-stage predicted cost summary",
     # -- runtime-only (DTA9xx) ---------------------------------------------
     "DTA901": "internal: op kind cannot ride a wave program",
     "DTA902": "internal: unknown exchange kind in streamed plan",
@@ -149,6 +163,36 @@ class DiagnosticReport:
     def codes(self) -> set:
         return {d.code for d in self.diagnostics}
 
+    def dedup(self) -> "DiagnosticReport":
+        """Collapse findings that differ only by the consumer path that
+        reached them: a construct consumed by N Tee'd branches (e.g. a
+        pinned repartition feeding two group_bys) used to be reported
+        once PER PATH — identical findings now report once, annotated
+        with the path count.  The message is part of the identity: two
+        DIFFERENT defects at the same span (e.g. id() and hash() on one
+        UDF line) must both survive.  In place; returns self for
+        chaining."""
+        seen: dict = {}
+        order = []
+        for d in self.diagnostics:
+            key = (d.code, d.severity, d.span, d.node, d.message)
+            if key in seen:
+                seen[key].append(d)
+            else:
+                seen[key] = [d]
+                order.append(key)
+        out: List[Diagnostic] = []
+        for key in order:
+            group = seen[key]
+            d = group[0]
+            if len(group) > 1:
+                d = dataclasses.replace(
+                    d, message=f"{d.message} [x{len(group)} consumer "
+                               f"paths]")
+            out.append(d)
+        self.diagnostics = out
+        return self
+
     def by_code(self, code: str) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.code == code]
 
@@ -160,6 +204,41 @@ class DiagnosticReport:
         n_i = len(self.diagnostics) - n_e - n_w
         lines.append(f"{n_e} error(s), {n_w} warning(s), {n_i} info")
         return "\n".join(lines)
+
+
+_CODE_FAMILIES = (
+    ("DTA0", "plan verifier (structural rules over the logical DAG)"),
+    ("DTA1", "UDF lint (determinism / shippability / capture)"),
+    ("DTA2", "cost & resource analyzer (pre-submit OOM/spill "
+             "forecasts)"),
+    ("DTA9", "runtime-only (no static rule can predict these)"),
+)
+
+
+def render_code_table() -> str:
+    """The DTA code table as markdown, generated from :data:`CODES` —
+    ``docs/diagnostics.md`` is this function's output verbatim
+    (drift-tested by ``python -m dryad_tpu.analysis --selfcheck``), so
+    a new code cannot ship undocumented."""
+    lines = [
+        "# Diagnostic codes (DTA)",
+        "",
+        "<!-- GENERATED from dryad_tpu/analysis/diagnostics.py::CODES"
+        " by `python -m dryad_tpu.analysis --selfcheck --write-docs`;"
+        " do not edit by hand — the selfcheck drift-gates this file."
+        " -->",
+        "",
+    ]
+    for prefix, family in _CODE_FAMILIES:
+        lines.append(f"**{family}**")
+        lines.append("")
+        lines.append("| Code | Meaning |")
+        lines.append("|---|---|")
+        for code in sorted(c for c in CODES if c.startswith(prefix)):
+            meaning = " ".join(CODES[code].split())
+            lines.append(f"| `{code}` | {meaning} |")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
 
 
 class DiagnosticError(RuntimeError):

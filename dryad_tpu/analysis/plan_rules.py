@@ -194,6 +194,17 @@ def _rule_stream_unsupported(c: PlanCheck) -> List[Diagnostic]:
 
 
 def _rule_capacity_hazard(c: PlanCheck) -> List[Diagnostic]:
+    """DTA010, severity by whether the runtime can right-size the hazard
+    from MEASUREMENT: a non-broadcast join's legs are hash exchanges —
+    eligible for the r06 measured-slot machinery (the first-wave
+    counts probe and the per-leg slot feedback,
+    exec/executor._slot_hints) — and any fan-out op inside a do_while
+    body re-runs with measured needs after wave 1, so the analyzer
+    downgrades to info there instead of contradicting the exact-slot
+    machinery.  First-wave-only hazards (flat_map / cross_apply /
+    broadcast join in a one-shot job) keep warn: their only escape is
+    the blind overflow-retry ladder."""
+    has_loop = any(isinstance(n, E.Placeholder) for n in c.nodes)
     out = []
     for n in c.nodes:
         if not isinstance(n, (E.FlatMap, E.CrossApply, E.Join)):
@@ -205,11 +216,16 @@ def _rule_capacity_hazard(c: PlanCheck) -> List[Diagnostic]:
                               "capacity",
                 E.Join: "join output capacity is expansion x left "
                         "capacity"}[type(n)]
+        measured = has_loop or (isinstance(n, E.Join)
+                                and not n.broadcast_right)
+        sev = "info" if measured else "warn"
+        note = (" (measured-slot feedback right-sizes this leg after "
+                "the first wave)" if measured else "")
         out.append(Diagnostic(
-            "DTA010", "info",
+            "DTA010", sev,
             f"{what}; overflow triggers measured capacity retries — "
             f"bound it with .with_capacity() when the fan-out is known "
-            f"(required inside do_while bodies)",
+            f"(required inside do_while bodies)" + note,
             _span(n), _node_label(n)))
     return out
 
@@ -448,7 +464,7 @@ RULES: List[Rule] = [
 # codes a static rule can emit (the drift test checks runtime raise sites
 # against this set ∪ RUNTIME_ONLY_CODES)
 STATIC_RULE_CODES = frozenset(
-    {r.code for r in RULES} | {"DTA102", "DTA103", "DTA104"})
+    {r.code for r in RULES} | {"DTA102", "DTA103", "DTA104", "DTA105"})
 
 
 def check_plan(root: E.Node, cluster: bool = False,
@@ -462,4 +478,7 @@ def check_plan(root: E.Node, cluster: bool = False,
     report = DiagnosticReport()
     for rule in RULES:
         report.diagnostics.extend(rule.fn(check))
-    return report
+    # identical findings reached via several Tee'd consumer paths (e.g.
+    # a pinned repartition feeding two group_bys) collapse to one record
+    # with a consumer count
+    return report.dedup()

@@ -13,6 +13,9 @@ constructs that break replay:
 * iteration over sets — order varies across processes (DTA103)
 * mutation of captured (closure/global) state — replays observe
   different values (DTA104)
+* capture of a device array / large ndarray constant — the bytes ship
+  with EVERY task envelope that references the UDF, and a captured
+  device buffer pins a specific process's device memory (DTA105)
 
 Shippability (the reference's serializable-expression constraint,
 QueryParser.cs:100 `assembly!class.method` entries) is checked by
@@ -47,6 +50,33 @@ _SEEDED_CTORS = (".RandomState", ".default_rng", ".Random", ".PRNGKey",
 _MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
              "pop", "popitem", "remove", "discard", "clear", "sort",
              "reverse"}
+# a captured HOST ndarray at or above this many bytes is "large" for
+# DTA105 (it re-serializes into every task envelope); device arrays are
+# flagged at ANY size — a captured device buffer additionally pins the
+# driver process's device memory into the program
+DTA105_NDARRAY_BYTES = 64 << 10
+
+
+def _captured_payload(v) -> Optional[str]:
+    """Why a captured value is heavyweight for shipping, or None.
+    Duck-typed so jax need not be importable: a jax.Array exposes
+    ``.device`` / ``.sharding``; a numpy ndarray exposes ``.nbytes``
+    without either."""
+    if v is None or isinstance(v, (int, float, str, bytes, bool)):
+        return None
+    nbytes = getattr(v, "nbytes", None)
+    if nbytes is None:
+        return None
+    if hasattr(v, "sharding") or hasattr(v, "device_buffer"):
+        return (f"a device array ({int(nbytes)} bytes) — the buffer "
+                f"transfers to host and re-ships with every task "
+                f"envelope; pass it through the query as a dataset "
+                f"(broadcast()/cross_apply) instead")
+    if int(nbytes) >= DTA105_NDARRAY_BYTES:
+        return (f"a {int(nbytes)}-byte ndarray constant — it "
+                f"re-serializes into every task envelope; load it "
+                f"worker-side or pass it as a broadcast dataset")
+    return None
 
 
 def fn_def_site(fn: Callable) -> Optional[Span]:
@@ -107,11 +137,45 @@ class _UdfVisitor(ast.NodeVisitor):
         self.findings: List[Tuple[str, str, int]] = []  # (code, msg, line)
         code = getattr(fn, "__code__", None)
         self.freevars = set(code.co_freevars) if code else set()
+        # params + locally-assigned names compile to LOAD_FAST — a local
+        # shadowing a module-level array captures nothing
+        self.local_names = set(code.co_varnames) if code else set()
         # captured globals that are MUTABLE containers: mutating them in a
         # UDF leaks state across replays/partitions
         self.mutable_globals = {
             name for name, v in getattr(fn, "__globals__", {}).items()
             if isinstance(v, (list, dict, set, bytearray))}
+        # concrete captured VALUES for the payload lint (DTA105): closure
+        # cells by freevar name; referenced globals resolve lazily
+        self._globals = getattr(fn, "__globals__", {})
+        self.captured_values = {}
+        clo = getattr(fn, "__closure__", None) or ()
+        if code is not None:
+            for name, cell in zip(code.co_freevars, clo):
+                try:
+                    self.captured_values[name] = cell.cell_contents
+                except ValueError:   # not yet filled (recursive def)
+                    pass
+        self._payload_flagged: set = set()
+
+    # -- heavyweight captures (DTA105) ------------------------------------
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) \
+                and node.id not in self._payload_flagged \
+                and node.id not in self.local_names:
+            if node.id in self.captured_values:
+                v = self.captured_values[node.id]
+            elif node.id in self.freevars:
+                v = None
+            else:
+                v = self._globals.get(node.id)
+            why = _captured_payload(v)
+            if why is not None:
+                self._payload_flagged.add(node.id)
+                self._flag("DTA105",
+                           f"closes over {node.id!r}: {why}", node)
+        self.generic_visit(node)
 
     def _flag(self, code: str, msg: str, node: ast.AST) -> None:
         self.findings.append((code, msg, getattr(node, "lineno", 1)))

@@ -113,17 +113,43 @@ class Context:
 
     # -- pre-submit static analysis (dryad_tpu/analysis) --------------------
 
-    def _pre_submit_lint(self, node, cluster: bool) -> None:
+    def _pre_submit_lint(self, node, cluster: bool, graph=None):
         """JobConfig.lint gate: verify the plan + lint its UDFs BEFORE any
         executor/cluster work starts (the reference's phase-1 static
         validation point, DryadLinqQueryGen.cs).  "warn" logs findings to
         the EventLog; "error" refuses to submit on error-severity
-        findings (analysis.LintError)."""
+        findings (analysis.LintError).
+
+        With ``graph`` (the already-planned StageGraph — planning is
+        deterministic, so it matches what the executor runs) the static
+        COST pass also runs (analysis/cost.py): per-stage row/byte
+        predictions from real source statistics, DTA2xx OOM/spill
+        forecasts against ``JobConfig.device_hbm_bytes``, and a
+        ``cost_report`` event whose machine-readable payload the
+        executor cross-checks at runtime (``cost_model_miss``).
+        Returns the CostReport (or None)."""
         mode = getattr(self.config, "lint", "off")
         if mode == "off":
-            return
+            return None
         from dryad_tpu.analysis import LintError, check_plan
         report = check_plan(node, cluster=cluster, fn_table=self.fn_table)
+        cost_rep = None
+        if graph is not None:
+            from dryad_tpu.analysis.cost import (cost_diagnostics,
+                                                 estimate_graph)
+            try:
+                cost_rep = estimate_graph(graph, self.nparts,
+                                          config=self.config)
+                report.diagnostics.extend(
+                    cost_diagnostics(cost_rep, self.config))
+            except Exception as e:
+                # the cost model must never turn a runnable job into a
+                # crashed one — skip it loudly (DTA200) and submit
+                cost_rep = None
+                report.add("DTA200", "info",
+                           f"cost analyzer failed ({e!r}) — cost pass "
+                           f"skipped", node="cost")
+        report.dedup()
         ev = self._event_log
         if ev is not None:
             for d in report:
@@ -131,8 +157,12 @@ class Context:
                     "severity": d.severity, "message": d.message,
                     "node": d.node,
                     "span": str(d.span) if d.span else None})
+            if cost_rep is not None:
+                ev({"event": "cost_report",
+                    "report": cost_rep.to_payload()})
         if mode == "error" and report.errors:
             raise LintError(report)
+        return cost_rep
 
     # -- cluster submission -------------------------------------------------
 
@@ -149,11 +179,13 @@ class Context:
         releases from dropped cached Datasets piggyback on every job."""
         from dryad_tpu.runtime import ClusterJobError, WorkerFailure
         from dryad_tpu.runtime.shiplan import serialize_for_cluster
-        if lint:
-            self._pre_submit_lint(node, cluster=True)
         graph = plan_query(node, self.nparts, hosts=self.hosts,
                            levels=self.levels,
                            config=self.config)
+        if lint:
+            # plan first so the lint gate's cost pass sees the lowered
+            # graph (pure host work — still zero cluster resources)
+            self._pre_submit_lint(node, cluster=True, graph=graph)
         plan_json, specs = serialize_for_cluster(graph, self.fn_table)
         # route worker events to THIS context's logger for the duration of
         # the job (several Contexts may share one cluster)
@@ -892,6 +924,13 @@ class Dataset:
             node = E.Source(parents=(), data=None,
                             _npartitions=self.ctx.nparts, host=t)
             return Dataset(self.ctx, node)
+        if not self._streaming():
+            # DTA204: cache() pins the result in device memory for the
+            # Context's lifetime — warn pre-materialization when the
+            # predicted bytes are edge-scale vs device_hbm_bytes (the
+            # streamed cache path below spools to a store instead, so
+            # it is exempt by construction)
+            self._warn_cache_cost()
         part = self.node.partitioning
         if self.ctx.cluster is not None:
             # materialize cluster-resident: later queries ship only the
@@ -926,6 +965,32 @@ class Dataset:
             part = E.Partitioning.none()
         return self.ctx.from_pdata(pd, partitioning=part)
 
+    def _warn_cache_cost(self) -> None:
+        """Emit the DTA204 edge-scale-cache warning (lint-gated, best
+        effort — a cost-model failure must never block a cache())."""
+        if getattr(self.ctx.config, "lint", "off") == "off" \
+                or not getattr(self.ctx.config, "device_hbm_bytes", 0) \
+                or self.ctx._event_log is None:
+            # no sink to surface the finding: skip the (planning +
+            # eval_shape) estimate instead of computing and dropping it
+            return
+        try:
+            from dryad_tpu.analysis.cost import (cache_diagnostic,
+                                                 estimate_query)
+            rep = estimate_query(self.node, self.ctx.nparts,
+                                 hosts=self.ctx.hosts,
+                                 levels=self.ctx.levels,
+                                 config=self.ctx.config)
+            d = cache_diagnostic(rep, self.ctx.config)
+        except Exception:
+            return
+        if d is not None and self.ctx._event_log is not None:
+            self.ctx._event_log(
+                {"event": "lint_finding", "code": d.code,
+                 "severity": d.severity, "message": d.message,
+                 "node": d.node,
+                 "span": str(d.span) if d.span else None})
+
     # -- terminals ---------------------------------------------------------
 
     def _streaming(self) -> bool:
@@ -938,20 +1003,22 @@ class Dataset:
         """Plan with ONE logical partition and execute over chunk streams
         (exec/stream_exec.py); returns the lazy output ChunkSource."""
         from dryad_tpu.exec.stream_exec import run_stream_graph
-        self.ctx._pre_submit_lint(self.node, cluster=False)
         graph = plan_query(self.node, 1, hosts=1, config=self.ctx.config)
+        self.ctx._pre_submit_lint(self.node, cluster=False, graph=graph)
         return run_stream_graph(graph, self.ctx.config,
                                 spill_dir=self.ctx.spill_dir,
                                 event_log=self.ctx.executor._event
                                 if self.ctx.executor else None)
 
     def _materialize(self) -> PData:
-        self.ctx._pre_submit_lint(self.node, cluster=False)
         graph = plan_query(self.node, self.ctx.nparts,
                            hosts=self.ctx.hosts,
                            levels=self.ctx.levels,
                            config=self.ctx.config)
-        pd = self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir)
+        cost_rep = self.ctx._pre_submit_lint(self.node, cluster=False,
+                                             graph=graph)
+        pd = self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir,
+                                   cost_report=cost_rep)
         # runtime hot-key salting — and adaptive broadcast flips
         # (dryad_tpu/adapt) — change the OUTPUT PLACEMENT: any
         # partitioning claim persisted from this materialization
@@ -1110,25 +1177,52 @@ class Dataset:
 
     # -- static analysis ---------------------------------------------------
 
-    def check(self, cluster: Optional[bool] = None):
+    def check(self, cluster: Optional[bool] = None,
+              cost: bool = False):
         """Statically verify this query — plan rules + UDF determinism/
         shippability lint — WITHOUT executing anything (the reference's
         phase-1 validation, DryadLinqQueryGen.cs, as a user call).
         Returns an ``analysis.DiagnosticReport`` with every finding at
         once (stable DTA0xx/DTA1xx codes, source spans).  ``cluster``
         forces the cluster-shipping rules on/off; default: whether this
-        Context targets a cluster."""
+        Context targets a cluster.  ``cost=True`` adds the DTA2xx
+        resource findings (analysis/cost.py abstract interpretation —
+        still zero execution: schemas propagate via jax.eval_shape)."""
         from dryad_tpu.analysis import check_plan
         if cluster is None:
             cluster = self.ctx.cluster is not None
-        return check_plan(self.node, cluster=cluster,
-                          fn_table=self.ctx.fn_table)
+        report = check_plan(self.node, cluster=cluster,
+                            fn_table=self.ctx.fn_table)
+        if cost:
+            from dryad_tpu.analysis.cost import (cost_diagnostics,
+                                                 estimate_query)
+            rep = estimate_query(self.node, self.ctx.nparts,
+                                 hosts=self.ctx.hosts,
+                                 levels=self.ctx.levels,
+                                 config=self.ctx.config)
+            report.diagnostics.extend(
+                cost_diagnostics(rep, self.ctx.config))
+            report.dedup()
+        return report
 
-    def explain(self, verify: bool = False) -> str:
+    def cost(self):
+        """The static cost pass alone: a machine-readable
+        ``analysis.cost.CostReport`` (per-stage row intervals, exact
+        byte predictions, per-device working-set bounds) for the plan
+        this query would execute.  Zero execution."""
+        from dryad_tpu.analysis.cost import estimate_query
+        return estimate_query(self.node, self.ctx.nparts,
+                              hosts=self.ctx.hosts,
+                              levels=self.ctx.levels,
+                              config=self.ctx.config)
+
+    def explain(self, verify: bool = False, cost: bool = False) -> str:
         text = plan_query(self.node, self.ctx.nparts,
                           hosts=self.ctx.hosts,
                           levels=self.ctx.levels,
                           config=self.ctx.config).explain()
         if verify:
             text += "\n\ndiagnostics:\n" + self.check().render()
+        if cost:
+            text += "\n\npredicted cost:\n" + self.cost().render()
         return text

@@ -482,6 +482,11 @@ class Executor:
         # last synchronous stage's observed stats (adapt/stats.StageStats)
         # — consumed by exec/recovery.Run's adaptive boundary hook
         self._last_stage_stats = None
+        # static CostReport of the running graph (analysis/cost.py),
+        # installed per run() — settled stages cross-check their
+        # measured rows/bytes against it (cost_model_miss events), so
+        # the model is continuously validated against device truth
+        self._cost_report = None
 
     def apply_config(self, config) -> None:
         """Re-point a persistent executor at a new job's JobConfig (worker
@@ -628,30 +633,57 @@ class Executor:
 
     def run(self, graph: StageGraph,
             bindings: Optional[Dict[str, PData]] = None,
-            spill_dir: Optional[str] = None) -> PData:
+            spill_dir: Optional[str] = None,
+            cost_report=None) -> PData:
         """Execute a graph with lineage-tracked recovery (exec.recovery.Run).
         With spill_dir, stage outputs are durably materialized.  With
         JobConfig.profile_dir, the whole run is captured in a
         jax.profiler device-time trace (xprof/TensorBoard viewable —
-        the Artemis device-timeline role)."""
+        the Artemis device-timeline role).  ``cost_report`` (the lint
+        gate's static analysis/cost.py prediction) arms the per-stage
+        runtime cross-check and seeds adaptive execution's priors."""
         from dryad_tpu.exec.recovery import Run
-        prof = getattr(self.config, "profile_dir", None)
-        if prof:
-            import os
+        self._cost_report = cost_report
+        try:
+            prof = getattr(self.config, "profile_dir", None)
+            if prof:
+                import os
 
-            import jax
-            sub = prof
-            if jax.process_count() > 1:
-                sub = os.path.join(prof, f"worker-{jax.process_index()}")
-            elif os.environ.get("DRYAD_WORKER_ID"):
-                # standalone (elastic) workers run outside jax.distributed
-                # but still need per-worker trace attribution
-                sub = os.path.join(
-                    prof, f"worker-{os.environ['DRYAD_WORKER_ID']}")
-            with jax.profiler.trace(sub):
-                return Run(self, graph, bindings,
-                           spill_dir=spill_dir).output()
-        return Run(self, graph, bindings, spill_dir=spill_dir).output()
+                import jax
+                sub = prof
+                if jax.process_count() > 1:
+                    sub = os.path.join(prof,
+                                       f"worker-{jax.process_index()}")
+                elif os.environ.get("DRYAD_WORKER_ID"):
+                    # standalone (elastic) workers run outside
+                    # jax.distributed but still need per-worker trace
+                    # attribution
+                    sub = os.path.join(
+                        prof, f"worker-{os.environ['DRYAD_WORKER_ID']}")
+                with jax.profiler.trace(sub):
+                    return Run(self, graph, bindings,
+                               spill_dir=spill_dir,
+                               cost_report=cost_report).output()
+            return Run(self, graph, bindings, spill_dir=spill_dir,
+                       cost_report=cost_report).output()
+        finally:
+            self._cost_report = None
+
+    def _check_cost(self, stage: Stage, scale: int, rows_total: int,
+                    out_bytes: int) -> None:
+        """Cross-check one settled (non-overflowing) stage against the
+        static cost prediction; misses surface as ``cost_model_miss``
+        events (the model-validation loop of the cost analyzer)."""
+        rep = self._cost_report
+        if rep is None:
+            return
+        est = rep.stage(stage.id)
+        if est is None:
+            return
+        from dryad_tpu.analysis.cost import check_stage_measurement
+        for miss in check_stage_measurement(est, scale, rows_total,
+                                            out_bytes, self.nparts):
+            self._event(miss)
 
     def _leg_input(self, leg, results, bindings) -> PData:
         if isinstance(leg.src, int):
@@ -951,6 +983,7 @@ class Executor:
                 stage._capacity_scale = scale
                 stage._send_slack = slack
                 stage._salted = salted
+                self._check_cost(stage, scale, int(sum(rows)), out_bytes)
                 pd = PData(out_batch, self.nparts)
                 if getattr(self.config, "adaptive", "off") == "on":
                     # rows arrived replicated on multi-process meshes,

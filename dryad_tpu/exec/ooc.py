@@ -719,15 +719,11 @@ def _sorted_bucket_chunks(schema, frags: List[HChunk],
 
 
 def _schema_row_bytes(schema) -> int:
-    total = 0
-    for spec in schema.values():
-        if spec["kind"] == "str":
-            total += spec["max_len"] + 4
-        else:
-            dt = np.dtype(spec["dtype"])
-            total += dt.itemsize * int(
-                np.prod(tuple(spec.get("shape", ())) or (1,)))
-    return max(total, 1)
+    # one row-width arithmetic repo-wide (io/store.schema_row_bytes ->
+    # analysis/domain); floored at 1 so an empty schema cannot zero the
+    # in-core byte estimate
+    from dryad_tpu.io.store import schema_row_bytes
+    return max(schema_row_bytes(schema), 1)
 
 
 def external_sort(src: ChunkSource, keys: Sequence[Tuple[str, bool]],

@@ -39,12 +39,14 @@ class Run:
                  bindings: Optional[Dict[str, PData]] = None,
                  spill_dir: Optional[str] = None,
                  failure_budget: Optional[int] = None,
-                 spill_compression: Optional[str] = None):
+                 spill_compression: Optional[str] = None,
+                 cost_report=None):
         cfg = getattr(executor, "config", None)
         self.ex = executor
         self.graph = graph
         self.bindings = bindings or {}
         self.spill_dir = spill_dir
+        self.cost_report = cost_report
         self.spill_compression = (spill_compression if spill_compression
                                   is not None else
                                   (cfg.spill_compression if cfg else None))
@@ -70,7 +72,7 @@ class Run:
             self.adapt = AdaptiveManager(
                 graph, cfg, executor.nparts,
                 levels=levels_of_mesh(getattr(executor, "mesh", None)),
-                event=executor._event)
+                event=executor._event, cost_report=cost_report)
         defer_ok = (getattr(cfg, "deferred_needs", True) if cfg else True)
         self._defer = ([] if defer_ok and not spill_dir
                        and not adaptive_on
@@ -206,6 +208,14 @@ class Run:
                 "deferred": True,
                 "dispatches": 1,   # program launch only; fetch amortized
                 "wall_s": rec["enqueue_s"]})
+            if not of:
+                # settled clean at the planned shapes: cross-check the
+                # measured rows/bytes against the static cost prediction
+                # (cost_model_miss events) — overflowing records replay
+                # below and cross-check on their synchronous re-run
+                self.ex._check_cost(stage, rec["scale"],
+                                    int(info[:, 3].sum()),
+                                    rec.get("out_bytes", 0))
             if of:
                 # the deferred path counts runs/bytes at enqueue
                 # (executor defer branch); the overflow verdict only
@@ -312,7 +322,19 @@ class Run:
         if self.adapt is not None:
             st = getattr(self.ex, "_last_stage_stats", None)
             if st is not None and st.stage == sid:
+                n_before = len(self.adapt.applied)
                 self.adapt.on_stage_materialized(st, set(self._results))
+                # a rewrite reshapes stages the static model never saw:
+                # drop their predictions so the runtime cross-check
+                # cannot fire spurious misses against pre-rewrite bounds
+                rep = self.cost_report
+                if rep is not None:
+                    for ev in self.adapt.applied[n_before:]:
+                        for rid in ([ev.get("stage")]
+                                    + list(ev.get("new_stages", ()))
+                                    + list(ev.get("orphaned", ()))):
+                            if rid is not None:
+                                rep._by_stage.pop(rid, None)
 
     def invalidate(self, sid: int, count_failure: bool = True,
                    drop_spill: bool = False) -> None:

@@ -29,7 +29,7 @@ from dryad_tpu.data.columnar import Batch, StringColumn
 from dryad_tpu.exec.data import PData
 
 __all__ = ["write_store", "read_store", "store_meta", "build_meta",
-           "StoreIntegrityError", "is_remote_store",
+           "schema_row_bytes", "StoreIntegrityError", "is_remote_store",
            "remote_read_part_views"]
 
 _FORMAT_VERSION = 3
@@ -65,6 +65,17 @@ def _part_path(path: str, p: int) -> str:
     return os.path.join(path, f"part-{p:05d}.bin")
 
 
+def schema_row_bytes(schema: Dict[str, Any]) -> int:
+    """Uncompressed payload bytes of ONE row under a store schema
+    (str columns: max_len data + 4-byte length lane).  Delegates to the
+    static cost analyzer's domain (analysis/domain.py) so the manifest's
+    byte counts, the OOC in-core decision (exec/ooc.py), and the cost
+    model's predictions share ONE row-width arithmetic."""
+    from dryad_tpu.analysis.domain import (schema_from_store_schema,
+                                           schema_row_bytes as _srb)
+    return _srb(schema_from_store_schema(schema))
+
+
 def build_meta(schema: Dict[str, Any], counts: List[int],
                checksums: List[str],
                partitioning: Optional[Dict[str, Any]] = None,
@@ -72,11 +83,21 @@ def build_meta(schema: Dict[str, Any], counts: List[int],
                capacity: Optional[int] = None) -> Dict[str, Any]:
     """The ONE meta.json constructor — every writer (in-memory write_store,
     streamed write_chunks_to_store, cluster parallel partition writers)
-    goes through it, so format_version / field skew cannot happen."""
+    goes through it, so format_version / field skew cannot happen.
+
+    ``bytes`` records each partition's UNCOMPRESSED payload bytes
+    (count x schema row width — the exact size ``fill_segments``
+    materializes on read) so admission/streaming policies (ROADMAP
+    items 1 and 4) can size jobs without opening a single partition
+    file.  The static cost analyzer seeds its intervals from the
+    manifest's ``counts`` + ``schema`` riding store_spec
+    (runtime/sources.py -> analysis/cost._source_state)."""
+    rb = schema_row_bytes(schema)
     return {
         "format_version": _FORMAT_VERSION,
         "npartitions": len(counts),
         "counts": list(counts),
+        "bytes": [int(c) * rb for c in counts],
         "capacity": capacity if capacity is not None
         else max(list(counts) or [1]),
         "schema": schema,

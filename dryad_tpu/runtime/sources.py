@@ -99,8 +99,11 @@ def text_spec(path, nparts: int, column: str = "line",
     io.providers.expand_paths; workers read them from the shared fs)."""
     paths = [path] if isinstance(path, str) else list(path)
     n = sum(count_lines_file(p) for p in paths)
+    # "rows" is the EXACT line count (the capacity computation already
+    # pays for it) — the static cost analyzer seeds its row intervals
+    # from it (analysis/cost.py source seeding)
     return {"kind": "text", "paths": paths, "column": column,
-            "max_line_len": max_line_len,
+            "max_line_len": max_line_len, "rows": n,
             "capacity": _block_capacity(n, nparts)}
 
 
@@ -126,8 +129,13 @@ def store_spec(path: str, nparts: int, meta: Dict[str, Any],
                               max(counts or [0]), 1)
     else:
         cap = capacity or _block_capacity(sum(counts), nparts)
+    # manifest statistics ride the spec: exact rows + the store schema
+    # let the static cost analyzer predict this source's device bytes
+    # before a single partition file is opened (analysis/cost.py)
     return {"kind": "store", "path": path, "capacity": cap,
             "partitions": partitions,
+            "rows": int(sum(counts)) if counts else None,
+            "schema": meta.get("schema"),
             "preferred_worker": preferred_worker,
             "preferred_hosts": (list(preferred_hosts)
                                 if preferred_hosts else None)}

@@ -242,6 +242,15 @@ class JobConfig:
     # "error" = refuse to submit when error-severity findings exist
     # (analysis.LintError).  Dataset.check() is the interactive form.
     lint: str = "off"
+    # per-device HBM budget for the static cost analyzer
+    # (analysis/cost.py, DTA2xx): with lint enabled, a plan whose
+    # predicted per-device working set PROVABLY exceeds this many bytes
+    # fails pre-submit (DTA201); predicted-spill warnings (DTA202) and
+    # the cache()-of-edge-scale-data warning (DTA204) key off it too.
+    # 0 = unknown/disabled — the cost pass still runs (per-stage cost
+    # table, unbounded-fan-out warnings, runtime cost_model_miss
+    # cross-check) but never gates on a memory budget.
+    device_hbm_bytes: int = 0
 
     def __post_init__(self):
         checks = [
@@ -296,6 +305,7 @@ class JobConfig:
             (self.max_loop_iterations >= 1, "max_loop_iterations >= 1"),
             (self.lint in ("off", "warn", "error"),
              "lint in ('off', 'warn', 'error')"),
+            (self.device_hbm_bytes >= 0, "device_hbm_bytes >= 0"),
             (self.adaptive in ("off", "on"),
              "adaptive in ('off', 'on')"),
             (self.adapt_skew_factor >= 1.0, "adapt_skew_factor >= 1.0"),

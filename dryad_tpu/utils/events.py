@@ -33,6 +33,9 @@ _LEVELS = {
     "stage_done": 1, "plan": 1, "stage_spilled": 1, "stage_restored": 1,
     "task_done": 1, "task_duplicated": 1, "task_reassigned": 1,
     "lint_finding": 1, "settle_replay": 1, "stage_retry": 1,
+    # static cost analyzer (dryad_tpu/analysis/cost.py): the pre-submit
+    # prediction and the runtime model-validation misses
+    "cost_report": 1, "cost_model_miss": 1,
     "stream_stage_done": 1, "stream_tee_spill": 1, "job_done": 1,
     "job_archived": 1, "diagnosis_skew": 1, "diagnosis_slow_worker": 1,
     # adaptive execution: an applied stage-graph rewrite is a scheduling

@@ -381,6 +381,66 @@ def _lint_html(events) -> str:
             f"<table class='lint'>{head}{''.join(rows)}</table>")
 
 
+def _cost_html(events) -> str:
+    """"Predicted cost" section: the pre-submit static cost analysis
+    (``cost_report`` events, analysis/cost.py via the JobConfig.lint
+    gate) as a per-stage table, plus any runtime ``cost_model_miss``
+    cross-check verdicts — present only when a cost pass ran."""
+    reps = [e for e in events if e.get("event") == "cost_report"]
+    if not reps:
+        return ""
+    from dryad_tpu.analysis.cost import CostReport
+    from dryad_tpu.analysis.domain import fmt_bytes
+    try:
+        rep = CostReport.from_payload(reps[-1]["report"])
+    except Exception:
+        return ""
+    if rep.streamed:
+        body = ("<p>streamed plan: device working set is "
+                "O(chunk_rows) — the HBM cost model does not apply</p>")
+    else:
+        rows = []
+        for s in rep.stages:
+            rv = (f"[{s.rows.lo}, {s.rows.hi}]"
+                  if s.rows.hi is not None else f"[{s.rows.lo}, ∞)")
+            ob = (fmt_bytes(s.out_bytes.hi)
+                  if s.out_bytes.hi is not None else "?")
+            wk = (fmt_bytes(s.work_bytes.hi)
+                  if s.work_bytes.hi is not None else "?")
+            rows.append(
+                f"<tr><td>{s.stage}</td>"
+                f"<td>{html.escape(str(s.label))}</td>"
+                f"<td>{s.capacity}</td><td>{html.escape(rv)}</td>"
+                f"<td>{ob}</td><td>{wk}</td>"
+                f"<td>{'~' if s.approx else ''}</td></tr>")
+        pk = rep.peak_work
+        budget = (f" / budget {fmt_bytes(rep.device_hbm_bytes)}"
+                  if rep.device_hbm_bytes else "")
+        body = ("<table><tr><th>stage</th><th>label</th><th>cap</th>"
+                "<th>rows</th><th>out bytes</th><th>work/dev</th>"
+                "<th>~</th></tr>" + "".join(rows) + "</table>"
+                f"<p>peak per-device working set {fmt_bytes(pk.lo)}"
+                + (f"..{fmt_bytes(pk.hi)}" if pk.hi is not None
+                   else "..?") + budget
+                + " &nbsp;(~ = approximate)</p>")
+    misses = [e for e in events if e.get("event") == "cost_model_miss"]
+    if misses:
+        li = "".join(
+            f"<li>stage {e.get('stage')} ({html.escape(str(e.get('label', '')))}): "
+            f"measured {html.escape(str(e.get('what')))}="
+            f"{e.get('measured')} outside predicted "
+            f"{html.escape(str(e.get('predicted')))}</li>"
+            for e in misses)
+        body += (f'<p style="color: var(--warning)">&#9888; '
+                 f'{len(misses)} cost-model miss(es) — the static '
+                 f'prediction did not contain the measured value:</p>'
+                 f"<ul>{li}</ul>")
+    else:
+        body += ("<p class='ink2'>runtime cross-check: no "
+                 "cost-model misses</p>")
+    return "<h2>Predicted cost (static analysis)</h2>" + body
+
+
 def _critical_path_html(events) -> str:
     """Critical-path section (the Artemis question): top path segments
     plus the per-stage queue/compile/run/io split, computed from the
@@ -569,6 +629,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
 <div class="tiles">{tile_html}</div>
 {_diagnosis_html(events)}
 {_lint_html(events)}
+{_cost_html(events)}
 {_adaptive_html(events)}
 {_critical_path_html(events)}
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}

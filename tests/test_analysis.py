@@ -110,13 +110,25 @@ def test_dta003_mirrors_unsupported_map(ctx, monkeypatch):
 
 
 def test_dta010_capacity_hazard(ctx):
+    # first-wave-only fan-out: the blind overflow-retry ladder is the
+    # only escape, so the hazard stays warn
     q = _kv(ctx).flat_map(fm_fn, out_capacity=16)
     rep = q.check()
     assert "DTA010" in rep.codes()
-    assert all(d.severity == "info" for d in rep.by_code("DTA010"))
+    assert all(d.severity == "warn" for d in rep.by_code("DTA010"))
     # a with_capacity bound downstream clears the hazard
     assert "DTA010" not in \
         q.with_capacity(32).check().codes()
+    # a non-broadcast join's legs ride hash exchanges — eligible for
+    # measured-slot feedback, so the analyzer downgrades to info
+    # instead of contradicting the exact-slot machinery
+    j = _kv(ctx).join(_kv(ctx), ["k"], ["k"])
+    jd = j.check().by_code("DTA010")
+    assert jd and all(d.severity == "info" for d in jd)
+    # ...but a broadcast join is first-wave-only again: warn
+    b = _kv(ctx).join(_kv(ctx), ["k"], ["k"], broadcast=True)
+    bd = b.check().by_code("DTA010")
+    assert bd and all(d.severity == "warn" for d in bd)
 
 
 def test_dta011_redundant_repartition(ctx):
@@ -281,6 +293,60 @@ def test_dta104_subscripted_captured_mutation(ctx):
     assert "DTA104" in _kv(ctx).select(sub_mut_udf).check().codes()
 
 
+_BIG_CONST = np.zeros(32768, np.float32)       # 128 KiB: over the line
+_SMALL_CONST = np.zeros(16, np.float32)
+
+
+def big_capture_udf(c):
+    return {"k": c["k"], "v": c["v"] + _BIG_CONST[0]}
+
+
+def small_capture_udf(c):
+    return {"k": c["k"], "v": c["v"] + _SMALL_CONST[0]}
+
+
+def test_dta105_heavy_capture(ctx):
+    """A UDF closing over a large ndarray constant silently re-ships the
+    bytes with every task envelope — warn, span at the capture site."""
+    rep = _kv(ctx).select(big_capture_udf).check()
+    d = rep.by_code("DTA105")
+    assert d and all(x.severity == "warn" for x in d)
+    assert "test_analysis.py" in d[0].span.file
+    src, first = inspect.getsourcelines(big_capture_udf)
+    assert first <= d[0].span.line < first + len(src)
+    # a small constant is fine payload
+    assert "DTA105" not in _kv(ctx).select(small_capture_udf) \
+        .check().codes()
+
+
+def shadowing_udf(c):
+    _BIG_CONST = c["v"] * 2        # noqa: N806 — shadows the module array
+    return {"k": c["k"], "v": _BIG_CONST}
+
+
+def test_dta105_local_shadow_not_a_capture(ctx):
+    """A local (LOAD_FAST) shadowing a large module-level array captures
+    nothing — no finding."""
+    assert "DTA105" not in _kv(ctx).select(shadowing_udf).check().codes()
+    # a PARAMETER named like the global is local too
+    def param_udf(c, _BIG_CONST=0):
+        return {"k": c["k"], "v": c["v"] + _BIG_CONST}
+    assert "DTA105" not in _kv(ctx).select(param_udf).check().codes()
+
+
+def test_dta105_device_array_capture(ctx):
+    """Closing over a DEVICE array is flagged regardless of size: the
+    buffer transfers to host and re-ships per task."""
+    import jax.numpy as jnp
+    dev = jnp.zeros(4, jnp.float32)
+
+    def udf(c):
+        return {"k": c["k"], "v": c["v"] + dev[0]}
+
+    d = _kv(ctx).select(udf).check().by_code("DTA105")
+    assert d and "device array" in d[0].message
+
+
 class _FakeCluster:
     nparts = 4
     n_processes = 1
@@ -302,12 +368,60 @@ def test_do_while_lints_once_per_loop():
     ctx2 = Context(cluster=cl, config=JobConfig(lint="warn"))
     calls = []
     orig = ctx2._pre_submit_lint
-    ctx2._pre_submit_lint = lambda node, cluster: (
-        calls.append(1), orig(node, cluster))[-1]
+    ctx2._pre_submit_lint = lambda node, cluster, graph=None: (
+        calls.append(1), orig(node, cluster, graph=graph))[-1]
     init = _kv(ctx2)
     ctx2.do_while(init, lambda ds: ds, n_iters=5)
     assert cl.executes == 6          # init + 5 iterations ran
     assert len(calls) == 2           # linted init + body once
+
+
+def test_report_dedup_consumer_count():
+    """Identical (code, severity, span, node) findings reached via
+    multiple Tee'd consumer paths collapse to ONE finding annotated with
+    the path count."""
+    from dryad_tpu.analysis.diagnostics import (Diagnostic,
+                                                DiagnosticReport, Span)
+    rep = DiagnosticReport()
+    sp = Span("q.py", 7)
+    rep.add("DTA010", "warn", "capacity is a static guess", span=sp,
+            node="FlatMap:fm")
+    rep.add("DTA010", "warn", "capacity is a static guess", span=sp,
+            node="FlatMap:fm")
+    # same code at a DIFFERENT span is a distinct finding — kept
+    rep.add("DTA010", "warn", "capacity is a static guess",
+            span=Span("q.py", 9), node="FlatMap:fm2")
+    # same (code, span) but a DIFFERENT defect message — kept: the
+    # message is part of the finding's identity
+    rep.add("DTA102", "warn", "id() depends on placement", span=sp,
+            node="Map:udf")
+    rep.add("DTA102", "warn", "hash() is salted per process", span=sp,
+            node="Map:udf")
+    rep.dedup()
+    assert len(rep.by_code("DTA102")) == 2
+    d10 = rep.by_code("DTA010")
+    assert len(d10) == 2
+    merged = [d for d in d10 if d.span == sp]
+    assert len(merged) == 1
+    assert "[x2 consumer paths]" in merged[0].message
+    assert isinstance(merged[0], Diagnostic)
+    # idempotent: a second dedup neither drops nor re-annotates
+    rep.dedup()
+    assert [d.message for d in rep.by_code("DTA010")] == \
+        [d.message for d in d10]
+
+
+def test_tee_consumers_report_hazard_once(ctx):
+    """Integration guard for the dedup: one hazardous flat_map consumed
+    by two Tee branches yields exactly ONE DTA010 finding."""
+    q = _kv(ctx).flat_map(fm_fn, out_capacity=16)
+    a = q.group_by(["k"], {"s": ("sum", "v")})
+    b = q.group_by(["k"], {"s": ("max", "v")})
+    both = a.concat(b)
+    d10 = both.check().by_code("DTA010")
+    assert len({(d.code, d.span and (d.span.file, d.span.line))
+                for d in d10}) == len(d10)
+    assert len(d10) == 1
 
 
 def test_udf_lint_spans_point_at_udf_line(ctx):
@@ -592,3 +706,9 @@ def test_apps_pipelines_check_clean(ctx):
     for name, q in pipelines.items():
         rep = q.check()
         assert rep.clean, f"{name} not clean:\n{rep.render()}"
+        # the cost pass adds ZERO new warn/error findings on the apps
+        # (only the DTA205 info summary — statistically seeded sources
+        # keep every bound tight)
+        crep = q.check(cost=True)
+        assert crep.clean, f"{name} cost findings:\n{crep.render()}"
+        assert "DTA205" in crep.codes(), f"{name}: cost pass did not run"

@@ -2,21 +2,22 @@
 
 Primary: run ``ruff check`` (config in pyproject.toml, tuned to the
 repo's style) over ``dryad_tpu/`` when ruff is installed.  The container
-may not ship ruff, so a dependency-free fallback always runs: an AST
-unused-import scan honoring ``noqa`` and ``__all__`` — the highest-value
-pyflakes rule (F401), reimplemented in ~60 lines so CI keeps teeth
-either way.
+may not ship ruff, so a dependency-free fallback always runs: the AST
+unused-import scan in ``dryad_tpu/analysis/selflint.py`` (shared with
+``python -m dryad_tpu.analysis --selfcheck``) — the highest-value
+pyflakes rule (F401), so CI keeps teeth either way.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import shutil
 import subprocess
 import sys
 
 import pytest
+
+from dryad_tpu.analysis.selflint import unused_imports
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PKG = REPO / "dryad_tpu"
@@ -37,55 +38,10 @@ def test_ruff_clean():
         f"ruff findings:\n{proc.stdout}\n{proc.stderr}"
 
 
-def _unused_imports(path: pathlib.Path):
-    src = path.read_text()
-    lines = src.splitlines()
-    tree = ast.parse(src, filename=str(path))
-
-    # bindings introduced by imports (outside try: blocks — those are
-    # optional-dependency probes), with their statement's line range
-    bindings = {}  # name -> (lineno, text)
-    in_try = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Try):
-            for sub in ast.walk(node):
-                in_try.add(id(sub))
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.Import, ast.ImportFrom)):
-            continue
-        if id(node) in in_try:
-            continue
-        if isinstance(node, ast.ImportFrom) \
-                and node.module == "__future__":
-            continue
-        stmt = " ".join(
-            lines[i].strip()
-            for i in range(node.lineno - 1,
-                           (node.end_lineno or node.lineno)))
-        if "noqa" in stmt:
-            continue
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name.split(".")[0]
-            if name.startswith("_"):
-                continue  # convention: side-effect / shim imports
-            bindings[name] = (node.lineno, stmt)
-
-    used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
-    used |= {n.value for n in ast.walk(tree)
-             if isinstance(n, ast.Constant) and isinstance(n.value, str)
-             and n.value in bindings}  # __all__ re-exports by string
-    return [(path, line, name, stmt)
-            for name, (line, stmt) in sorted(bindings.items(),
-                                             key=lambda kv: kv[1][0])
-            if name not in used]
-
-
 def test_no_unused_imports():
     findings = []
     for path in _py_files():
-        findings.extend(_unused_imports(path))
+        findings.extend(unused_imports(path))
     msg = "\n".join(f"{p.relative_to(REPO)}:{line}: unused import "
                     f"{name!r} ({stmt})"
                     for p, line, name, stmt in findings)
