@@ -710,6 +710,136 @@ def smoke_kernels(out_path="BENCH_kernels.json", n=None, quiet=False):
     return out
 
 
+def smoke_service(out_path="BENCH_service.json", n_lines=None,
+                  k_jobs=None, reps=None, quiet=False):
+    """Multi-tenant job-service smoke (``python bench.py
+    --smoke-service``): K wordcount jobs CONCURRENTLY through one
+    persistent daemon (shared in-process fleet + shared compiled-stage
+    caches, dryad_tpu/service) vs the SAME K jobs run sequentially as
+    standalone drivers (fresh Executor each — the reference's
+    one-Graph-Manager-per-job model, nothing amortized).  Both sides run
+    ``reps`` repetitions INTERLEAVED (standalone, service, standalone,
+    ...) and report MEDIAN aggregate walls (the PR-4 protocol: both
+    sides get the same box weather; each rep builds a fresh daemon /
+    fresh executors so every rep pays its own cold start).
+
+    The second headline is the amortization story the ROADMAP names
+    (BENCH_obs: compile is ~0.75s of a ~1.0s job): after the K
+    concurrent jobs, a WARM-CACHE second-user submission of the same
+    app — its compile segment must be near zero because the daemon's
+    shared executor keeps the compiled stages hot.  Written to
+    ``BENCH_service.json`` and appended to ``BENCH_trend.jsonl`` (app
+    ``bench-smoke-service``)."""
+    import statistics
+    import tempfile
+
+    from dryad_tpu.api.dataset import Context
+    from dryad_tpu.exec.data import maybe_shrink_for_collect, pdata_to_host
+    from dryad_tpu.exec.executor import Executor
+    from dryad_tpu.parallel.mesh import make_mesh
+    from dryad_tpu.plan.planner import plan_query
+    from dryad_tpu.service.apps import APPS
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+
+    n_lines = n_lines or int(os.environ.get("BENCH_SERVICE_LINES", "4000"))
+    k_jobs = k_jobs or int(os.environ.get("BENCH_SERVICE_JOBS", "3"))
+    reps = max(1, reps or int(os.environ.get("BENCH_SERVICE_REPS", "3")))
+    app = APPS["wordcount"]
+    job_params = [{"n_lines": n_lines, "seed": i} for i in range(k_jobs)]
+    mesh = make_mesh()
+
+    def standalone(params, ex):
+        """One job the one-GM-per-job way: its own executor (cold
+        compile), its own driver run."""
+        tasks = app.make_tasks(dict(params), mesh.devices.size)
+        cols = {k: [x for t in tasks for x in t[k]] for k in tasks[0]}
+        ctx = Context(mesh=mesh)
+        q = app.build_query(ctx, cols, params)
+        graph = plan_query(q.node, ctx.nparts, hosts=ctx.hosts,
+                           levels=ctx.levels)
+        pd = ex.run(graph)
+        return app.combine([pdata_to_host(maybe_shrink_for_collect(pd))])
+
+    seq_walls, conc_walls = [], []
+    warm = cold = None
+    seq_results = conc_results = None
+    for _ in range(reps):
+        # -- sequential standalone baseline (fresh executor per job)
+        t0 = time.time()
+        seq_results = []
+        for params in job_params:
+            seq_results.append(standalone(params, Executor(mesh)))
+        seq_walls.append(time.time() - t0)
+        # -- K jobs concurrently through one fresh daemon
+        with tempfile.TemporaryDirectory(prefix="bench-svc-") as d:
+            svc = JobService(ServiceConfig(service_dir=d, slots=2),
+                             mesh=mesh)
+            try:
+                t0 = time.time()
+                jids = [svc.submit("wordcount", p,
+                                   tenant=f"tenant{i % 2}")
+                        for i, p in enumerate(job_params)]
+                rows = [svc.wait(j, timeout=600) for j in jids]
+                conc_walls.append(time.time() - t0)
+                assert all(r["state"] == "done" for r in rows), rows
+                conc_results = [r["result"] for r in rows]
+
+                def compile_of(jid):
+                    return sum(e.get("compile_s", 0)
+                               for e in svc.jobs[jid].log.events
+                               if e.get("event") == "stage_done")
+
+                cold = compile_of(jids[0])
+                # warm-cache second user: same app+params as job 0,
+                # new tenant — the Nth-user-pays-zero-compile check
+                t0 = time.time()
+                jw = svc.submit("wordcount", job_params[0],
+                                tenant="warm-user")
+                rw = svc.wait(jw, timeout=600)
+                warm = {"wall_s": round(time.time() - t0, 4),
+                        "compile_s": round(compile_of(jw), 4)}
+                assert rw["state"] == "done", rw
+            finally:
+                svc.close()
+    seq_s = statistics.median(seq_walls)
+    conc_s = statistics.median(conc_walls)
+    results_match = conc_results == seq_results
+    out = {
+        "metric": "service smoke (K concurrent jobs through one daemon "
+                  "vs K sequential standalone runs)",
+        "k_jobs": k_jobs,
+        "lines_per_job": n_lines,
+        "reps": reps,
+        "wall_s_sequential": round(seq_s, 4),
+        "wall_s_concurrent": round(conc_s, 4),
+        "wall_s_sequential_all": [round(w, 4) for w in seq_walls],
+        "wall_s_concurrent_all": [round(w, 4) for w in conc_walls],
+        "speedup_pct": (round(100.0 * (seq_s - conc_s) / seq_s, 1)
+                        if seq_s > 0 else None),
+        "cold": {"compile_s": round(cold, 4)},
+        "warm": warm,
+        "results_match": results_match,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-smoke-service",
+            "wall_s": round(conc_s, 4),
+            "sequential_wall_s": round(seq_s, 4),
+            "speedup_pct": out["speedup_pct"],
+            "warm_user_compile_s": warm["compile_s"],
+            "warm_user_wall_s": warm["wall_s"],
+            "cold_compile_s": round(cold, 4),
+            "k_jobs": k_jobs, "lines": n_lines, "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def main():
     import jax
 
@@ -1279,6 +1409,9 @@ if __name__ == "__main__":
     elif "--smoke-kernels" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-kernels"]
         smoke_kernels(out_path=args[0] if args else "BENCH_kernels.json")
+    elif "--smoke-service" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-service"]
+        smoke_service(out_path=args[0] if args else "BENCH_service.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
@@ -1291,6 +1424,8 @@ if __name__ == "__main__":
         smoke_adapt(out_path=os.path.join(base, "BENCH_adapt.json"),
                     quiet=True)
         smoke_kernels(out_path=os.path.join(base, "BENCH_kernels.json"),
+                      quiet=True)
+        smoke_service(out_path=os.path.join(base, "BENCH_service.json"),
                       quiet=True)
     else:
         main()

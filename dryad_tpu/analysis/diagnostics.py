@@ -71,6 +71,14 @@ CODES = {
     "DTA903": "bucket capacity overflow during wave exchange",
     "DTA904": "wave exchange still overflowing after capacity retries",
     "DTA905": "worker cannot resolve a plan callable (missing --fn-module)",
+    # multi-tenant job service admission (dryad_tpu/service): typed,
+    # code-carrying rejections raised BEFORE any work starts
+    "DTA910": "job service: unknown app or malformed job spec",
+    "DTA911": "job service: tenant admission queue full (backpressure — "
+              "resubmit later)",
+    "DTA912": "job service: tenant failure budget exhausted",
+    "DTA913": "job service: daemon is draining/stopped — submission "
+              "refused",
 }
 
 # codes that have NO static-analyzer rule, by design: data-dependent
@@ -78,7 +86,8 @@ CODES = {
 # drift test asserts every runtime raise site uses a code that is either
 # carried by a static rule or listed here.
 RUNTIME_ONLY_CODES = frozenset({"DTA901", "DTA902", "DTA903", "DTA904",
-                                "DTA905"})
+                                "DTA905", "DTA910", "DTA911", "DTA912",
+                                "DTA913"})
 
 
 @dataclasses.dataclass(frozen=True)

@@ -56,7 +56,7 @@ class Context:
                  event_log: Optional[Callable[[dict], None]] = None,
                  spill_dir: Optional[str] = None,
                  cluster=None, fn_table: Optional[Mapping[str, Any]] = None,
-                 config=None):
+                 config=None, install_trace: bool = True):
         from dryad_tpu.utils.config import JobConfig
         self.cluster = cluster
         self.fn_table = dict(fn_table or {})
@@ -69,9 +69,14 @@ class Context:
         # into this context's event stream (obs/trace.py).  The sink is
         # process-global and the LATEST Context owns it — including a
         # log-less Context, which detaches the previous sink: a later
-        # job's spans must never leak into an earlier job's JSONL
-        from dryad_tpu.obs import trace as _trace
-        _trace.install(event_log)
+        # job's spans must never leak into an earlier job's JSONL.
+        # ``install_trace=False`` opts out of that latest-owner model
+        # entirely: the multi-tenant service daemon builds Contexts for
+        # plan/lint work with fully explicit per-job sinks, and must not
+        # detach whatever sink the embedding process installed.
+        if install_trace:
+            from dryad_tpu.obs import trace as _trace
+            _trace.install(event_log)
         # job-history archiving (obs/history.py): JobConfig.history_dir
         # makes the attached EventLog archive this job's {events, plan,
         # metrics, bundles} on close; an explicit EventLog(history_dir=)

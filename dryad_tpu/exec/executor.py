@@ -482,11 +482,12 @@ class Executor:
         # last synchronous stage's observed stats (adapt/stats.StageStats)
         # — consumed by exec/recovery.Run's adaptive boundary hook
         self._last_stage_stats = None
-        # static CostReport of the running graph (analysis/cost.py),
-        # installed per run() — settled stages cross-check their
-        # measured rows/bytes against it (cost_model_miss events), so
-        # the model is continuously validated against device truth
-        self._cost_report = None
+        # the job-service daemon (dryad_tpu/service) runs CONCURRENT
+        # jobs over one shared executor so they share the compiled-stage
+        # cache; the shared caches get a lock (compiles run outside it —
+        # two jobs racing the same cold stage at worst both compile)
+        import threading
+        self._cache_lock = threading.RLock()
 
     def apply_config(self, config) -> None:
         """Re-point a persistent executor at a new job's JobConfig (worker
@@ -634,56 +635,65 @@ class Executor:
     def run(self, graph: StageGraph,
             bindings: Optional[Dict[str, PData]] = None,
             spill_dir: Optional[str] = None,
-            cost_report=None) -> PData:
+            cost_report=None, event_log=None, job=None,
+            failure_budget: Optional[int] = None) -> PData:
         """Execute a graph with lineage-tracked recovery (exec.recovery.Run).
         With spill_dir, stage outputs are durably materialized.  With
         JobConfig.profile_dir, the whole run is captured in a
         jax.profiler device-time trace (xprof/TensorBoard viewable —
         the Artemis device-timeline role).  ``cost_report`` (the lint
         gate's static analysis/cost.py prediction) arms the per-stage
-        runtime cross-check and seeds adaptive execution's priors."""
-        from dryad_tpu.exec.recovery import Run
-        self._cost_report = cost_report
-        try:
-            prof = getattr(self.config, "profile_dir", None)
-            if prof:
-                import os
+        runtime cross-check and seeds adaptive execution's priors.
 
-                import jax
-                sub = prof
-                if jax.process_count() > 1:
-                    sub = os.path.join(prof,
-                                       f"worker-{jax.process_index()}")
-                elif os.environ.get("DRYAD_WORKER_ID"):
-                    # standalone (elastic) workers run outside
-                    # jax.distributed but still need per-worker trace
-                    # attribution
-                    sub = os.path.join(
-                        prof, f"worker-{os.environ['DRYAD_WORKER_ID']}")
-                with jax.profiler.trace(sub):
-                    return Run(self, graph, bindings,
-                               spill_dir=spill_dir,
-                               cost_report=cost_report).output()
-            return Run(self, graph, bindings, spill_dir=spill_dir,
-                       cost_report=cost_report).output()
-        finally:
-            self._cost_report = None
+        ``event_log``/``job``/``failure_budget`` make the run's driver
+        state fully per-JOB (the service daemon runs many concurrent
+        jobs over one shared executor): events route to the given sink
+        tagged with the job id, never to the executor's process default."""
+        from dryad_tpu.exec.recovery import Run
+        prof = getattr(self.config, "profile_dir", None)
+        if prof:
+            import os
+
+            import jax
+            sub = prof
+            if jax.process_count() > 1:
+                sub = os.path.join(prof,
+                                   f"worker-{jax.process_index()}")
+            elif os.environ.get("DRYAD_WORKER_ID"):
+                # standalone (elastic) workers run outside
+                # jax.distributed but still need per-worker trace
+                # attribution
+                sub = os.path.join(
+                    prof, f"worker-{os.environ['DRYAD_WORKER_ID']}")
+            with jax.profiler.trace(sub):
+                return Run(self, graph, bindings,
+                           spill_dir=spill_dir,
+                           cost_report=cost_report,
+                           event=event_log, job=job,
+                           failure_budget=failure_budget).output()
+        return Run(self, graph, bindings, spill_dir=spill_dir,
+                   cost_report=cost_report, event=event_log,
+                   job=job, failure_budget=failure_budget).output()
 
     def _check_cost(self, stage: Stage, scale: int, rows_total: int,
-                    out_bytes: int) -> None:
+                    out_bytes: int, report=None, event=None) -> None:
         """Cross-check one settled (non-overflowing) stage against the
         static cost prediction; misses surface as ``cost_model_miss``
-        events (the model-validation loop of the cost analyzer)."""
-        rep = self._cost_report
-        if rep is None:
+        events (the model-validation loop of the cost analyzer).
+        ``report``/``event`` come from the CALLING run — there is no
+        shared-executor fallback: with concurrent jobs on one executor
+        (the service daemon) a process-global report would cross-check
+        one job's stages against another job's model."""
+        if report is None:
             return
-        est = rep.stage(stage.id)
+        est = report.stage(stage.id)
         if est is None:
             return
         from dryad_tpu.analysis.cost import check_stage_measurement
+        ev = event if event is not None else self._event
         for miss in check_stage_measurement(est, scale, rows_total,
                                             out_bytes, self.nparts):
-            self._event(miss)
+            ev(miss)
 
     def _leg_input(self, leg, results, bindings) -> PData:
         if isinstance(leg.src, int):
@@ -803,18 +813,19 @@ class Executor:
         if info.shape[1] < 4 + 1:
             return
         fp = stage.fingerprint()
-        for li, leg in enumerate(stage.legs[:_SLOT_FEEDBACK_LEGS]):
-            ex = leg.exchange
-            if ex is None or ex.kind == "broadcast":
-                continue
-            if 4 + li >= info.shape[1]:
-                break
-            slot = int(info[:, 4 + li].max())
-            if slot > 0:
-                self._slot_feedback[(fp, li)] = slot
-                self._slot_feedback.move_to_end((fp, li))
-        while len(self._slot_feedback) > 512:
-            self._slot_feedback.popitem(last=False)
+        with self._cache_lock:
+            for li, leg in enumerate(stage.legs[:_SLOT_FEEDBACK_LEGS]):
+                ex = leg.exchange
+                if ex is None or ex.kind == "broadcast":
+                    continue
+                if 4 + li >= info.shape[1]:
+                    break
+                slot = int(info[:, 4 + li].max())
+                if slot > 0:
+                    self._slot_feedback[(fp, li)] = slot
+                    self._slot_feedback.move_to_end((fp, li))
+            while len(self._slot_feedback) > 512:
+                self._slot_feedback.popitem(last=False)
 
     def _slot_hints(self, stage: Stage, inputs, slack: int,
                     salted: bool) -> tuple:
@@ -859,11 +870,19 @@ class Executor:
         return tuple(hints) if any(h is not None for h in hints) else ()
 
     def _run_stage(self, stage: Stage, results, bindings,
-                   defer: Optional[list] = None) -> PData:
+                   defer: Optional[list] = None, event=None,
+                   cost_report=None, stats_box: Optional[list] = None,
+                   job=None) -> PData:
+        # per-job driver state (exec/recovery.Run threads these): the
+        # event sink, cost report, and observed-stats box belong to the
+        # CALLING run, not this (possibly shared) executor
+        ev = event if event is not None else self._event
         # observed-stats slot for the adaptive manager (exec/recovery):
         # cleared per stage so a deferred or failed attempt can never
         # leak a previous stage's measurement into a rewrite decision
         self._last_stage_stats = None
+        if stats_box is not None:
+            stats_box[0] = None
         inputs = [self._leg_input(leg, results, bindings)
                   for leg in stage.legs]
         bounds = None
@@ -889,7 +908,10 @@ class Executor:
             args = [i.batch for i in inputs]
             if bounds is not None:
                 args.append(bounds)
-            fn = self._compile_cache.get(key)
+            with self._cache_lock:
+                fn = self._compile_cache.get(key)
+                if fn is not None:
+                    self._compile_cache.move_to_end(key)
             compile_s = 0.0
             cache_hit = fn is not None
             if fn is None:
@@ -905,12 +927,18 @@ class Executor:
                                           ).lower(*args).compile()
                 compile_s = time.time() - t0
                 _M_COMPILE_S.inc(compile_s)
-                self._compile_cache[key] = fn
-                if len(self._compile_cache) > self._compile_cache_max:
-                    self._compile_cache.popitem(last=False)
+                with self._cache_lock:
+                    self._compile_cache[key] = fn
+                    if len(self._compile_cache) > self._compile_cache_max:
+                        self._compile_cache.popitem(last=False)
             else:
                 _M_CACHE_HITS.inc()
-                self._compile_cache.move_to_end(key)
+            if job is not None:
+                # per-job compiled-stage hit/miss attribution: the
+                # service dashboard's "did the Nth user pay compile"
+                # signal (labels ride the same canonical families)
+                _family(_METRICS, "cache_hits" if cache_hit
+                        else "cache_misses", job=job).inc()
             t0 = time.time()
             out_batch, info = fn(*args)
             if defer is not None and attempt == 0:
@@ -965,17 +993,17 @@ class Executor:
             _M_SHUFFLE_B.inc(out_bytes)
             if of:
                 _M_CAP_RETRIES.inc()
-            self._event({"event": "stage_done", "stage": stage.id,
-                         "label": stage.label, "attempt": attempt,
-                         "scale": scale, "slack": slack, "overflow": of,
-                         "need_scale": need_scale,
-                         "need_slack": need_slack,
-                         "need_exchange": need_exch, "salted": salted,
-                         "rows": rows, "out_bytes": out_bytes,
-                         "compile_s": round(compile_s, 4),
-                         "cache_hit": cache_hit,
-                         "dispatches": 2,   # program launch + info fetch
-                         "wall_s": round(wall, 4)})
+            ev({"event": "stage_done", "stage": stage.id,
+                "label": stage.label, "attempt": attempt,
+                "scale": scale, "slack": slack, "overflow": of,
+                "need_scale": need_scale,
+                "need_slack": need_slack,
+                "need_exchange": need_exch, "salted": salted,
+                "rows": rows, "out_bytes": out_bytes,
+                "compile_s": round(compile_s, 4),
+                "cache_hit": cache_hit,
+                "dispatches": 2,   # program launch + info fetch
+                "wall_s": round(wall, 4)})
             decision = self._decide_needs(stage, scale, slack, salted,
                                           need_scale, need_slack,
                                           need_exch)
@@ -983,17 +1011,21 @@ class Executor:
                 stage._capacity_scale = scale
                 stage._send_slack = slack
                 stage._salted = salted
-                self._check_cost(stage, scale, int(sum(rows)), out_bytes)
+                self._check_cost(stage, scale, int(sum(rows)), out_bytes,
+                                 report=cost_report, event=ev)
                 pd = PData(out_batch, self.nparts)
                 if getattr(self.config, "adaptive", "off") == "on":
                     # rows arrived replicated on multi-process meshes,
                     # so every gang member records identical stats and
                     # the rewrite rules stay mirrored
                     from dryad_tpu.adapt.stats import StageStats
-                    self._last_stage_stats = StageStats(
+                    st = StageStats(
                         stage.id, tuple(int(r) for r in rows),
                         capacity=int(pd.capacity), out_bytes=out_bytes,
                         wall_s=round(wall, 4))
+                    self._last_stage_stats = st
+                    if stats_box is not None:
+                        stats_box[0] = st
                 return pd
             # right-size from the measured requirements (the dynamic
             # distribution managers' size feedback, DrDynamicDistributor

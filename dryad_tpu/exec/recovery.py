@@ -15,6 +15,15 @@ seeded sampling), so replay is exact.  With ``spill_dir`` set, every stage
 output is also persisted as a columnar store; recovery then reloads from
 disk instead of recomputing, and a NEW process can resume the run
 (checkpoint/resume, which the reference lacks — SURVEY.md §5).
+
+A ``Run`` is also the per-JOB driver state boundary (the reference's
+one-Graph-Manager-per-job model made this per-process; the job-service
+daemon runs many concurrent jobs in one process, dryad_tpu/service):
+the event sink, failure budget, adaptive manager, cost report, and
+observed-stats slot all live on the Run, never on the shared Executor.
+``event=`` overrides the executor's process-default sink and ``job=``
+tags every emitted event with the job id, so two concurrent Runs over
+ONE executor can never interleave their streams.
 """
 
 from __future__ import annotations
@@ -40,13 +49,34 @@ class Run:
                  spill_dir: Optional[str] = None,
                  failure_budget: Optional[int] = None,
                  spill_compression: Optional[str] = None,
-                 cost_report=None):
+                 cost_report=None, event=None, job=None):
         cfg = getattr(executor, "config", None)
         self.ex = executor
         self.graph = graph
         self.bindings = bindings or {}
         self.spill_dir = spill_dir
         self.cost_report = cost_report
+        self.job = job
+        # per-job event sink: explicit ``event`` wins over the executor's
+        # process default; with a job id every event is tagged so streams
+        # from concurrent jobs sharing one executor never interleave
+        # anonymously (the sink keeps the underlying EventLog's level so
+        # span gating still sees the consumer's verdict)
+        sink = event if event is not None else executor._event
+        if job is not None:
+            base = sink
+
+            def _tagged(e, _base=base, _job=job):
+                e.setdefault("job", _job)
+                _base(e)
+
+            from dryad_tpu.obs import trace as _trace
+            sink = _trace.leveled(_tagged, getattr(base, "level", None))
+        self._event = sink
+        # observed-stats slot for the adaptive boundary hook: a one-slot
+        # box owned by THIS run (a shared executor attribute would let a
+        # concurrent job's stage leak its stats into our rewrite rules)
+        self._stats_box = [None]
         self.spill_compression = (spill_compression if spill_compression
                                   is not None else
                                   (cfg.spill_compression if cfg else None))
@@ -72,7 +102,7 @@ class Run:
             self.adapt = AdaptiveManager(
                 graph, cfg, executor.nparts,
                 levels=levels_of_mesh(getattr(executor, "mesh", None)),
-                event=executor._event, cost_report=cost_report)
+                event=self._event, cost_report=cost_report)
         defer_ok = (getattr(cfg, "deferred_needs", True) if cfg else True)
         self._defer = ([] if defer_ok and not spill_dir
                        and not adaptive_on
@@ -86,8 +116,8 @@ class Run:
         # plan would not match the stage events
         try:
             from dryad_tpu.plan.serialize import graph_to_json
-            self.ex._event({"event": "plan",
-                            "plan": graph_to_json(graph)})
+            self._event({"event": "plan",
+                         "plan": graph_to_json(graph)})
         except Exception:
             pass  # plan serialization must never block execution
 
@@ -107,7 +137,7 @@ class Run:
         # (runtime/worker.py) — sampling here too would double-report
         # them under a driver label.
         sampler = _profile.start(
-            self.ex._event if os.environ.get("DRYAD_WORKER_ID") is None
+            self._event if os.environ.get("DRYAD_WORKER_ID") is None
             else None,
             getattr(getattr(self.ex, "config", None),
                     "resource_sample_s", 0.0) or 0.0,
@@ -116,7 +146,7 @@ class Run:
             # the job span: every stage/io span of this run parents into
             # it (on a worker the envelope's trace_ctx makes it a child
             # of the driver's job span — obs/trace.py propagation)
-            with trace.span("run", "job", sink=self.ex._event,
+            with trace.span("run", "job", sink=self._event,
                             stages=len(self.graph.stages)):
                 # re-read out_stage after the walk: an adaptive rewrite
                 # (agg-tree expansion) may have redirected it to an
@@ -153,7 +183,7 @@ class Run:
                 continue
             reach.add(sid)
             frontier.extend(self.graph.stage(sid).input_stage_ids())
-        self.ex._event({"event": "progress",
+        self._event({"event": "progress",
                         "done": len(reach & set(self._results)),
                         "total": len(reach), "pct": 100.0})
         # job-end metrics snapshot.  "metrics" carries CUMULATIVE
@@ -162,7 +192,7 @@ class Run:
         # (runtime/worker.py sets _emit_job_done=False) — a 16-task farm
         # is one job, not 16.
         if getattr(self.ex, "_emit_job_done", True):
-            self.ex._event({"event": "job_done",
+            self._event({"event": "job_done",
                             "wall_s": round(_time.time() - t0, 4),
                             "stages": len(self.graph.stages),
                             "replays": self.failures,
@@ -195,7 +225,7 @@ class Run:
             need_slack = int(info[:, 1].max())
             need_exch = int(info[:, 2].max())
             of = need_scale > 0 or need_slack > 0
-            self.ex._event({
+            self._event({
                 "event": "stage_done", "stage": stage.id,
                 "label": stage.label, "attempt": 0,
                 "scale": rec["scale"], "slack": rec["slack"],
@@ -215,7 +245,9 @@ class Run:
                 # below and cross-check on their synchronous re-run
                 self.ex._check_cost(stage, rec["scale"],
                                     int(info[:, 3].sum()),
-                                    rec.get("out_bytes", 0))
+                                    rec.get("out_bytes", 0),
+                                    report=self.cost_report,
+                                    event=self._event)
             if of:
                 # the deferred path counts runs/bytes at enqueue
                 # (executor defer branch); the overflow verdict only
@@ -261,7 +293,7 @@ class Run:
                 st._salted = salted
             for sid in dirty:
                 self._results.pop(sid, None)
-            self.ex._event({"event": "settle_replay",
+            self._event({"event": "settle_replay",
                             "stages": sorted(dirty)})
         return self.result(self.graph.out_stage)
 
@@ -302,17 +334,20 @@ class Run:
         # deferred path this covers the enqueue only — the device time
         # lands in the settle's stage_done events)
         with trace.span(f"stage {stage.id}:{stage.label}", "stage",
-                        sink=self.ex._event, stage=stage.id,
+                        sink=self._event, stage=stage.id,
                         label=stage.label,
                         deferred=self._defer is not None):
             out = self.ex._run_stage(stage, self._results, self.bindings,
-                                     defer=self._defer)
+                                     defer=self._defer, event=self._event,
+                                     cost_report=self.cost_report,
+                                     stats_box=self._stats_box,
+                                     job=self.job)
         self._results[sid] = out
         self._save_spill(sid, out)
         # progress percentage pushed to the event stream (the reference
         # pushes it to the launcher, DrGraph.cpp:109-110)
         total = len(self.graph.stages)
-        self.ex._event({"event": "progress", "done": len(self._results),
+        self._event({"event": "progress", "done": len(self._results),
                         "total": total,
                         "pct": round(100.0 * len(self._results) / total, 1)})
         # adaptive boundary: the unexecuted suffix may be rewritten from
@@ -320,7 +355,7 @@ class Run:
         # connection-manager hook, DrConnectionManager
         # NotifyUpstreamVertexCompleted parity)
         if self.adapt is not None:
-            st = getattr(self.ex, "_last_stage_stats", None)
+            st = self._stats_box[0]
             if st is not None and st.stage == sid:
                 n_before = len(self.adapt.applied)
                 self.adapt.on_stage_materialized(st, set(self._results))
@@ -341,7 +376,7 @@ class Run:
         """Report a lost stage output (fault injection / preemption)."""
         if count_failure:
             self.failures += 1
-            self.ex._event({"event": "stage_replay", "stage": sid,
+            self._event({"event": "stage_replay", "stage": sid,
                             "label": self.graph.stage(sid).label,
                             "failures": self.failures})
             if self.failures > self.failure_budget:
@@ -380,7 +415,7 @@ class Run:
             # the executed shape so loads can refuse mismatches.
             with open(self._spill_path(sid) + ".fp", "w") as f:
                 f.write(self._stage_fp(sid))
-        self.ex._event({"event": "stage_spilled", "stage": sid})
+        self._event({"event": "stage_spilled", "stage": sid})
 
     def _load_spill(self, sid: int) -> Optional[PData]:
         if not self.spill_dir:
@@ -409,5 +444,5 @@ class Run:
             return None
         from dryad_tpu.io.store import read_store
         pd = read_store(p, self.ex.mesh)
-        self.ex._event({"event": "stage_restored", "stage": sid})
+        self._event({"event": "stage_restored", "stage": sid})
         return pd

@@ -222,10 +222,14 @@ def render_history_text(entries: List[Dict[str, Any]]) -> str:
 
 
 def index_html(entries: List[Dict[str, Any]],
-               title: str = "dryad job history") -> str:
+               title: str = "dryad job history",
+               extra_html: str = "") -> str:
     """The history index page (the JobBrowser job-list view): one row
     per archived job, failure headlines inline, split deltas vs the
-    previous run of the same app."""
+    previous run of the same app.  ``extra_html`` is injected above the
+    archive table — the service daemon (dryad_tpu/service) promotes this
+    page to its LIVE multi-job dashboard by prepending the running-jobs
+    and tenant tables there."""
     rows = []
     for s in reversed(entries):       # newest first
         dw = s.get("d_wall_pct")
@@ -274,6 +278,7 @@ def index_html(entries: List[Dict[str, Any]],
   .hl {{ color: var(--critical); font-size: 12px; }}
 </style></head>
 <body><h1>{_html.escape(title)}</h1>
+{extra_html}
 <p>{len(entries)} archived run(s); Δwall compares each run to the
 previous run of the same app.</p>
 <table>{head}{''.join(rows)}</table>

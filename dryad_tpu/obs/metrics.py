@@ -23,8 +23,9 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
-           "FAMILIES", "family_counter", "family_gauge",
-           "family_histogram", "metrics_dump", "metrics_from_events"]
+           "FAMILIES", "PER_JOB_FAMILIES", "family_counter",
+           "family_gauge", "family_histogram", "metrics_dump",
+           "metrics_from_events"]
 
 _DEF_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                 5.0, 10.0, 30.0, 60.0)
@@ -71,6 +72,17 @@ FAMILIES = {
     "io_bytes": ("dryad_io_bytes_total", "IO provider bytes moved"),
     "io_seconds": ("dryad_io_seconds_total", "IO provider wall"),
 }
+
+
+# families the runtime ALSO exposes with a per-job label when a job id
+# is known (the multi-tenant service labels its live instrumentation and
+# metrics_from_events(by_job=True) groups the derived mirror the same
+# way).  Every key must exist in FAMILIES — drift-tested so a renamed
+# family cannot silently lose its per-job view.
+PER_JOB_FAMILIES = ("queue_depth", "task_seconds", "graph_rewrites",
+                    "cache_hits", "cache_misses", "tasks", "jobs",
+                    "jobs_failed", "stage_runs", "shuffle_bytes",
+                    "compile_seconds", "run_seconds")
 
 
 def family_counter(reg: "Registry", key: str, **labels) -> "Counter":
@@ -229,6 +241,20 @@ class Registry:
         with self._lock:
             self._metrics.clear()
 
+    def prune(self, **labels) -> int:
+        """Drop every metric whose label set contains all of ``labels``
+        (e.g. ``prune(job=jid)``); returns the number removed.  A
+        persistent multi-job process (the service daemon) retires a
+        terminal job's per-job series with this so unique job-id labels
+        cannot grow the registry without bound."""
+        want = {(k, str(v)) for k, v in labels.items()}
+        with self._lock:
+            dead = [key for key in self._metrics
+                    if want <= set(key[1])]
+            for key in dead:
+                del self._metrics[key]
+        return len(dead)
+
     def merge_from(self, other: "Registry") -> "Registry":
         """Copy families from ``other`` that this registry does not
         already hold (event-derived metrics win over live ones, so a
@@ -277,18 +303,36 @@ def metrics_dump() -> str:
     return REGISTRY.render()
 
 
-def metrics_from_events(events, registry: Optional[Registry] = None
-                        ) -> Registry:
+def metrics_from_events(events, registry: Optional[Registry] = None,
+                        by_job: bool = False) -> Registry:
     """Derive the counter families from a recorded event stream (the
     post-hoc path: a viewer holding only the JSONL).  Families mirror
-    the live instrumentation so scrape dashboards work on either."""
+    the live instrumentation so scrape dashboards work on either.
+
+    ``by_job=True`` additionally GROUPS the :data:`PER_JOB_FAMILIES` by
+    each event's ``job`` tag (the per-job namespacing the service daemon
+    stamps on every event) — events without a tag keep the unlabeled
+    family, so single-job streams render unchanged."""
     r = registry or Registry()
+
+    def C(key: str, e: dict, **labels) -> Counter:
+        if (by_job and key in PER_JOB_FAMILIES
+                and e.get("job") is not None):
+            labels["job"] = str(e["job"])
+        return family_counter(r, key, **labels)
+
+    def H(key: str, e: dict) -> Histogram:
+        if (by_job and key in PER_JOB_FAMILIES
+                and e.get("job") is not None):
+            return family_histogram(r, key, job=str(e["job"]))
+        return family_histogram(r, key)
+
     for e in events:
         k = e.get("event")
         if k == "task_done":
-            family_counter(r, "tasks").inc()
+            C("tasks", e).inc()
             if e.get("wall_s") is not None:
-                family_histogram(r, "task_seconds").observe(e["wall_s"])
+                H("task_seconds", e).observe(e["wall_s"])
             if "dup_won" in e:
                 family_counter(r, "straggler_dups",
                                result="won" if e["dup_won"] else "lost"
@@ -299,32 +343,29 @@ def metrics_from_events(events, registry: Optional[Registry] = None
                    "worker_ping_timeout"):
             family_counter(r, "task_retries", reason=k).inc()
         elif k in ("stage_done", "stream_stage_done"):
-            family_counter(r, "stage_runs").inc()
+            C("stage_runs", e).inc()
             if e.get("overflow"):
                 family_counter(r, "cap_retries").inc()
             if e.get("out_bytes"):
-                family_counter(r, "shuffle_bytes").inc(e["out_bytes"])
+                C("shuffle_bytes", e).inc(e["out_bytes"])
             if e.get("compile_s"):
-                family_counter(r, "compile_seconds").inc(e["compile_s"])
+                C("compile_seconds", e).inc(e["compile_s"])
             if e.get("wall_s"):
-                family_counter(r, "run_seconds").inc(e["wall_s"])
+                C("run_seconds", e).inc(e["wall_s"])
             if "cache_hit" in e:
-                family_counter(r, "cache_hits"
-                               ).inc(1 if e["cache_hit"] else 0)
-                family_counter(r, "cache_misses"
-                               ).inc(0 if e["cache_hit"] else 1)
+                C("cache_hits", e).inc(1 if e["cache_hit"] else 0)
+                C("cache_misses", e).inc(0 if e["cache_hit"] else 1)
         elif k in ("stage_replay", "settle_replay"):
             family_counter(r, "stage_replays").inc()
         elif k == "graph_rewrite":
-            family_counter(r, "graph_rewrites",
-                           rule=e.get("rule", "?"),
-                           kind=e.get("kind", "?")).inc()
+            C("graph_rewrites", e,
+              rule=e.get("rule", "?"), kind=e.get("kind", "?")).inc()
         elif k == "stream_tee_spill":
             family_counter(r, "tee_spills").inc()
         elif k == "job_done":
-            family_counter(r, "jobs").inc()
+            C("jobs", e).inc()
         elif k == "job_failed":
-            family_counter(r, "jobs_failed").inc()
+            C("jobs_failed", e).inc()
         elif k == "span" and e.get("kind") == "io":
             a = e.get("attrs") or {}
             op = e.get("name", "io")

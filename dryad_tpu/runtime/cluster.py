@@ -269,6 +269,29 @@ class LocalCluster(ClusterBackend):
     def recv_frames(self, pid: int, job: int):
         return self._recv_frames(pid, job)
 
+    def recv_frames_any(self, pid: int):
+        """One non-blocking drain of ``pid``'s socket returning EVERY
+        complete frame regardless of job tag: the multi-tenant service
+        loop (dryad_tpu/service) multiplexes many concurrent jobs over
+        one fleet and routes each frame to its job's driver state by the
+        frame's ``protocol.JOB_ID`` tag itself.  Same ``(frames, alive)``
+        contract as :meth:`recv_frames`."""
+        got = self._drain_socket(pid)
+        if got is not True:
+            return [], got is False       # None = dead, False = no data
+        out: List[dict] = []
+        try:
+            while True:
+                r = _try_decode(self._bufs[pid])
+                if r is None:
+                    break
+                out.append(r)
+        except WorkerFailure:
+            # a desynced stream poisons only THIS worker for the service
+            # loop (it owns per-worker reaction); report it dead
+            return out, False
+        return out, True
+
     def log_tails(self) -> str:
         return self._log_tails()
 
@@ -387,23 +410,34 @@ class LocalCluster(ClusterBackend):
         except Exception:
             pass
 
+    def _drain_socket(self, pid: int) -> Optional[bool]:
+        """One non-blocking recv into ``pid``'s frame buffer (the step
+        shared by :meth:`_recv_frames` and :meth:`recv_frames_any` —
+        only the decode policy differs between them).  True = bytes
+        buffered, False = nothing to read right now, None = socket
+        closed/broken (the caller treats the worker as dead)."""
+        s = self._socks.get(pid)
+        if s is None:
+            return None
+        try:
+            chunk = s.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        self._bufs[pid].extend(chunk)
+        return True
+
     def _recv_frames(self, pid: int, job: int):
         """One non-blocking drain of ``pid``'s socket: returns
         ``(replies_for_job, alive)``.  ``alive=False`` means the socket is
         closed/broken — the caller picks the site-appropriate reaction
         (gang teardown, grace-period skip, or farm reassignment)."""
-        s = self._socks.get(pid)
-        if s is None:
-            return [], False
-        try:
-            chunk = s.recv(1 << 20)
-        except (BlockingIOError, InterruptedError):
-            return [], True
-        except OSError:
-            return [], False
-        if not chunk:
-            return [], False
-        self._bufs[pid].extend(chunk)
+        got = self._drain_socket(pid)
+        if got is not True:
+            return [], got is False
         return self._decode_job_frames(pid, job), True
 
     def _decode_job_frames(self, pid: int, job: int) -> List[dict]:

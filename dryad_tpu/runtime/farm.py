@@ -93,11 +93,20 @@ class TaskFarm:
                  abs_margin_s: Optional[float] = None,
                  config=None,
                  delay_hook: Optional[Callable[[int, int], float]] = None,
-                 worker_hosts: Optional[Dict[int, str]] = None):
+                 worker_hosts: Optional[Dict[int, str]] = None,
+                 job_label: Optional[str] = None):
         from dryad_tpu.utils.config import JobConfig
         cfg = config or JobConfig()
         self.config = cfg
         self.cluster = cluster
+        # per-job metric namespacing (obs/metrics.PER_JOB_FAMILIES): when
+        # the caller names the job (the service daemon always does), the
+        # queue-depth gauge and task histogram carry a job label so
+        # concurrent jobs' scrapes never merge; unset = the historical
+        # unlabeled families
+        self.job_label = job_label
+        self._job_labels = ({"job": job_label} if job_label is not None
+                            else {})
         self.duplication_budget = (
             duplication_budget if duplication_budget is not None
             else (cfg.speculation_duplication_budget
@@ -172,7 +181,8 @@ class TaskFarm:
         tsink = trace.leveled(self._emit,
                               getattr(cl.event_log, "level", None)
                               if cl.event_log is not None else 0)
-        queue_gauge = family_gauge(REGISTRY, "queue_depth")
+        queue_gauge = family_gauge(REGISTRY, "queue_depth",
+                                   **self._job_labels)
         farm_span = trace.start("farm", "farm", sink=tsink,
                                 job=job, tasks=len(per_task_sources))
         # driver-side resource sampler for the farm's duration (workers
@@ -206,7 +216,8 @@ class TaskFarm:
         from dryad_tpu.obs.metrics import REGISTRY, family_histogram
 
         cl = self.cluster
-        task_hist = family_histogram(REGISTRY, "task_seconds")
+        task_hist = family_histogram(REGISTRY, "task_seconds",
+                                     **self._job_labels)
         hosts = (self.worker_hosts if self.worker_hosts is not None
                  else (cl.worker_hosts()
                        if hasattr(cl, "worker_hosts") else {}))
@@ -261,10 +272,11 @@ class TaskFarm:
             try:
                 sock.setblocking(True)
                 protocol.send_msg(sock, protocol.attach_trace(
-                    {"cmd": "run_task", "plan": plan_json,
-                     "sources": task.sources,
-                     "task": task.idx, "job": job,
-                     "config": self.config, "delay_s": delay},
+                    protocol.attach_job(
+                        {"cmd": "run_task", "plan": plan_json,
+                         "sources": task.sources,
+                         "task": task.idx,
+                         "config": self.config, "delay_s": delay}, job),
                     trace.ctx_of(sp if sp is not None else farm_span)))
                 sock.setblocking(False)
             except OSError:

@@ -52,6 +52,27 @@ def extract_trace(msg: dict):
     return ctx if isinstance(ctx, dict) else None
 
 
+# -- job namespacing ---------------------------------------------------------
+# Every job/task envelope and every reply carries a JOB tag: workers echo
+# it verbatim, schedulers discard stale frames by it (runtime/cluster.py
+# _decode_job_frames), and the multi-tenant service daemon routes frames
+# from MANY concurrent jobs sharing one fleet back to the right per-job
+# driver state by it (dryad_tpu/service).  One constant + two helpers so
+# every attach/read site names the same field.
+JOB_ID = "job"
+
+
+def attach_job(msg: dict, job) -> dict:
+    """Tag an outgoing envelope with its job id (in place; returns msg)."""
+    msg[JOB_ID] = job
+    return msg
+
+
+def extract_job(msg: dict):
+    """The envelope/reply's job tag, or None."""
+    return msg.get(JOB_ID)
+
+
 # -- failure forensics -------------------------------------------------------
 # A failing worker's error reply may carry a FORENSICS field: the flight
 # recorder's self-contained bundle (obs/flight.py — task envelope, input

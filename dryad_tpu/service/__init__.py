@@ -1,0 +1,38 @@
+"""Multi-tenant job service: one persistent daemon, many concurrent
+jobs on a shared fleet (ROADMAP item 1).
+
+The reference runs one Graph Manager process per job (PAPER.md layer 3,
+Dryad §3) — nothing is amortized across jobs and tenancy is delegated
+to the cluster scheduler.  This package inverts that into a serving
+stack: :class:`JobService` is a long-lived daemon that owns the fleet
+and the caches, admits jobs from many tenants through a weighted
+fair-share :class:`~dryad_tpu.service.admission.AdmissionQueue` with
+per-tenant quotas and typed DTA91x rejections, gives every job its own
+driver state (event log, metrics labels, forensics dir, failure budget
+— the per-job refactor of ``exec/recovery.Run``), and shares what
+should be shared: the workers, the compiled-stage caches, the
+persistent XLA cache, and the :class:`~dryad_tpu.utils.compile_cache.
+FileCache` of serialized plans, so the Nth user of an app pays zero
+compile (BENCH_obs: compile is ~0.75s of a ~1.0s job — amortizing it
+IS the latency story).
+
+Front end: ``python -m dryad_tpu.service serve|submit|status|cancel|
+list|wait`` over HTTP (``service/http.py``); the dashboard at ``/`` is
+the obs/history index promoted to a live multi-job view.  See
+docs/service.md.
+"""
+
+from dryad_tpu.service.admission import AdmissionQueue
+from dryad_tpu.service.apps import APPS, ServiceApp, get_app
+from dryad_tpu.service.daemon import JobService
+from dryad_tpu.service.job import ServiceJob
+from dryad_tpu.service.tenancy import (FailureBudgetError,
+                                       MalformedJobError, QueueFullError,
+                                       ServiceConfig, ServiceRejected,
+                                       ServiceStoppedError, TenantQuota,
+                                       UnknownAppError)
+
+__all__ = ["JobService", "ServiceConfig", "TenantQuota", "ServiceJob",
+           "AdmissionQueue", "APPS", "ServiceApp", "get_app",
+           "ServiceRejected", "QueueFullError", "FailureBudgetError",
+           "UnknownAppError", "MalformedJobError", "ServiceStoppedError"]

@@ -1,0 +1,830 @@
+"""The persistent job-service daemon: one process, many concurrent jobs,
+one shared fleet.
+
+The reference runs one Graph Manager per job (PAPER.md layer 3) — job
+lifetime IS process lifetime, and nothing is amortized across jobs.
+``JobService`` inverts that: a long-lived daemon owns the fleet and the
+caches, admits jobs from many tenants through the fair-share
+:class:`~dryad_tpu.service.admission.AdmissionQueue`, gives each job its
+own driver state (:class:`~dryad_tpu.service.job.ServiceJob` + the
+per-job ``exec/recovery.Run`` refactor), and shares what SHOULD be
+shared: the worker fleet, the in-memory compiled-stage caches (worker
+executors persist across jobs — the Nth user of an app pays zero
+compile, the DryadLINQ vertex-DLL-reuse argument at service scale), the
+on-disk XLA cache, and the :class:`FileCache` of serialized plans.
+
+Two fleet shapes:
+
+* **in-process** (``cluster=None``): a thread pool of ``slots`` driver
+  threads over ONE shared Executor/mesh — concurrent jobs in one
+  process, zero worker overhead (the bench smoke + quota tests run
+  here);
+* **cluster** (``cluster=LocalCluster(...)``): a single multiplexing
+  dispatch loop over the cluster's control sockets — tasks from MANY
+  jobs interleave on the shared workers, replies route back to each
+  job's driver state by the envelope's ``protocol.JOB_ID`` tag.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import select
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from dryad_tpu.obs.metrics import (REGISTRY, family_counter, family_gauge,
+                                   family_histogram)
+from dryad_tpu.service.admission import AdmissionQueue
+from dryad_tpu.service.apps import get_app, task_capacity
+from dryad_tpu.service.job import ServiceJob
+from dryad_tpu.service.tenancy import (MalformedJobError, ServiceConfig,
+                                       ServiceRejected,
+                                       ServiceStoppedError)
+from dryad_tpu.utils.events import EventLog
+
+__all__ = ["JobService"]
+
+# legal tenant/app names: they are composed into job ids and on-disk
+# paths, so no separators or dot-prefixes (path traversal)
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+def _now() -> float:
+    return time.time()
+
+
+class JobService:
+    """See module docstring.  ``config`` is a ServiceConfig; ``cluster``
+    (optional) a started ClusterBackend whose workers serve the fleet —
+    pass ``own_cluster=True`` if the service should shut it down on
+    close.  Without a cluster, jobs run in-process on a shared mesh +
+    executor (``mesh`` overrides the default)."""
+
+    def __init__(self, config: ServiceConfig, cluster=None, mesh=None,
+                 own_cluster: bool = False):
+        from dryad_tpu.utils.config import JobConfig
+        self.config = config
+        self.job_config = config.job_config or JobConfig()
+        root = os.path.abspath(os.path.expanduser(config.service_dir))
+        self.root = root
+        self.jobs_dir = os.path.join(root, "jobs")
+        self.history_dir = os.path.join(root, "history")
+        for d in (self.jobs_dir, self.history_dir):
+            os.makedirs(d, exist_ok=True)
+        # the daemon's own lifecycle log (rejections included: a refused
+        # submission starts zero work, so it has no job log to land in)
+        self.log = EventLog(os.path.join(root, "service.jsonl"))
+        from dryad_tpu.utils.compile_cache import FileCache
+        self.plan_cache = FileCache(os.path.join(root, "cache"))
+        self.admission = AdmissionQueue(config.quota)
+        self.jobs: Dict[str, ServiceJob] = {}
+        self._jobs_lock = threading.Lock()
+        self._seq = 0
+        self._stopping = False
+        self.cluster = cluster
+        self._own_cluster = own_cluster
+        if cluster is not None:
+            self.mesh = None
+            self.executor = None
+            self.nparts = cluster.devices_per_process
+            self._fleet = _ClusterFleet(self)
+        else:
+            from dryad_tpu.exec.executor import Executor
+            from dryad_tpu.parallel.mesh import make_mesh
+            self.mesh = mesh if mesh is not None else make_mesh()
+            self.nparts = self.mesh.devices.size
+            # ONE executor shared by every in-process job: its compiled-
+            # stage cache is the warm-compile story (per-job state lives
+            # on each job's Run, never here)
+            self.executor = Executor(self.mesh, config=self.job_config)
+            self._fleet = _LocalFleet(self, config.slots)
+        self.log({"event": "service_started",
+                  "fleet": ("cluster" if cluster is not None
+                            else "in-process"),
+                  "slots": self.slots, "dir": root})
+        self._fleet.start()
+
+    @property
+    def slots(self) -> int:
+        if self.cluster is not None:
+            return len(self.cluster.sockets)
+        return self.config.slots
+
+    # -- submission --------------------------------------------------------
+
+    def _reject_teardown(self, job: ServiceJob, err) -> None:
+        """Zero-work rejection teardown: the job's directory state goes
+        away and the refusal lands in the SERVICE log only (no history
+        archive — the job never existed as far as tenants see)."""
+        job.log.history_dir = None
+        job.log.close()
+        try:
+            os.unlink(job.log.path)
+            os.rmdir(job.dir)
+        except OSError:
+            pass
+        self.log({"event": "job_rejected", "tenant": job.tenant,
+                  "app": job.app, "code": err.code, "error": str(err)})
+
+    def _admit(self, job: ServiceJob) -> str:
+        try:
+            self.admission.submit(job)
+        except ServiceRejected as e:
+            self._reject_teardown(job, e)
+            raise
+        with self._jobs_lock:
+            self.jobs[job.id] = job
+            self._prune_terminal_locked()
+        if self._stopping:
+            # close() may have swept between _new_job's check and this
+            # registration — its sweep can no longer see us, so take the
+            # FULL rejection path ourselves (nobody holds the id yet)
+            self.admission.retire(job)
+            with self._jobs_lock:
+                self.jobs.pop(job.id, None)
+            err = ServiceStoppedError()
+            self._reject_teardown(job, err)
+            raise err
+        job.event({"event": "job_submitted", "tenant": job.tenant,
+                   "app": job.app, "priority": job.priority,
+                   "tasks": job.n_tasks})
+        self.log({"event": "job_submitted", "job": job.id,
+                  "tenant": job.tenant, "app": job.app})
+        self._fleet.wake()
+        return job.id
+
+    def _prune_terminal_locked(self) -> None:
+        """Keep at most ``max_terminal_jobs`` TERMINAL jobs resident
+        (holds self._jobs_lock): the oldest drop from the live table and
+        their per-job metric series leave the registry — a persistent
+        daemon's memory must not scale with lifetime job count.  Disk
+        state (job dir, history archive) is untouched."""
+        cap = getattr(self.config, "max_terminal_jobs", 256)
+        term = [j for j in self.jobs.values()
+                if j.state in ("done", "failed", "cancelled")]
+        if len(term) <= cap:
+            return
+        term.sort(key=lambda j: j.seq)
+        for j in term[:len(term) - cap]:
+            del self.jobs[j.id]
+            REGISTRY.prune(job=j.id)
+
+    @staticmethod
+    def _check_names(app: str, tenant: str) -> None:
+        """tenant/app are caller-supplied strings composed into the
+        on-disk job path: reject anything that could traverse outside
+        service_dir ("../..", separators) or mangle the id format —
+        BEFORE any per-name state (admission tenant records included)
+        exists anywhere."""
+        for field, val in (("tenant", tenant), ("app", app)):
+            if not _NAME_RE.match(val):
+                raise MalformedJobError(app, ValueError(
+                    f"illegal {field} name {val!r} (allowed: letters, "
+                    f"digits, then . _ - up to 64 chars)"))
+
+    def _new_job(self, app: str, tenant: str, priority: int,
+                 n_tasks: int, **kw) -> ServiceJob:
+        if self._stopping:
+            raise ServiceStoppedError()
+        self._check_names(app, tenant)
+        with self._jobs_lock:
+            self._seq += 1
+            seq = self._seq
+        jid = f"{tenant}-{app}-{seq}"
+        return ServiceJob(jid, tenant, app, seq, priority, n_tasks,
+                          os.path.join(self.jobs_dir, jid),
+                          self.job_config, history_dir=self.history_dir,
+                          **kw)
+
+    def submit(self, app: str, params: Optional[dict] = None,
+               tenant: str = "default", priority: int = 0) -> str:
+        """Submit a registered app; returns the job id.  Raises the
+        typed DTA91x rejections (tenancy.py) — and the lint gate's
+        DiagnosticError for a statically rejected plan — with zero work
+        started."""
+        from dryad_tpu.analysis.diagnostics import (DiagnosticError,
+                                                    LintError)
+        service_app = get_app(app)       # DTA910 before any state
+        self._check_names(app, tenant)   # ... so is a bad tenant name
+        if self._stopping:               # DTA913 before any state too
+            raise ServiceStoppedError()
+        # advisory quota precheck BEFORE paying for payload/plan
+        # building (submit()'s atomic check stays authoritative)
+        self.admission.precheck(tenant)
+        params = dict(params or {})
+        try:
+            if self.cluster is not None:
+                payload = self._build_farm_payload(service_app, params)
+            else:
+                # build (and thereby validate) the tasks NOW so bad
+                # params reject the SUBMISSION, not the running job
+                tasks = service_app.make_tasks(dict(params),
+                                               self.nparts)
+                run_local = self._build_local_runner(service_app,
+                                                     params, tasks)
+        except (ServiceRejected, DiagnosticError, LintError):
+            raise                        # already typed (DTA910/2xx/9xx)
+        except (ValueError, TypeError, KeyError, IndexError) as e:
+            # app builders choking on the PARAMS is a malformed job
+            # spec — the documented DTA910, never an untyped 500.
+            # Anything else (OSError on the plan cache, an internal
+            # planner bug) propagates untyped: blaming the client's
+            # params for an operator-side failure would hide it
+            raise MalformedJobError(app, e)
+        if self.cluster is not None:
+            job = self._new_job(app, tenant, priority,
+                                len(payload["sources"]),
+                                params=params, payload=payload,
+                                combine=service_app.combine)
+        else:
+            job = self._new_job(app, tenant, priority, 1, params=params,
+                                run_local=run_local)
+        return self._admit(job)
+
+    def submit_tasks(self, plan_json: str, per_task_sources: List[dict],
+                     tenant: str = "default", priority: int = 0,
+                     app: str = "custom",
+                     combine: Optional[Callable] = None) -> str:
+        """Python-API submission of a pre-serialized plan + per-task
+        sources (cluster fleet only) — the raw TaskFarm surface behind
+        the admission queue."""
+        if self.cluster is None:
+            raise ValueError("submit_tasks needs a cluster fleet")
+        job = self._new_job(app, tenant, priority, len(per_task_sources),
+                            payload={"plan": plan_json,
+                                     "sources": list(per_task_sources)},
+                            combine=combine)
+        return self._admit(job)
+
+    def submit_callable(self, fn: Callable, tenant: str = "default",
+                        priority: int = 0, app: str = "callable") -> str:
+        """In-process submission of a driver callable ``fn(env)`` where
+        ``env`` carries the shared ``executor``/``mesh`` and the job's
+        ``event`` sink / ``job_id`` / ``config`` (tests and embedders)."""
+        if self.cluster is not None:
+            raise ValueError("submit_callable needs the in-process fleet")
+
+        def run_local(service, job, _fn=fn):
+            import types
+            env = types.SimpleNamespace(
+                executor=service.executor, mesh=service.mesh,
+                event=job.event, job_id=job.id, config=job.config,
+                service=service)
+            return _fn(env)
+
+        job = self._new_job(app, tenant, priority, 1,
+                            run_local=run_local)
+        return self._admit(job)
+
+    # -- payload building --------------------------------------------------
+
+    def _plan_cache_key(self, app: str, params: dict) -> str:
+        """Restart-persistent plan-cache key.  Includes the base
+        JobConfig (planning consumes it — a daemon restarted with a
+        different config must not serve the old lowering) and the
+        package version as a code salt (an upgraded planner/app query
+        invalidates old entries instead of silently shipping stale
+        plans)."""
+        import dryad_tpu
+        return json.dumps(
+            {"app": app, "nparts": self.nparts, "params": params,
+             "config": repr(self.job_config),
+             "ver": getattr(dryad_tpu, "__version__", "dev")},
+            sort_keys=True, default=str)
+
+    def _build_farm_payload(self, service_app, params: dict) -> dict:
+        """(plan, per-task sources) for the cluster fleet.  The
+        serialized plan is memoized in the shared FileCache keyed by
+        (app, nparts, params, config, version): the Nth same-shaped
+        submission — across daemon restarts too — pays zero planning
+        (the compile side is amortized by the persistent worker
+        executors).  A cache MISS runs the full pre-submit lint/cost
+        gate (JobConfig.lint) exactly like every other submission
+        surface — a statically rejected plan never reaches the fleet
+        (and never enters the cache)."""
+        from dryad_tpu.runtime.sources import columns_spec
+        tasks = service_app.make_tasks(params, self.nparts)
+        cap = task_capacity(tasks, self.nparts)
+        key = self._plan_cache_key(service_app.name, params)
+        cached = self.plan_cache.get(key)
+        if cached is not None:
+            meta = json.loads(cached.decode())
+            plan_json, src_key = meta["plan"], meta["src_key"]
+        else:
+            from dryad_tpu.api.dataset import Context
+            from dryad_tpu.plan.planner import plan_query
+            from dryad_tpu.runtime.shiplan import serialize_for_cluster
+            ctx = Context(cluster=self.cluster, config=self.job_config,
+                          install_trace=False)
+            q = service_app.build_query(ctx, tasks[0], params,
+                                        capacity=cap)
+            graph = plan_query(q.node, self.nparts, hosts=1,
+                               config=self.job_config)
+            ctx._pre_submit_lint(q.node, cluster=True, graph=graph)
+            plan_json, specs = serialize_for_cluster(graph, ctx.fn_table)
+            (src_key,) = specs.keys()
+            self.plan_cache.put(key, json.dumps(
+                {"plan": plan_json, "src_key": src_key}).encode())
+        sources = [{src_key: columns_spec(t, self.nparts, capacity=cap,
+                                          str_max_len=service_app
+                                          .str_max_len)}
+                   for t in tasks]
+        return {"plan": plan_json, "sources": sources}
+
+    def _build_local_runner(self, service_app, params: dict,
+                            tasks: List[dict]) -> Callable:
+        """In-process driver: the whole job is ONE admission unit run on
+        a fleet thread against the SHARED executor with per-job driver
+        state (event sink + job tag + failure budget on the Run).
+
+        Query building, planning, and the pre-submit lint/cost gate all
+        run HERE — at submission time, on the caller's thread — so a
+        statically rejected plan is a typed rejection from submit()
+        with zero work started and zero failure-budget charge, exactly
+        like the cluster path (``install_trace=False``: the daemon's
+        sinks are fully explicit, the process-global tracer must not be
+        touched)."""
+        from dryad_tpu.api.dataset import Context
+        from dryad_tpu.plan.planner import plan_query
+        cols = {k: [x for t in tasks for x in t[k]] for k in tasks[0]}
+        ctx = Context(mesh=self.mesh, config=self.job_config,
+                      install_trace=False)
+        q = service_app.build_query(ctx, cols, params)
+        graph = plan_query(q.node, ctx.nparts, hosts=ctx.hosts,
+                           levels=ctx.levels, config=self.job_config)
+        cost_rep = ctx._pre_submit_lint(q.node, cluster=False,
+                                        graph=graph)
+
+        def run_local(service, job):
+            from dryad_tpu.exec.data import (maybe_shrink_for_collect,
+                                             pdata_to_host)
+            # the job ITSELF is the sink (sink protocol: __call__ +
+            # .level) — a bound method would hide the log's level from
+            # span gating and add a redundant copy per event
+            pd = service.executor.run(graph, cost_report=cost_rep,
+                                      event_log=job, job=job.id)
+            table = pdata_to_host(
+                maybe_shrink_for_collect(pd, config=job.config))
+            return service_app.combine([table])
+
+        return run_local
+
+    # -- job control -------------------------------------------------------
+
+    def job(self, job_id: str) -> ServiceJob:
+        with self._jobs_lock:
+            try:
+                return self.jobs[job_id]
+            except KeyError:
+                raise KeyError(f"unknown job {job_id!r}")
+
+    def status(self, job_id: str, with_result: bool = False) -> dict:
+        return self.job(job_id).to_row(with_result=with_result)
+
+    def result(self, job_id: str):
+        return self.job(job_id).result
+
+    def wait(self, job_id: str, timeout: Optional[float] = None) -> dict:
+        job = self.job(job_id)
+        job.wait(timeout)
+        return job.to_row(with_result=True)
+
+    def cancel(self, job_id: str) -> bool:
+        job = self.job(job_id)
+        ok = job.cancel()
+        if ok:
+            self.admission.retire(job)
+            self.log({"event": "job_cancelled", "job": job.id,
+                      "tenant": job.tenant})
+            family_gauge(REGISTRY, "queue_depth", job=job.id).set(0)
+        return ok
+
+    def list_jobs(self) -> List[dict]:
+        with self._jobs_lock:
+            return [j.to_row() for j in self.jobs.values()]
+
+    # -- dashboard / metrics -----------------------------------------------
+
+    def metrics_text(self) -> str:
+        return REGISTRY.render()
+
+    def dashboard_html(self) -> str:
+        """The live multi-job dashboard: the obs/history index page
+        (archived runs + deltas) promoted with the daemon's running-jobs
+        and tenant-shares tables on top."""
+        import html as _html
+
+        from dryad_tpu.obs.history import history_index, index_html
+        rows = []
+        for r in reversed(self.list_jobs()):
+            rows.append(
+                f"<tr><td>{_html.escape(r['job'])}</td>"
+                f"<td>{_html.escape(r['tenant'])}</td>"
+                f"<td>{_html.escape(r['app'])}</td>"
+                f"<td>{_html.escape(r['state'])}</td>"
+                f"<td>{r['tasks_done']}/{r['tasks']}</td>"
+                f"<td>{r['wall_s'] if r['wall_s'] is not None else '—'}"
+                f"</td></tr>")
+        shares = self.admission.shares()
+        srows = [
+            f"<tr><td>{_html.escape(t)}</td><td>{v[0]:.3f}</td>"
+            f"<td>{v[1]}</td><td>{v[2]}</td></tr>"
+            for t, v in sorted(shares.items())]
+        extra = (
+            "<h2>jobs</h2><table><tr><th>job</th><th>tenant</th>"
+            "<th>app</th><th>state</th><th>tasks</th><th>wall&nbsp;s"
+            "</th></tr>" + "".join(rows) + "</table>"
+            "<h2>tenants</h2><table><tr><th>tenant</th>"
+            "<th>slot&nbsp;s</th><th>running</th><th>failures</th></tr>"
+            + "".join(srows) + "</table><h2>history</h2>")
+        return index_html(history_index(self.history_dir),
+                          title="dryad job service", extra_html=extra)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, cancel_pending: bool = True) -> None:
+        """Stop admitting (DTA913), optionally cancel queued jobs, stop
+        the fleet, and close the service log."""
+        if self._stopping:
+            return
+        self._stopping = True
+        if cancel_pending:
+            for job in self.list_jobs():
+                j = self.jobs.get(job["job"])
+                if j is not None and j.state == "queued":
+                    self.cancel(j.id)
+        self._fleet.stop()
+        # the fleet is gone: any job still non-terminal (in flight when
+        # the daemon stopped) can never finish — fail it NOW so waiters
+        # release and its log closes/archives instead of hanging forever
+        for row in self.list_jobs():
+            j = self.jobs.get(row["job"])
+            if j is not None and j.state in ("queued", "running"):
+                j.pending.clear()
+                j.finish(False, error="service stopped with the job "
+                                      "in flight")
+                self.admission.retire(j)
+        self.log({"event": "service_stopped"})
+        self.log.close()
+        if self._own_cluster and self.cluster is not None:
+            self.cluster.shutdown()
+
+    def __enter__(self) -> "JobService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- fleets ------------------------------------------------------------------
+
+
+class _LocalFleet:
+    """In-process fleet: ``slots`` driver threads pulling admission
+    units; each unit is a whole job's driver run on the shared
+    executor."""
+
+    def __init__(self, service: JobService, slots: int):
+        self.service = service
+        self.slots = max(1, slots)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for i in range(self.slots):
+            t = threading.Thread(target=self._worker, name=f"fleet-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def wake(self) -> None:
+        pass          # workers poll the admission queue's condition
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def _worker(self) -> None:
+        svc = self.service
+        while not self._stop.is_set():
+            unit = svc.admission.next_unit(wait=0.2)
+            if unit is None:
+                continue
+            job, idx = unit
+            # snapshot the runner FIRST: a cancel() racing this check
+            # releases job.run_local (terminal jobs drop their inputs),
+            # and calling through a stale None would charge the tenant's
+            # failure budget for a cancellation
+            fn = job.run_local
+            if job.state == "cancelled" or fn is None:
+                svc.admission.on_done(job, idx, 0.0)
+                svc.admission.retire(job)
+                continue
+            job.mark_started()
+            family_gauge(REGISTRY, "queue_depth",
+                         job=job.id).set(len(job.pending))
+            t0 = _now()
+            ok, err = True, None
+            try:
+                res = fn(svc, job)
+            except Exception:
+                ok, err = False, traceback.format_exc()
+            wall = _now() - t0
+            svc.admission.on_done(job, idx, wall, ok=ok)
+            svc.admission.retire(job)
+            family_histogram(REGISTRY, "task_seconds",
+                             job=job.id).observe(wall)
+            family_gauge(REGISTRY, "queue_depth", job=job.id).set(0)
+            if ok:
+                # the per-job Run already emitted job_done for query
+                # jobs; only bare callables need the service to emit it
+                saw = any(e.get("event") == "job_done"
+                          for e in job.log.events)
+                job.result = res
+                job.finish(True, emit_job_done=not saw)
+            else:
+                job.finish(False, error=err)
+            # count by the ACTUAL terminal state: a job cancelled while
+            # its run was executing must not land in the completed (or
+            # failed) tally, and keeps no result
+            if job.state == "done":
+                family_counter(REGISTRY, "jobs", job=job.id).inc()
+            elif job.state == "failed":
+                family_counter(REGISTRY, "jobs_failed",
+                               job=job.id).inc()
+            else:
+                job.result = None
+
+
+class _ClusterFleet:
+    """Cluster fleet: ONE dispatch loop multiplexing tasks from many
+    concurrent jobs over the shared workers (the multi-job extension of
+    runtime/farm.TaskFarm's single-run loop).  Frames route back to
+    their job by the envelope's ``protocol.JOB_ID`` tag; a worker loss
+    costs only its in-flight tasks (reassigned through the admission
+    queue, fair-share preserved); a task failure fails only ITS job —
+    forensics land under that job's directory and every other job keeps
+    running."""
+
+    def __init__(self, service: JobService):
+        self.service = service
+        self.cl = service.cluster
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight: Dict[int, tuple] = {}      # pid -> (job, idx, t0)
+        self._idle: set = set()
+        self._ping_t: Dict[int, float] = {}
+        self._dead: set = set()
+
+    def wake(self) -> None:
+        pass                      # the loop polls at 100ms
+
+    def start(self) -> None:
+        from dryad_tpu.runtime import protocol
+        job = self.cl.next_job_id()
+        for pid, sock in list(self.cl.sockets.items()):
+            try:
+                sock.setblocking(True)
+                protocol.send_msg(sock, {"cmd": "ping", "job": job})
+                sock.setblocking(False)
+                self._ping_t[pid] = _now()
+            except OSError:
+                self._dead.add(pid)
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-cluster", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=15)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _wire_of(self, job: ServiceJob) -> int:
+        """The job's wire id (the ``protocol.JOB_ID`` tag on its task
+        envelopes).  Reply routing goes through the per-worker in-flight
+        record — which holds the ServiceJob itself — so no wire-id→job
+        map needs to exist (or be pruned) daemon-side."""
+        w = getattr(job, "_wire", None)
+        if w is None:
+            w = self.cl.next_job_id()
+            job._wire = w
+        return w
+
+    def _dispatch(self, job: ServiceJob, idx: int, pid: int) -> bool:
+        from dryad_tpu.obs import trace
+        from dryad_tpu.runtime import protocol
+        wire = self._wire_of(job)
+        job.mark_started()
+        sp = getattr(job, "_span", None)
+        if sp is None and job.log.level >= 2:
+            sp = trace.start(f"job {job.id}", "farm", sink=job,
+                             job=job.id, tasks=job.n_tasks)
+            job._span = sp
+        sock = self.cl.sockets[pid]
+        msg = protocol.attach_trace(
+            protocol.attach_job(
+                {"cmd": "run_task", "plan": job.payload["plan"],
+                 "sources": job.payload["sources"][idx], "task": idx,
+                 "config": job.config}, wire),
+            trace.ctx_of(sp) if sp is not None else None)
+        try:
+            sock.setblocking(True)
+            protocol.send_msg(sock, msg)
+            sock.setblocking(False)
+        except OSError:
+            self._worker_lost(pid)
+            return False
+        self._inflight[pid] = (job, idx, _now())
+        self._idle.discard(pid)
+        family_gauge(REGISTRY, "queue_depth",
+                     job=job.id).set(len(job.pending))
+        return True
+
+    def _worker_lost(self, pid: int) -> None:
+        self._dead.add(pid)
+        self._idle.discard(pid)
+        self._ping_t.pop(pid, None)
+        unit = self._inflight.pop(pid, None)
+        if unit is not None:
+            job, idx, _t0 = unit
+            if job.state == "running":
+                job.event({"event": "task_reassigned", "task": idx,
+                           "worker": pid})
+                self.service.admission.requeue(job, idx)
+            else:
+                self.service.admission.on_done(job, idx, 0.0)
+
+    def _fail_job(self, job: ServiceJob, idx: int, pid: int,
+                  reply: dict, wall: float) -> None:
+        from dryad_tpu.obs import flight
+        bpath = None
+        try:
+            bpath = flight.persist_reply_forensics(
+                reply, job.config, job.log, job.event)
+        except Exception:
+            pass
+        err = str(reply.get("error") or "task failed")
+        if bpath:
+            err += (f"\nforensics bundle: {bpath}\n  reproduce locally: "
+                    f"python -m dryad_tpu.obs replay {bpath}")
+        self.service.admission.on_done(job, idx, wall, ok=False)
+        job.pending.clear()
+        job.finish(False, error=f"task {idx} failed on worker {pid}:\n"
+                                + err)
+        self.service.admission.retire(job)
+        family_counter(REGISTRY, "jobs_failed", job=job.id).inc()
+        family_gauge(REGISTRY, "queue_depth", job=job.id).set(0)
+
+    def _on_reply(self, pid: int, reply: dict) -> None:
+        from dryad_tpu.obs import trace
+        from dryad_tpu.runtime import protocol
+        if "pong" in reply:
+            self._ping_t.pop(pid, None)
+            # a stale pong (buffered from a pre-daemon epoch of a
+            # reused cluster) must not idle a worker that is BUSY with
+            # our task — the next dispatch would clobber its in-flight
+            # record and strand the task forever
+            if pid not in self._inflight:
+                self._idle.add(pid)
+            return
+        if "hb" in reply:
+            return
+        unit = self._inflight.get(pid)
+        if (unit is None or getattr(unit[0], "_wire", None)
+                != protocol.extract_job(reply)):
+            # stale frame from an earlier epoch of this cluster (e.g. a
+            # losing speculative duplicate of a pre-daemon TaskFarm
+            # run): ignore it WITHOUT touching the in-flight record or
+            # the idle set — popping here would silently discard a live
+            # task and double-book the still-busy worker
+            return
+        job, idx, t0 = unit
+        self._inflight.pop(pid)
+        self._idle.add(pid)
+        idx = reply.get("task", idx)
+        wall = _now() - t0
+        if job.state != "running":
+            # cancelled/failed mid-flight: charge fair-share, drop reply
+            self.service.admission.on_done(job, idx, wall,
+                                           ok=bool(reply.get("ok")))
+            return
+        for e in reply.get("events") or ():
+            job.event(dict(e, worker=pid))
+        if not reply.get("ok"):
+            self._fail_job(job, idx, pid, reply, wall)
+            return
+        if reply.get("rewrites"):
+            job.rewrites += int(reply["rewrites"])
+        job.event({"event": "task_done", "task": idx, "worker": pid,
+                   "wall_s": round(wall, 4)})
+        family_histogram(REGISTRY, "task_seconds",
+                         job=job.id).observe(wall)
+        family_counter(REGISTRY, "tasks", job=job.id).inc()
+        self.service.admission.on_done(job, idx, wall, ok=True)
+        done = job.task_result(idx, reply.get("table"))
+        if done:
+            trace.finish(getattr(job, "_span", None),
+                         done=job.n_tasks)
+            job.finish(True)
+            self.service.admission.retire(job)
+            family_counter(REGISTRY, "jobs", job=job.id).inc()
+            family_gauge(REGISTRY, "queue_depth", job=job.id).set(0)
+
+    # -- the loop ----------------------------------------------------------
+
+    def _live_pids(self) -> List[int]:
+        return [p for p in self.cl.sockets if p not in self._dead]
+
+    def _loop(self) -> None:
+        svc = self.service
+        while not self._stop.is_set():
+            try:
+                self._tick(svc)
+            except Exception:
+                # the ONE dispatch thread must survive anything — a
+                # transient error (full disk killing a log write, a
+                # socket edge case) wedging it would strand every job
+                # while submissions keep being accepted
+                try:
+                    svc.log({"event": "service_error", "error":
+                             "fleet loop error (recovered):\n"
+                             + traceback.format_exc()[-2000:]})
+                except Exception:
+                    pass
+                time.sleep(0.2)
+
+    def _tick(self, svc) -> None:
+        """One iteration of the dispatch loop: reap timeouts/deaths,
+        fill idle workers fair-share, drain replies (~100ms)."""
+        timeout_s = svc.config.task_timeout_s
+        now = _now()
+        # per-task timeout: a wedged worker is retired (its socket
+        # severed) and the task reassigns elsewhere — farm semantics
+        for pid, (job, idx, t0) in list(self._inflight.items()):
+            if now - t0 > timeout_s:
+                job.event({"event": "task_timeout", "task": idx,
+                           "worker": pid, "timeout_s": timeout_s})
+                self.cl.retire_worker(pid)
+                self._worker_lost(pid)
+        # startup-ping timeout: a worker that never pongs would
+        # otherwise just never enter the idle set — with every
+        # worker wedged that way jobs would queue forever with no
+        # verdict; retire it like a wedged task
+        for pid, t0 in list(self._ping_t.items()):
+            if now - t0 > min(30.0, timeout_s):
+                svc.log({"event": "worker_ping_timeout",
+                         "worker": pid})
+                self.cl.retire_worker(pid)
+                self._worker_lost(pid)
+        # process deaths
+        for pid, proc in self.cl.worker_procs().items():
+            if pid not in self._dead and proc.poll() is not None:
+                self._worker_lost(pid)
+        live = self._live_pids()
+        if not live:
+            for row in svc.list_jobs():
+                j = svc.jobs.get(row["job"])
+                if j is not None and j.state in ("queued", "running"):
+                    j.pending.clear()
+                    j.finish(False, error="all fleet workers died"
+                             + self.cl.log_tails())
+                    svc.admission.retire(j)
+            time.sleep(0.5)
+            return
+        # fill idle workers from the fair-share queue (belt+braces:
+        # a worker with an in-flight task is never dispatch-eligible
+        # even if something wrongly idled it)
+        self._idle -= set(self._inflight)
+        while self._idle:
+            unit = svc.admission.next_unit()
+            if unit is None:
+                break
+            job, idx = unit
+            if job.state == "cancelled":
+                svc.admission.on_done(job, idx, 0.0)
+                svc.admission.retire(job)
+                continue
+            if not self._dispatch(job, idx, min(self._idle)):
+                svc.admission.requeue(job, idx)
+        # replies
+        socks = {self.cl.sockets[p]: p for p in self._live_pids()}
+        if not socks:
+            return
+        try:
+            ready, _, _ = select.select(list(socks), [], [], 0.1)
+        except (OSError, ValueError):
+            return
+        for sock in ready:
+            pid = socks[sock]
+            frames, ok = self.cl.recv_frames_any(pid)
+            for reply in frames:
+                self._on_reply(pid, reply)
+            if not ok:
+                self._worker_lost(pid)

@@ -1,0 +1,201 @@
+"""HTTP front end for the job-service daemon, plus the matching client.
+
+One small surface over the stdlib HTTP plumbing the repo already uses
+(utils/viewer.serve_live, io/http_provider's test servers):
+
+==========================  ==========================================
+``GET /``                   live multi-job dashboard (HTML — the
+                            obs/history index promoted with running
+                            jobs + tenant shares, daemon.dashboard_html)
+``GET /jobs``               all jobs, JSON rows
+``GET /status/<job>``       one job's row (``?result=1`` inlines the
+                            combined result when done)
+``GET /tenants``            fair-share snapshot {tenant: [slot_s,
+                            running, failures]}
+``GET /metrics``            Prometheus text exposition of the live
+                            registry (per-job labeled families incl.)
+``POST /submit``            JSON {app, params?, tenant?, priority?} ->
+                            {"job": id}; typed DTA91x rejections come
+                            back as JSON {"code", "error"} with a
+                            matching status (below)
+``POST /cancel/<job>``      {"cancelled": bool}
+==========================  ==========================================
+
+A rejected submission maps its stable diagnostic code onto an HTTP
+status so generic clients can react without parsing: DTA910 (unknown
+app) -> 400, DTA911 (queue full — backpressure) -> 429, DTA912
+(failure budget) -> 403, DTA913 (draining) -> 503.  The Python client
+below re-raises the SAME typed :class:`ServiceRejected` the daemon
+raised, so local and remote submission surface identical errors.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from dryad_tpu.service.tenancy import ServiceRejected
+
+__all__ = ["serve", "REJECTION_STATUS", "Client"]
+
+# stable diagnostic code -> HTTP status (docs/service.md table)
+REJECTION_STATUS = {"DTA910": 400, "DTA911": 429, "DTA912": 403,
+                    "DTA913": 503}
+
+
+def serve(service, port: int = 0, host: str = "127.0.0.1"):
+    """Bind the front end for ``service`` (a JobService); returns
+    ``(server, port)`` — call ``server.serve_forever()`` (the CLI does)
+    or drive it from a thread (tests do)."""
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):      # the service log is the log
+            pass
+
+        def _send(self, status: int, body: bytes,
+                  ctype: str = "application/json") -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, status: int, obj: Any) -> None:
+            self._send(status, json.dumps(obj, default=str).encode())
+
+        def do_GET(self):
+            path, _, query = self.path.partition("?")
+            try:
+                if path == "/":
+                    self._send(200, service.dashboard_html().encode(),
+                               "text/html; charset=utf-8")
+                elif path == "/jobs":
+                    self._json(200, service.list_jobs())
+                elif path == "/tenants":
+                    self._json(200, service.admission.shares())
+                elif path == "/metrics":
+                    self._send(200, service.metrics_text().encode(),
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+                elif path.startswith("/status/"):
+                    jid = path[len("/status/"):]
+                    with_result = "result=1" in query
+                    try:
+                        self._json(200, service.status(
+                            jid, with_result=with_result))
+                    except KeyError:
+                        self._json(404, {"error": f"unknown job {jid}"})
+                else:
+                    self._json(404, {"error": f"no route {path}"})
+            except Exception as e:      # surface, never kill the server
+                self._json(500, {"error": repr(e)})
+
+        def do_POST(self):
+            path = self.path.partition("?")[0]
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n) if n else b"{}"
+            try:
+                body = json.loads(raw.decode() or "{}")
+            except ValueError:
+                return self._json(400, {"error": "malformed JSON body",
+                                        "code": "DTA910"})
+            try:
+                if path == "/submit":
+                    jid = service.submit(
+                        body.get("app", ""),
+                        params=body.get("params") or {},
+                        tenant=str(body.get("tenant", "default")),
+                        priority=int(body.get("priority", 0)))
+                    self._json(200, {"job": jid})
+                elif path.startswith("/cancel/"):
+                    jid = path[len("/cancel/"):]
+                    try:
+                        self._json(200,
+                                   {"cancelled": service.cancel(jid)})
+                    except KeyError:
+                        self._json(404, {"error": f"unknown job {jid}"})
+                else:
+                    self._json(404, {"error": f"no route {path}"})
+            except ServiceRejected as e:
+                self._json(REJECTION_STATUS.get(e.code, 400),
+                           {"error": str(e), "code": e.code,
+                            "tenant": e.tenant})
+            except Exception as e:
+                self._json(500, {"error": repr(e)})
+
+    srv = http.server.ThreadingHTTPServer((host, port), H)
+    return srv, srv.server_address[1]
+
+
+class Client:
+    """Thin urllib client for the front end (the CLI's transport; tests
+    use it too).  Typed rejections re-raise as :class:`ServiceRejected`
+    carrying the daemon's code/message."""
+
+    def __init__(self, url: str, timeout_s: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _req(self, path: str, body: Optional[dict] = None) -> Any:
+        data = (json.dumps(body).encode() if body is not None else None)
+        req = urllib.request.Request(
+            self.url + path, data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+            method="POST" if data is not None else "GET")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                obj = json.loads(payload.decode())
+            except ValueError:
+                raise RuntimeError(f"service error {e.code}: "
+                                   f"{payload[:200]!r}")
+            code = obj.get("code")
+            if code in REJECTION_STATUS:
+                raise ServiceRejected(obj.get("error", code), code=code,
+                                      tenant=obj.get("tenant", ""))
+            raise RuntimeError(obj.get("error", f"HTTP {e.code}"))
+        return json.loads(payload.decode())
+
+    def submit(self, app: str, params: Optional[dict] = None,
+               tenant: str = "default", priority: int = 0) -> str:
+        return self._req("/submit", {"app": app, "params": params or {},
+                                     "tenant": tenant,
+                                     "priority": priority})["job"]
+
+    def status(self, job: str, result: bool = False) -> Dict[str, Any]:
+        return self._req(f"/status/{job}"
+                         + ("?result=1" if result else ""))
+
+    def cancel(self, job: str) -> bool:
+        return bool(self._req(f"/cancel/{job}", {})["cancelled"])
+
+    def jobs(self) -> List[Dict[str, Any]]:
+        return self._req("/jobs")
+
+    def tenants(self) -> Dict[str, Any]:
+        return self._req("/tenants")
+
+    def metrics(self) -> str:
+        req = urllib.request.Request(self.url + "/metrics")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return r.read().decode()
+
+    def wait(self, job: str, timeout: float = 300.0,
+             poll_s: float = 0.25) -> Dict[str, Any]:
+        """Poll until the job reaches a terminal state (or timeout);
+        returns the final row with the result inlined."""
+        t0 = time.time()
+        while True:
+            row = self.status(job, result=True)
+            if row["state"] in ("done", "failed", "cancelled"):
+                return row
+            if time.time() - t0 > timeout:
+                return row
+            time.sleep(poll_s)

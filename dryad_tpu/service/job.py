@@ -1,0 +1,204 @@
+"""Per-job driver state for the service daemon.
+
+One ``ServiceJob`` is the daemon-resident half of what used to be a
+whole driver process in the reference's one-GM-per-job model: identity
+(job id, tenant, app, priority), the per-job EventLog (its OWN JSONL
+under ``jobs/<id>/``, archived into the shared history dir on close —
+the multi-job dashboard's data), the per-job JobConfig (forensics
+bundles land in the job's directory, never a neighbor's), the task
+list and collected results, and the completion latch API waiters block
+on.  Everything here composes with the per-job refactor of
+``exec/recovery.Run``: the job's ``event`` sink tags every record with
+the job id, so streams from concurrent jobs can never interleave
+anonymously even when they share one executor or one fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from dryad_tpu.utils.events import EventLog
+
+__all__ = ["ServiceJob", "JOB_STATES"]
+
+JOB_STATES = ("queued", "running", "done", "failed", "cancelled")
+
+
+class _JobLog(EventLog):
+    """An EventLog that stamps the owning job's id on EVERY record at
+    the sink itself — including the log's own close-time emissions
+    (``job_archived``) — so a job's JSONL is job-tagged end to end and
+    concurrent jobs' streams can never interleave anonymously."""
+
+    def __init__(self, job_id: str, *a, **kw):
+        self.job_id = job_id
+        super().__init__(*a, **kw)
+
+    def __call__(self, e: Dict[str, Any]) -> None:
+        e = dict(e)
+        e.setdefault("job", self.job_id)
+        super().__call__(e)
+
+
+class ServiceJob:
+    """One admitted job (see module docstring)."""
+
+    def __init__(self, job_id: str, tenant: str, app: str, seq: int,
+                 priority: int, n_tasks: int, job_dir: str, config,
+                 history_dir: Optional[str] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 combine: Optional[Callable[[List], Any]] = None,
+                 payload: Optional[Dict[str, Any]] = None,
+                 run_local: Optional[Callable] = None):
+        self.id = job_id
+        self.tenant = tenant
+        self.app = app
+        self.seq = seq
+        self.priority = priority
+        self.params = params or {}
+        self.state = "queued"
+        self.error: Optional[str] = None
+        self.n_tasks = n_tasks
+        self.pending = deque(range(n_tasks))
+        self.results: List[Any] = [None] * n_tasks
+        self.done_tasks = 0
+        self.result: Any = None
+        self.rewrites = 0
+        self.submitted_ts = time.time()
+        self.started_ts: Optional[float] = None
+        self.finished_ts: Optional[float] = None
+        # cluster-fleet payload: {"plan": plan_json, "sources": [per-task
+        # source dicts]}; in-process jobs carry run_local instead (a
+        # callable executed on a fleet thread with the shared executor)
+        self.payload = payload
+        self.combine = combine
+        self.run_local = run_local
+        # per-job driver state: own JSONL + forensics dir + history
+        # archive on close (EventLog(app=...) names the dashboard row)
+        self.dir = job_dir
+        os.makedirs(job_dir, exist_ok=True)
+        self.log = _JobLog(job_id,
+                           os.path.join(job_dir, "events.jsonl"),
+                           history_dir=history_dir, app=app)
+        self.config = config.replace(
+            forensics_dir=os.path.join(job_dir, "bundles"))
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- event routing -----------------------------------------------------
+
+    def event(self, e: Dict[str, Any]) -> None:
+        """The job's event sink: every record lands in the job's own
+        log, which tags it with the job id at the sink (:class:`_JobLog`
+        — no extra copy here).  Spans gate on the log's level via the
+        ``level`` attribute."""
+        self.log(e)
+
+    @property
+    def level(self) -> int:
+        return self.log.level
+
+    def __call__(self, e: Dict[str, Any]) -> None:   # sink protocol
+        self.event(e)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def mark_started(self) -> None:
+        with self._lock:
+            if self.started_ts is None:
+                self.started_ts = time.time()
+                self.event({"event": "job_started", "tenant": self.tenant,
+                            "app": self.app, "tasks": self.n_tasks})
+
+    def task_result(self, idx: int, table: Any) -> bool:
+        """Record one task's table; True when the job just completed."""
+        with self._lock:
+            if self.results[idx] is None:
+                self.results[idx] = table
+                self.done_tasks += 1
+            return self.done_tasks >= self.n_tasks
+
+    def finish(self, ok: bool, error: Optional[str] = None,
+               emit_job_done: bool = True) -> None:
+        """Terminal transition: combine results, emit the terminal
+        event, close (and thereby archive) the per-job log, release
+        waiters.  Idempotent."""
+        with self._lock:
+            if self.state in ("done", "failed", "cancelled"):
+                return
+            self.finished_ts = time.time()
+            if ok:
+                self.state = "done"
+                if self.combine is not None:
+                    try:
+                        self.result = self.combine(list(self.results))
+                    except Exception as e:        # combine is user code
+                        self.state = "failed"
+                        self.error = f"combine failed: {e!r}"
+                if self.state == "done" and emit_job_done:
+                    self.event({"event": "job_done",
+                                "wall_s": round(self.finished_ts
+                                                - (self.started_ts
+                                                   or self.submitted_ts),
+                                                4),
+                                "tasks": self.n_tasks,
+                                "tenant": self.tenant})
+            else:
+                self.state = "failed"
+                self.error = error
+            if self.state == "failed":
+                self.event({"event": "job_failed", "tenant": self.tenant,
+                            "error": (error or self.error
+                                      or "unknown")[:2000]})
+            self._release_inputs()
+        self.log.close()
+        self._done.set()
+
+    def _release_inputs(self) -> None:
+        """Drop the job's input-sized state on terminal transition (the
+        farm payload with per-task source columns, the planned-graph
+        closure, the per-task tables).  Only ``result`` serves the
+        status/result API — without this, the daemon's terminal-job
+        retention window would hold whole job INPUTS in RAM, not just
+        rows of metadata."""
+        self.payload = None
+        self.run_local = None
+        self.results = []
+
+    def cancel(self) -> bool:
+        """Cancel a queued/running job: queued tasks are dropped;
+        in-flight task replies will be ignored.  True if it transitioned."""
+        with self._lock:
+            if self.state in ("done", "failed", "cancelled"):
+                return False
+            self.state = "cancelled"
+            self.pending.clear()
+            self.finished_ts = time.time()
+            self.event({"event": "job_cancelled", "tenant": self.tenant})
+            self._release_inputs()
+        self.log.close()
+        self._done.set()
+        return True
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    # -- introspection -----------------------------------------------------
+
+    def to_row(self, with_result: bool = False) -> Dict[str, Any]:
+        row = {"job": self.id, "tenant": self.tenant, "app": self.app,
+               "priority": self.priority, "state": self.state,
+               "tasks_done": self.done_tasks, "tasks": self.n_tasks,
+               "submitted_ts": round(self.submitted_ts, 3),
+               "wall_s": (round(self.finished_ts - self.started_ts, 4)
+                          if self.finished_ts and self.started_ts
+                          else None),
+               "error": self.error, "dir": self.dir,
+               "rewrites": self.rewrites}
+        if with_result and self.state == "done":
+            row["result"] = self.result
+        return row

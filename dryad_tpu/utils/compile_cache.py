@@ -15,16 +15,28 @@ the cache is multi-process safe — writes go through atomic renames).
 
 Wired from Context.__init__, runtime.worker startup, and bench.py, keyed by
 ``JobConfig.compilation_cache_dir`` (set to None to disable).
+
+:class:`FileCache` is the framework's OWN shared on-disk artifact cache
+(serialized plans, lowered specs — anything bytes) with the same
+concurrency contract the XLA cache relies on, made explicit: commits go
+through same-directory atomic renames so a reader can never observe a
+torn entry, every entry carries a content checksum so a corrupt or
+crash-truncated file reads as a MISS (never as garbage), and concurrent
+writers of one key are last-writer-wins.  The multi-tenant job service
+(dryad_tpu/service) keys its per-app plan cache here so the Nth user of
+an app pays zero planning, and per-JOB hit/miss counters land in the
+metrics registry (the "did this tenant pay compile" dashboard signal).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from typing import Optional
 
 __all__ = ["enable_persistent_cache", "machine_fingerprint",
-           "DEFAULT_CACHE_DIR"]
+           "DEFAULT_CACHE_DIR", "FileCache"]
 
 DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "dryad_tpu", "xla_cache")
 
@@ -103,3 +115,102 @@ def enable_persistent_cache(path: Optional[str] = DEFAULT_CACHE_DIR) -> Optional
         _enabled_dir = resolved
         family_gauge(REGISTRY, "persistent_cache").set(1)
         return resolved
+
+
+# 8-byte magic + sha256 of the payload, prefixed so a reader validates
+# BEFORE trusting the bytes; bumping the version invalidates old entries
+_FC_MAGIC = b"DRYDFC1\n"
+
+
+class FileCache:
+    """Concurrent-writer-safe on-disk bytes cache (get/put by string key).
+
+    * **Atomic commit:** ``put`` writes to a uniquely-named temp file in
+      the SAME directory, fsyncs, then ``os.replace``s it into place —
+      readers observe either the old complete entry or the new complete
+      entry, never a partial write (the rename-commit contract the
+      reference's partitioned stores and the XLA persistent cache both
+      rely on).
+    * **No torn reads:** every entry is ``magic + sha256(payload) +
+      payload``; a file that fails the checksum (crash-truncated write
+      on a filesystem without atomic rename, e.g. some NFS modes) is a
+      MISS and is unlinked best-effort.
+    * **Concurrent writers:** two processes putting the same key race
+      benignly — both renames are atomic, last writer wins, and both
+      committed values are valid (cache values must be deterministic
+      functions of the key, which plans are).
+
+    Hit/miss counters land in the canonical metrics families
+    (``cache_hits``/``cache_misses``, labeled ``cache="file"`` plus the
+    optional per-job label) so the service dashboard can show per-tenant
+    amortization."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        h = hashlib.sha256(key.encode()).hexdigest()
+        return os.path.join(self.root, h[:2], h[2:])
+
+    def _count(self, hit: bool, job: Optional[str]) -> None:
+        from dryad_tpu.obs.metrics import REGISTRY, family_counter
+        labels = {"cache": "file"}
+        if job is not None:
+            labels["job"] = job
+        family_counter(REGISTRY, "cache_hits" if hit else "cache_misses",
+                       **labels).inc()
+
+    def get(self, key: str, job: Optional[str] = None) -> Optional[bytes]:
+        """The committed payload for ``key``, or None (miss / torn)."""
+        p = self._path(key)
+        try:
+            with open(p, "rb") as f:
+                blob = f.read()
+                ino = os.fstat(f.fileno()).st_ino
+        except OSError:
+            self._count(False, job)
+            return None
+        head = len(_FC_MAGIC) + 32
+        if (len(blob) < head or not blob.startswith(_FC_MAGIC)
+                or hashlib.sha256(blob[head:]).digest()
+                != blob[len(_FC_MAGIC):head]):
+            # corrupt/torn entry: a miss, never garbage — and evict it
+            # so the next writer's rename starts clean.  Only evict the
+            # INODE we read: a concurrent put may have os.replace()d a
+            # fresh valid entry in since, and unlinking that would throw
+            # away a just-committed value (the remaining stat→unlink
+            # window is benign: worst case one extra rebuildable miss)
+            try:
+                if os.stat(p).st_ino == ino:
+                    os.unlink(p)
+            except OSError:
+                pass
+            self._count(False, job)
+            return None
+        self._count(True, job)
+        return blob[head:]
+
+    def put(self, key: str, data: bytes, job: Optional[str] = None) -> None:
+        """Commit ``data`` under ``key`` atomically (rename commit)."""
+        p = self._path(key)
+        d = os.path.dirname(p)
+        os.makedirs(d, exist_ok=True)
+        blob = _FC_MAGIC + hashlib.sha256(data).digest() + data
+        # unique temp name in the SAME directory: os.replace is only
+        # atomic within a filesystem, and a shared suffix would let two
+        # writers scribble into one temp file
+        tmp = os.path.join(
+            d, f".tmp-{os.getpid()}-{threading.get_ident()}-"
+               f"{os.urandom(4).hex()}")
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, p)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

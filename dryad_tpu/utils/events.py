@@ -41,6 +41,12 @@ _LEVELS = {
     # adaptive execution: an applied stage-graph rewrite is a scheduling
     # decision (level 1, dryad_tpu/adapt)
     "graph_rewrite": 1,
+    # multi-tenant job service lifecycle (dryad_tpu/service): admission,
+    # start/finish, cancellation, and typed rejections are job-lifecycle
+    # grade; daemon start/stop bookends the service log
+    "job_submitted": 1, "job_started": 1, "job_cancelled": 1,
+    "job_rejected": 1, "service_started": 1, "service_stopped": 1,
+    "service_error": 0,
     # chatter: progress ticks, losing duplicates, locality notes, spans,
     # periodic resource samples (obs/profile.py), per-stage adapt stats
     # and declined rewrites (dryad_tpu/adapt)

@@ -129,7 +129,14 @@ def test_three_level_mesh_hierarchical_paths():
 
 def test_dryrun_multichip_32():
     """dryrun_multichip(32) in a fresh interpreter (the driver's
-    multi-chip validation at 4x the usual scale; VERDICT r4 next-9)."""
+    multi-chip validation at 4x the usual scale; VERDICT r4 next-9).
+
+    Retried ONCE when the failure carries XLA's collective rendezvous
+    liveness-watchdog signature: 32 virtual devices on a CPU-share-
+    throttled box can trip the watchdog's "participants failed to
+    arrive" timeout spuriously (its own log says "Thread is unstuck!
+    ... false-positive"), which is box weather, not a product bug — a
+    deterministic failure reproduces on the retry."""
     import os
     import subprocess
     import sys
@@ -140,8 +147,15 @@ def test_dryrun_multichip_32():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
     env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
-    p = subprocess.run(
-        [sys.executable, "-c",
-         "import __graft_entry__ as g; g.dryrun_multichip(32)"],
-        env=env, cwd=here, capture_output=True, text=True, timeout=1800)
+
+    def run():
+        return subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g; g.dryrun_multichip(32)"],
+            env=env, cwd=here, capture_output=True, text=True,
+            timeout=1800)
+
+    p = run()
+    if p.returncode != 0 and "rendezvous" in p.stderr:
+        p = run()
     assert p.returncode == 0, p.stderr[-2000:]
