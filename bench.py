@@ -334,6 +334,106 @@ def smoke_adapt(out_path="BENCH_adapt.json", n_rows=None, reps=None,
     return out
 
 
+def smoke_sql(out_path="BENCH_sql.json", n_rows=None, reps=None,
+              quiet=False):
+    """SQL front-end smoke (``python bench.py --smoke`` /
+    ``--smoke-sql``): a TPC-H-style SKEWED join+group query — lineitem
+    with a 90%-hot order key joined to orders, filtered, grouped to
+    per-order revenue, globally sorted, LIMIT 10 — compiled by
+    dryad_tpu/sql and run adaptive-on vs adaptive-off, INTERLEAVED >=3
+    reps, median walls (the PR-4 protocol).  The adaptive run must
+    record at least one ``graph_rewrite`` with IDENTICAL result rows:
+    the declarative front end exercising the optimizer stack on a real
+    query shape is the point (ROADMAP item 5).  Written to
+    ``BENCH_sql.json`` + appended to ``BENCH_trend.jsonl`` (app
+    ``bench-sql``)."""
+    import statistics
+
+    from dryad_tpu import sql
+    from dryad_tpu.api.dataset import Context
+    from dryad_tpu.utils.config import JobConfig
+
+    n_rows = n_rows or int(os.environ.get("BENCH_SQL_ROWS", "50000"))
+    reps = max(3, reps or int(os.environ.get("BENCH_SQL_REPS", "5")))
+    n_orders = 1000
+    rng = np.random.RandomState(0)
+    okey = np.where(rng.rand(n_rows) < 0.9, 0,
+                    rng.randint(1, n_orders, n_rows)).astype(np.int32)
+    cat = sql.Catalog()
+    cat.register_columns("lineitem", {
+        "okey": okey,
+        "price": rng.randint(1, 100, n_rows).astype(np.int32),
+        "qty": rng.randint(1, 10, n_rows).astype(np.int32)})
+    cat.register_columns("orders", {
+        "okey": np.arange(n_orders, dtype=np.int32),
+        "flag": (np.arange(n_orders) % 2).astype(np.int32)})
+    query = ("SELECT l.okey, SUM(l.price * l.qty) AS revenue, "
+             "COUNT(*) AS n "
+             "FROM lineitem l JOIN orders o ON l.okey = o.okey "
+             "WHERE o.flag = 0 "
+             "GROUP BY l.okey ORDER BY revenue DESC LIMIT 10")
+
+    def make(adaptive, events):
+        ctx = Context(event_log=events.append,
+                      config=JobConfig(adaptive=adaptive))
+        return sql.query(ctx, cat, query)
+
+    ev_on, ev_off = [], []
+    q_on, q_off = make("on", ev_on), make("off", ev_off)
+    out_on, out_off = q_on.collect(), q_off.collect()  # warmup+verify
+    rewrites = [e for e in ev_on if e.get("event") == "graph_rewrite"]
+
+    def rows(t):
+        return sorted(zip(np.asarray(t["okey"]).tolist(),
+                          np.asarray(t["revenue"]).tolist(),
+                          np.asarray(t["n"]).tolist()))
+
+    rows_identical = rows(out_on) == rows(out_off)
+    walls_on, walls_off = [], []
+    for _ in range(reps):
+        t0 = time.time()
+        q_off.collect()
+        walls_off.append(time.time() - t0)
+        t0 = time.time()
+        q_on.collect()
+        walls_on.append(time.time() - t0)
+    on_s = statistics.median(walls_on)
+    off_s = statistics.median(walls_off)
+    out = {
+        "metric": "sql smoke (TPC-H-style skewed join+group via the "
+                  "SQL front end, adapt-on vs adapt-off)",
+        "rows": n_rows,
+        "reps": reps,
+        "query": sql.normalize_query(query),
+        "wall_s_adapt_on": round(on_s, 4),
+        "wall_s_adapt_off": round(off_s, 4),
+        "wall_s_adapt_on_all": [round(w, 4) for w in walls_on],
+        "wall_s_adapt_off_all": [round(w, 4) for w in walls_off],
+        "speedup_pct": (round(100.0 * (off_s - on_s) / off_s, 1)
+                        if off_s > 0 else None),
+        "graph_rewrites": len(rewrites),
+        "rewrite_kinds": sorted({e.get("kind", "?") for e in rewrites}),
+        "rows_identical": rows_identical,
+        "sql_events": sum(1 for e in ev_on
+                          if e.get("event") == "sql_query"),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-sql",
+            "wall_s": round(on_s, 4),
+            "adapt_off_wall_s": round(off_s, 4),
+            "speedup_pct": out["speedup_pct"],
+            "graph_rewrites": len(rewrites), "rows": n_rows,
+            "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def smoke_kernels(out_path="BENCH_kernels.json", n=None, quiet=False):
     """Data-plane kernel micro-bench smoke (``python bench.py
     --smoke-kernels``, also rides ``--smoke``): DEVICE-TRUTH rows for the
@@ -1406,6 +1506,9 @@ if __name__ == "__main__":
     if "--smoke-adapt" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-adapt"]
         smoke_adapt(out_path=args[0] if args else "BENCH_adapt.json")
+    elif "--smoke-sql" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-sql"]
+        smoke_sql(out_path=args[0] if args else "BENCH_sql.json")
     elif "--smoke-kernels" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-kernels"]
         smoke_kernels(out_path=args[0] if args else "BENCH_kernels.json")
@@ -1427,5 +1530,7 @@ if __name__ == "__main__":
                       quiet=True)
         smoke_service(out_path=os.path.join(base, "BENCH_service.json"),
                       quiet=True)
+        smoke_sql(out_path=os.path.join(base, "BENCH_sql.json"),
+                  quiet=True)
     else:
         main()

@@ -1,0 +1,8 @@
+-- TPC-H-Q3-ish: join + filter + grouped revenue + top-k
+SELECT l.okey, SUM(l.price * l.qty) AS revenue, COUNT(*) AS n
+FROM lineitem l
+JOIN orders o ON l.okey = o.okey
+WHERE o.flag = 1
+GROUP BY l.okey
+ORDER BY revenue DESC
+LIMIT 10

@@ -1,0 +1,6 @@
+-- single-table scan shape: predicate pushover + global sort
+SELECT okey, price, qty
+FROM lineitem
+WHERE qty > 2 AND tag != 'void'
+ORDER BY price DESC
+LIMIT 100

@@ -67,7 +67,11 @@ def _selfcheck() -> int:
     else:
         print("docs/diagnostics.md: in sync with diagnostics.CODES")
 
-    plans = sorted((_REPO / "docs" / "plans").glob("*.json"))
+    failures.extend(_sql_golden_check())
+
+    import json as _json
+    plans = [p for p in sorted((_REPO / "docs" / "plans").glob("*.json"))
+             if "stages" in _json.loads(p.read_text())]
     plan_failures = []
     if not plans:
         plan_failures.append(f"{_REPO / 'docs' / 'plans'}: no committed "
@@ -89,6 +93,66 @@ def _selfcheck() -> int:
     for f in failures:
         print(f"SELFCHECK FAILURE: {f}", file=sys.stderr)
     return 1 if failures else 0
+
+
+def _sql_golden_check() -> list:
+    """SQL golden-plan drift gate: every committed ``docs/plans/*.sql``
+    recompiles (offline, schema-only catalog, nparts=8) to EXACTLY its
+    committed ``<name>.json`` lowering, and that JSON round-trips
+    through graph_from_json -> graph_to_json byte-identically (the
+    shippable-value protocol's self-decode contract).  A planner or
+    sql/ change that alters lowered plans must regenerate the goldens
+    deliberately (tests/test_sql.py shows the one-liner)."""
+    failures = []
+    plans_dir = _REPO / "docs" / "plans"
+    sqls = sorted(plans_dir.glob("*.sql"))
+    cat_path = plans_dir / "sql_catalog.json"
+    if not sqls:
+        return [f"{plans_dir}: no committed .sql golden queries"]
+    if not cat_path.exists():
+        return [f"{cat_path}: missing (the catalog the committed .sql "
+                f"goldens compile against)"]
+    from dryad_tpu.sql import Catalog, offline_plan_json
+    catalog = Catalog.load(str(cat_path))
+    for sp in sqls:
+        golden = sp.with_suffix(".json")
+        if not golden.exists():
+            failures.append(f"{sp.name}: no committed golden "
+                            f"{golden.name}")
+            continue
+        js = offline_plan_json(catalog, sp.read_text(), nparts=8,
+                               origin=sp.name)
+        if js != golden.read_text():
+            failures.append(
+                f"{golden.name}: stale vs the lowering of {sp.name} — "
+                f"regenerate via sql.offline_plan_json(catalog, query, "
+                f"nparts=8, origin={sp.name!r})")
+            continue
+        # round trip: rebuild (row-expressions self-decode as data,
+        # zero fn_table) and re-serialize byte-identically
+        import json as _json
+
+        from dryad_tpu.plan.serialize import (graph_from_json,
+                                              graph_to_json)
+        d = _json.loads(js)
+        slots = {f"{st['id']}:{li}": None for st in d["stages"]
+                 for li, leg in enumerate(st["legs"])
+                 if "source" in leg["src"]}
+        try:
+            graph = graph_from_json(js, fn_table={}, sources=slots)
+            js2 = graph_to_json(graph)
+        except Exception as e:
+            failures.append(f"{golden.name}: does not round-trip "
+                            f"through graph_from_json: {e!r}")
+            continue
+        if js2 != js:
+            failures.append(f"{golden.name}: graph_from_json -> "
+                            f"graph_to_json is not byte-identical")
+    if not failures:
+        print(f"sql goldens: {len(sqls)} committed .sql quer"
+              f"{'ies' if len(sqls) != 1 else 'y'} lower to their "
+              f"committed plans and round-trip")
+    return failures
 
 
 def main(argv=None) -> int:

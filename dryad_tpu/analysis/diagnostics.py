@@ -14,6 +14,9 @@ Code space:
 * DTA1xx — UDF lint (determinism / shippability of user callables)
 * DTA2xx — cost & resource analyzer (analysis/cost.py: abstract
   interpretation over the lowered plan; pre-submit OOM/spill forecasts)
+* DTA3xx — SQL front end (dryad_tpu/sql: lexer/parser/binder errors whose
+  spans point INTO THE QUERY TEXT as line:column — the file slot of the
+  Span holds the query's origin, e.g. ``<sql>`` or a ``.sql`` path)
 * DTA9xx — runtime-only conditions (data-dependent overflows, internal
   invariants, worker-side deploy errors) that no static rule can predict
 """
@@ -65,6 +68,14 @@ CODES = {
               "blind)",
     "DTA204": "cache() of edge-scale data that should be streamed",
     "DTA205": "per-stage predicted cost summary",
+    # -- SQL front end (DTA3xx) --------------------------------------------
+    "DTA301": "SQL parse error",
+    "DTA302": "unknown table (not registered in the catalog)",
+    "DTA303": "unknown column",
+    "DTA304": "ambiguous column reference (qualify with the table "
+              "alias)",
+    "DTA305": "type mismatch in SQL expression",
+    "DTA306": "unsupported SQL construct",
     # -- runtime-only (DTA9xx) ---------------------------------------------
     "DTA901": "internal: op kind cannot ride a wave program",
     "DTA902": "internal: unknown exchange kind in streamed plan",
@@ -92,14 +103,21 @@ RUNTIME_ONLY_CODES = frozenset({"DTA901", "DTA902", "DTA903", "DTA904",
 
 @dataclasses.dataclass(frozen=True)
 class Span:
-    """Source provenance: where the user wrote the offending construct."""
+    """Source provenance: where the user wrote the offending construct.
+
+    For Python UDF/plan findings ``file:line`` names a source file; for
+    SQL findings (DTA3xx) the ``file`` slot names the query's origin
+    (``<sql>`` or a ``.sql`` path) and ``col`` carries the 1-based
+    column INSIDE the query text, rendered ``origin:line:column``."""
 
     file: str
     line: int
     func: str = ""
+    col: int = 0
 
     def __str__(self) -> str:
-        return f"{self.file}:{self.line}"
+        base = f"{self.file}:{self.line}"
+        return f"{base}:{self.col}" if self.col else base
 
     @staticmethod
     def of(v: Any) -> Optional["Span"]:
@@ -220,6 +238,8 @@ _CODE_FAMILIES = (
     ("DTA1", "UDF lint (determinism / shippability / capture)"),
     ("DTA2", "cost & resource analyzer (pre-submit OOM/spill "
              "forecasts)"),
+    ("DTA3", "SQL front end (parse / bind / type errors with "
+             "line:column spans into the query text)"),
     ("DTA9", "runtime-only (no static rule can predict these)"),
 )
 

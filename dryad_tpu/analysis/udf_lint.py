@@ -89,10 +89,13 @@ def fn_def_site(fn: Callable) -> Optional[Span]:
 
 
 def shippability_of(fn: Callable) -> Optional[str]:
-    """None if ``fn`` ships to a cluster (importable as module:qualname),
-    else a human explanation mirroring runtime/shiplan's rejection."""
+    """None if ``fn`` ships to a cluster (importable as module:qualname,
+    or a shippable VALUE serializing as data — plan/serialize.ship_ref_of,
+    e.g. SQL row-expression programs), else a human explanation
+    mirroring runtime/shiplan's rejection."""
+    from dryad_tpu.plan.serialize import ship_ref_of
     from dryad_tpu.runtime.shiplan import _import_ref
-    if _import_ref(fn) is not None:
+    if _import_ref(fn) is not None or ship_ref_of(fn) is not None:
         return None
     qual = getattr(fn, "__qualname__", repr(fn))
     kind = "lambda" if "<lambda>" in str(qual) else \

@@ -1226,8 +1226,18 @@ class Dataset:
                           hosts=self.ctx.hosts,
                           levels=self.ctx.levels,
                           config=self.ctx.config).explain()
+        cost_rep = self.cost() if cost else None
         if verify:
-            text += "\n\ndiagnostics:\n" + self.check().render()
-        if cost:
-            text += "\n\npredicted cost:\n" + self.cost().render()
+            # the ONE cost pass feeds both sections: the diagnostics
+            # include the DTA2xx resource findings, so an EXPLAIN COST
+            # on a provably >HBM plan SHOWS its DTA201 rejection
+            report = self.check()
+            if cost_rep is not None:
+                from dryad_tpu.analysis.cost import cost_diagnostics
+                report.diagnostics.extend(
+                    cost_diagnostics(cost_rep, self.ctx.config))
+                report.dedup()
+            text += "\n\ndiagnostics:\n" + report.render()
+        if cost_rep is not None:
+            text += "\n\npredicted cost:\n" + cost_rep.render()
         return text

@@ -12,12 +12,51 @@ QueryParser.cs:100).
 
 from __future__ import annotations
 
+import importlib
 import json
 from typing import Any, Callable, Dict, Optional
 
 from dryad_tpu.plan.stages import Exchange, Leg, Stage, StageGraph, StageOp
 
-__all__ = ["graph_to_json", "graph_from_json"]
+__all__ = ["graph_to_json", "graph_from_json", "import_ref",
+           "ship_ref_of"]
+
+
+def import_ref(obj: Any) -> Optional[str]:
+    """``module:qualname`` if re-importing it yields the SAME object
+    (the reference's `assembly!class.method` vertex-entry contract,
+    QueryParser.cs:100) — the one importability check shared by the
+    shipper (runtime/shiplan.py) and the static analyzer
+    (analysis/udf_lint.shippability_of)."""
+    mod = getattr(obj, "__module__", None)
+    qual = getattr(obj, "__qualname__", None)
+    if not mod or not qual or "<" in qual:
+        return None
+    try:
+        o: Any = importlib.import_module(mod)
+        for part in qual.split("."):
+            o = getattr(o, part)
+    except (ImportError, AttributeError):
+        return None
+    return f"{mod}:{qual}" if o is obj else None
+
+
+def ship_ref_of(v: Any) -> Optional[str]:
+    """Shippable-VALUE protocol: an op-param object that serializes as
+    DATA instead of by name.  A value qualifies when it implements
+    ``__ship_payload__() -> jsonable`` plus the classmethod
+    ``__from_payload__(payload)``, and its class is importable — it
+    then crosses the wire as ``{"__shipped__": {cls, payload}}`` and
+    rebuilds on the executing side with no fn_table registration.  The
+    SQL front end's row-expression programs (dryad_tpu/sql/rowexpr.py)
+    are the first users: a compiled query's Map/Filter callables are
+    pure data, so SQL plans ship to workers exactly like structured
+    ops.  Returns the class's import ref, or None when the protocol is
+    absent/unusable."""
+    if (not hasattr(v, "__ship_payload__")
+            or not hasattr(type(v), "__from_payload__")):
+        return None
+    return import_ref(type(v))
 
 
 # params carrying planner-internal mutable state shared between ops of one
@@ -36,6 +75,12 @@ def _op_to_json(op: StageOp, fn_names: Dict[int, str],
             # explicitly registered shipping name (runtime/shiplan.py) —
             # covers non-callable values (user Decomposables) too
             return {"__fn__": fn_names[id(v)]}
+        ref = ship_ref_of(v)
+        if ref is not None:
+            # shippable-value protocol: serialize as data, rebuild via
+            # the class's __from_payload__ on the executing side
+            return {"__shipped__": {"cls": ref,
+                                    "payload": v.__ship_payload__()}}
         if callable(v):
             return {"__fn__": fn_names.get(id(v), f"fn_{id(v):x}")}
         if isinstance(v, bytes):
@@ -75,6 +120,12 @@ def _op_from_json(d: dict, fn_table: Optional[Dict[str, Callable]],
             return fn_table[name]
         if isinstance(v, dict) and "__bytes__" in v:
             return v["__bytes__"].encode("latin1")
+        if isinstance(v, dict) and "__shipped__" in v:
+            mod_name, qual = v["__shipped__"]["cls"].split(":", 1)
+            cls: Any = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                cls = getattr(cls, part)
+            return cls.__from_payload__(v["__shipped__"]["payload"])
         if isinstance(v, dict) and "__ephemeral__" in v:
             return shared.setdefault(v["__ephemeral__"], {})
         if isinstance(v, dict) and "__opaque__" in v:

@@ -109,11 +109,23 @@ class Stage:
         cache, which is correct (their behavior is unknowable) just not
         optimal."""
 
+        def val_fp(v) -> str:
+            # shippable VALUES (plan/serialize.ship_ref_of — e.g. the
+            # SQL front end's row-expression programs) fingerprint by
+            # CONTENT: two submissions of the same query build fresh
+            # objects computing the same function, and must hit the
+            # compile cache (the service's warm-Nth-user story)
+            if (hasattr(v, "__ship_payload__")
+                    and hasattr(type(v), "__from_payload__")):
+                import json
+                return (f"ship:{type(v).__qualname__}:"
+                        f"{json.dumps(v.__ship_payload__(), sort_keys=True)}")
+            return "fn%x" % id(v) if callable(v) else repr(v)
+
         def op_fp(op: StageOp) -> str:
             items = []
             for k in sorted(op.params):
-                v = op.params[k]
-                items.append(f"{k}={'fn%x' % id(v) if callable(v) else v!r}")
+                items.append(f"{k}={val_fp(op.params[k])}")
             return f"{op.kind}({','.join(items)})"
 
         def ex_fp(ex: Optional[Exchange]) -> str:

@@ -16,7 +16,7 @@ import json
 from typing import Any, Callable, Dict, Iterable, Tuple
 
 from dryad_tpu.analysis.diagnostics import DiagnosticError
-from dryad_tpu.plan.serialize import graph_to_json
+from dryad_tpu.plan.serialize import graph_to_json, import_ref, ship_ref_of
 from dryad_tpu.plan.stages import StageGraph
 from dryad_tpu.runtime.sources import DeferredSource
 
@@ -42,19 +42,10 @@ def register_fn_table(table: Dict[str, Any]) -> None:
     _GLOBAL_FN_TABLE.update(table)
 
 
-def _import_ref(fn: Callable) -> str | None:
-    """``module:qualname`` if re-importing it yields the same object."""
-    mod = getattr(fn, "__module__", None)
-    qual = getattr(fn, "__qualname__", None)
-    if not mod or not qual or "<" in qual:
-        return None
-    try:
-        obj: Any = importlib.import_module(mod)
-        for part in qual.split("."):
-            obj = getattr(obj, part)
-    except (ImportError, AttributeError):
-        return None
-    return f"{mod}:{qual}" if obj is fn else None
+# the one importability check (moved to plan/serialize.import_ref so the
+# serializer's shippable-value protocol shares it); kept under the old
+# name for its existing importers (analysis/udf_lint)
+_import_ref = import_ref
 
 
 # serializer-ephemeral params (rebuilt on the executing side) need no refs
@@ -73,6 +64,10 @@ def _collect_refs(graph: StageGraph,
             return
         if id(v) in user_names:
             fn_names[id(v)] = user_names[id(v)]
+            return
+        if ship_ref_of(v) is not None:
+            # shippable-value protocol (plan/serialize.ship_ref_of):
+            # serializes as data, needs no shipping name
             return
         if callable(v):
             ref = _import_ref(v)

@@ -4,8 +4,10 @@
                (Ctrl-C drains and stops); ``--cluster N`` runs an
                N-process LocalCluster fleet, default is the in-process
                thread fleet
-* ``submit``   submit a registered app to a running daemon; ``--wait``
-               blocks for the result
+* ``submit``   submit a registered app — or a SQL query over the
+               daemon's catalog with ``--sql "SELECT ..."`` (serve
+               ``--catalog cat.json`` registers the tables) — to a
+               running daemon; ``--wait`` blocks for the result
 * ``status``   one job's row (``--result`` inlines the result)
 * ``wait``     block until a job is terminal; prints the final row
 * ``cancel``   cancel a queued/running job
@@ -13,8 +15,9 @@
 * ``tenants``  fair-share snapshot (slot-seconds, running, failures)
 
 Exit codes: 0 success; 1 the operation failed (job failed / unknown
-job); 2 typed admission rejection (the DTA91x code is printed — DTA911
-means backpressure, resubmit later); 3 malformed input.
+job); 2 typed rejection (the stable code is printed — DTA91x admission
+walls, DTA911 meaning backpressure/resubmit later, or a DTA3xx SQL
+compile error with its line:column findings); 3 malformed input.
 """
 
 from __future__ import annotations
@@ -53,7 +56,8 @@ def _cmd_serve(args) -> int:
             devices_per_process=args.devices_per_process)
     cfg = ServiceConfig(service_dir=args.dir, slots=args.slots,
                         tenants=tenants,
-                        task_timeout_s=args.task_timeout_s)
+                        task_timeout_s=args.task_timeout_s,
+                        catalog_path=args.catalog)
     svc = JobService(cfg, cluster=cluster, own_cluster=cluster is not None)
     srv, port = serve(svc, port=args.port)
     print(f"dryad job service on http://127.0.0.1:{port}/ "
@@ -76,14 +80,20 @@ def _print_row(row: dict) -> int:
 
 def _cmd_submit(args) -> int:
     from dryad_tpu.service.tenancy import ServiceRejected
+    if bool(args.app) == bool(args.sql):
+        return _fail("submit needs an app name OR --sql \"QUERY\"")
     try:
         params = json.loads(args.params) if args.params else {}
     except ValueError as e:
         return _fail(f"--params is not JSON: {e}")
     c = _client(args)
     try:
-        jid = c.submit(args.app, params=params, tenant=args.tenant,
-                       priority=args.priority)
+        if args.sql:
+            jid = c.submit_sql(args.sql, tenant=args.tenant,
+                               priority=args.priority)
+        else:
+            jid = c.submit(args.app, params=params, tenant=args.tenant,
+                           priority=args.priority)
     except ServiceRejected as e:
         return _fail(f"rejected [{e.code}]: {e}", rc=2)
     if not args.wait:
@@ -135,15 +145,23 @@ def main(argv=None) -> int:
     s.add_argument("--tenants", default=None,
                    help='JSON file {"tenant": {"share": 2, ...}, ...}')
     s.add_argument("--task-timeout-s", type=float, default=600.0)
+    s.add_argument("--catalog", default=None,
+                   help="serialized sql.Catalog JSON: the tables "
+                        "POST /sql and `submit --sql` queries run over")
     s.set_defaults(fn=_cmd_serve)
 
     def _url(p):
         p.add_argument("--url", required=True,
                        help="daemon base URL (http://127.0.0.1:PORT)")
 
-    s = sub.add_parser("submit", help="submit a registered app")
+    s = sub.add_parser("submit",
+                       help="submit a registered app or a --sql query")
     _url(s)
-    s.add_argument("app")
+    s.add_argument("app", nargs="?", default=None)
+    s.add_argument("--sql", default=None, metavar="QUERY",
+                   help="submit a SQL query over the daemon's catalog "
+                        "instead of a registered app (typed DTA3xx "
+                        "rejection on compile errors, exit 2)")
     s.add_argument("--params", default=None, help="JSON object")
     s.add_argument("--tenant", default="default")
     s.add_argument("--priority", type=int, default=0)

@@ -18,15 +18,24 @@ One small surface over the stdlib HTTP plumbing the repo already uses
                             {"job": id}; typed DTA91x rejections come
                             back as JSON {"code", "error"} with a
                             matching status (below)
+``POST /sql``               JSON {query, tenant?, priority?} ->
+                            {"job": id}; the query compiles against
+                            the daemon's catalog AT SUBMISSION — a
+                            bad query is a typed DTA3xx rejection
+                            (400) with every finding + line:column
+                            span inlined as ``diagnostics``, zero
+                            work started
 ``POST /cancel/<job>``      {"cancelled": bool}
 ==========================  ==========================================
 
 A rejected submission maps its stable diagnostic code onto an HTTP
 status so generic clients can react without parsing: DTA910 (unknown
 app) -> 400, DTA911 (queue full — backpressure) -> 429, DTA912
-(failure budget) -> 403, DTA913 (draining) -> 503.  The Python client
-below re-raises the SAME typed :class:`ServiceRejected` the daemon
-raised, so local and remote submission surface identical errors.
+(failure budget) -> 403, DTA913 (draining) -> 503, and every SQL
+compile error DTA301-DTA306 -> 400 (so do pre-submit lint/cost
+rejections like a DTA201 >HBM plan).  The Python client below
+re-raises a typed :class:`ServiceRejected` carrying the daemon's
+code/message, so local and remote submission surface identical errors.
 """
 
 from __future__ import annotations
@@ -42,9 +51,28 @@ from dryad_tpu.service.tenancy import ServiceRejected
 
 __all__ = ["serve", "REJECTION_STATUS", "Client"]
 
-# stable diagnostic code -> HTTP status (docs/service.md table)
+# stable diagnostic code -> HTTP status (docs/service.md table).  The
+# SQL front end's compile errors (dryad_tpu/sql, DTA301-306) are all
+# client errors: the query text itself is wrong.
 REJECTION_STATUS = {"DTA910": 400, "DTA911": 429, "DTA912": 403,
-                    "DTA913": 503}
+                    "DTA913": 503,
+                    "DTA301": 400, "DTA302": 400, "DTA303": 400,
+                    "DTA304": 400, "DTA305": 400, "DTA306": 400}
+
+
+def _compile_rejection(e: Exception):
+    """(status, body) for non-admission rejections raised by a
+    submission: SQL compile errors (sql.SqlError — DTA3xx, every
+    finding inlined) and pre-submit lint gates (analysis.LintError,
+    e.g. a DTA201 provably->HBM plan) are the CLIENT's fault -> 400
+    with the stable code; anything else is a 500."""
+    report = getattr(e, "report", None)   # SqlError / LintError only
+    if report is not None and getattr(report, "errors", None):
+        code = getattr(e, "code", None) or report.errors[0].code
+        return (REJECTION_STATUS.get(code, 400),
+                {"error": str(e), "code": code,
+                 "diagnostics": [d.render() for d in report]})
+    return 500, {"error": repr(e)}
 
 
 def serve(service, port: int = 0, host: str = "127.0.0.1"):
@@ -111,6 +139,12 @@ def serve(service, port: int = 0, host: str = "127.0.0.1"):
                         tenant=str(body.get("tenant", "default")),
                         priority=int(body.get("priority", 0)))
                     self._json(200, {"job": jid})
+                elif path == "/sql":
+                    jid = service.submit_sql(
+                        str(body.get("query", "")),
+                        tenant=str(body.get("tenant", "default")),
+                        priority=int(body.get("priority", 0)))
+                    self._json(200, {"job": jid})
                 elif path.startswith("/cancel/"):
                     jid = path[len("/cancel/"):]
                     try:
@@ -125,7 +159,8 @@ def serve(service, port: int = 0, host: str = "127.0.0.1"):
                            {"error": str(e), "code": e.code,
                             "tenant": e.tenant})
             except Exception as e:
-                self._json(500, {"error": repr(e)})
+                status, obj = _compile_rejection(e)
+                self._json(status, obj)
 
     srv = http.server.ThreadingHTTPServer((host, port), H)
     return srv, srv.server_address[1]
@@ -157,8 +192,19 @@ class Client:
                 raise RuntimeError(f"service error {e.code}: "
                                    f"{payload[:200]!r}")
             code = obj.get("code")
-            if code in REJECTION_STATUS:
-                raise ServiceRejected(obj.get("error", code), code=code,
+            if code:
+                # ANY code-carrying error body is a typed rejection —
+                # admission walls (DTA91x), SQL compile errors
+                # (DTA3xx), AND pre-submit lint/cost gates (e.g. a
+                # DTA201 >HBM plan) — so local and remote submission
+                # raise the same exception type
+                msg = obj.get("error", code)
+                # the daemon's message already carries the "[CODE] "
+                # prefix DiagnosticError adds — re-wrapping would
+                # stutter it
+                if msg.startswith(f"[{code}] "):
+                    msg = msg[len(code) + 3:]
+                raise ServiceRejected(msg, code=code,
                                       tenant=obj.get("tenant", ""))
             raise RuntimeError(obj.get("error", f"HTTP {e.code}"))
         return json.loads(payload.decode())
@@ -168,6 +214,14 @@ class Client:
         return self._req("/submit", {"app": app, "params": params or {},
                                      "tenant": tenant,
                                      "priority": priority})["job"]
+
+    def submit_sql(self, query: str, tenant: str = "default",
+                   priority: int = 0) -> str:
+        """Submit a SQL query over the daemon's catalog.  A compile
+        error re-raises as ServiceRejected with its DTA3xx code and
+        the full line:column diagnostics in the message."""
+        return self._req("/sql", {"query": query, "tenant": tenant,
+                                  "priority": priority})["job"]
 
     def status(self, job: str, result: bool = False) -> Dict[str, Any]:
         return self._req(f"/status/{job}"

@@ -123,6 +123,10 @@ class ServiceConfig:
         default_factory=dict)
     job_config: Optional[object] = None   # base JobConfig for jobs
     task_timeout_s: float = 600.0
+    # serialized sql.Catalog (Catalog.save JSON) the daemon loads at
+    # startup: the tables POST /sql queries resolve FROM clauses
+    # against (a Catalog object passed to JobService(...) wins)
+    catalog_path: Optional[str] = None
     # daemon-resident retention for TERMINAL jobs: beyond this many,
     # the oldest finished/failed/cancelled jobs drop from the live jobs
     # table and their per-job metric series are pruned from the

@@ -47,6 +47,11 @@ _LEVELS = {
     "job_submitted": 1, "job_started": 1, "job_cancelled": 1,
     "job_rejected": 1, "service_started": 1, "service_stopped": 1,
     "service_error": 0,
+    # SQL front end (dryad_tpu/sql): every lowering emits sql_query
+    # (normalized query text + catalog fingerprint — history/forensics
+    # bundles identify SQL jobs by it); sql_lowered carries the lowered
+    # shape (outputs/joins/grouping) and is chatter-grade
+    "sql_query": 1, "sql_lowered": 2,
     # chatter: progress ticks, losing duplicates, locality notes, spans,
     # periodic resource samples (obs/profile.py), per-stage adapt stats
     # and declined rewrites (dryad_tpu/adapt)

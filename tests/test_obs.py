@@ -59,6 +59,11 @@ def test_every_emitted_event_kind_is_registered():
     assert _LEVELS["graph_rewrite"] == 1
     assert _LEVELS["adapt_stats"] == 2
     assert _LEVELS["adapt_skipped"] == 2
+    # SQL front end (dryad_tpu/sql): sql_query identifies SQL jobs in
+    # history/forensics (job-lifecycle grade); the lowered-shape detail
+    # is chatter
+    assert _LEVELS["sql_query"] == 1
+    assert _LEVELS["sql_lowered"] == 2
 
 
 # -- satellite: EventLog lifecycle -------------------------------------------
