@@ -434,6 +434,119 @@ def smoke_sql(out_path="BENCH_sql.json", n_rows=None, reps=None,
     return out
 
 
+def smoke_analyze(out_path="BENCH_analyze.json", n_lines=None,
+                  reps=None, quiet=False):
+    """EXPLAIN ANALYZE smoke (``python bench.py --smoke-analyze``, also
+    rides ``--smoke``): the traced wordcount run through plain
+    ``collect()`` and through ``Dataset.analyze()`` (execute + annotate
+    the executed stages against the static cost model), INTERLEAVED
+    >= 3 reps each, median walls — the delta is the ANNOTATION
+    overhead (event capture + cost pass + report build).
+
+    Correctness gate, not just timing: the analyze report's totals must
+    EXACTLY equal the event-derived metrics of the same capture (both
+    accumulate in event order — bit-identical float sums), every
+    settled stage must carry actuals, the static predictions must
+    contain them, and the runtime cross-check must stay silent (zero
+    ``cost_model_miss``).  Written to ``BENCH_analyze.json`` +
+    appended to ``BENCH_trend.jsonl`` (app ``bench-analyze``)."""
+    import statistics
+
+    import jax
+
+    from dryad_tpu import Context
+    from dryad_tpu.apps import wordcount
+    from dryad_tpu.obs.metrics import metrics_from_events
+    from dryad_tpu.parallel.mesh import make_mesh
+
+    n_lines = n_lines or int(os.environ.get("BENCH_ANALYZE_LINES",
+                                            "8000"))
+    reps = max(3, reps or int(os.environ.get("BENCH_ANALYZE_REPS", "3")))
+    rng = np.random.RandomState(0)
+    vocab = np.array(["alpha", "beta", "gamma", "delta", "epsilon",
+                      "zeta", "eta", "theta"])
+    words_per_line = 6
+    idx = rng.randint(0, len(vocab), (n_lines, words_per_line))
+    lines = [" ".join(vocab[i]) for i in idx]
+    mesh = make_mesh(jax.devices())
+    per_part = -(-n_lines // mesh.devices.size)
+    cap = per_part * (words_per_line + 2)
+    ctx = Context(mesh=mesh)
+    q = wordcount.wordcount_query(
+        ctx.from_columns({"line": lines}, str_max_len=64),
+        tokens_per_partition=cap)
+
+    q.collect()                       # warmup: compiles (shared cache)
+    rep0 = q.analyze()                # warmup + the verified capture
+
+    # -- correctness: the ANALYZE actuals ARE the event-derived metrics
+    derived = metrics_from_events(rep0._events).snapshot()
+    checks = {
+        "stage_runs": (rep0.stage_runs,
+                       derived.get("dryad_stage_runs_total", 0)),
+        "run_s": (rep0.run_s,
+                  derived.get("dryad_run_seconds_total", 0.0)),
+        "compile_s": (rep0.compile_s,
+                      derived.get("dryad_compile_seconds_total", 0.0)),
+        "out_bytes": (rep0.out_bytes_total,
+                      derived.get("dryad_shuffle_bytes_total", 0)),
+    }
+    for what, (ours, theirs) in checks.items():
+        # snapshot() rounds to 6 places; match it for the comparison
+        assert round(float(ours), 6) == round(float(theirs), 6), \
+            f"analyze {what} {ours} != event-derived {theirs}"
+    settled = rep0.settled
+    assert settled and all(s.runs >= 1 for s in settled)
+    compared = [s for s in settled if s.bytes_in_bounds is not None]
+    assert compared, "no stage carried a prediction comparison"
+    assert all(s.bytes_in_bounds and s.rows_in_bounds
+               for s in compared), "prediction excluded a measured value"
+    assert rep0.misses == 0, f"{rep0.misses} cost_model_miss event(s)"
+
+    walls_plain, walls_analyze = [], []
+    for _ in range(reps):
+        t0 = time.time()
+        q.collect()
+        walls_plain.append(time.time() - t0)
+        t0 = time.time()
+        q.analyze()
+        walls_analyze.append(time.time() - t0)
+    plain_s = statistics.median(walls_plain)
+    analyze_s = statistics.median(walls_analyze)
+    overhead = (round(100.0 * (analyze_s - plain_s) / plain_s, 1)
+                if plain_s > 0 else None)
+    out = {
+        "metric": "analyze smoke (EXPLAIN ANALYZE vs plain collect)",
+        "lines": n_lines,
+        "reps": reps,
+        "wall_s_plain": round(plain_s, 4),
+        "wall_s_analyze": round(analyze_s, 4),
+        "wall_s_plain_all": [round(w, 4) for w in walls_plain],
+        "wall_s_analyze_all": [round(w, 4) for w in walls_analyze],
+        "annotation_overhead_pct": overhead,
+        "stages": len(rep0.stages),
+        "stages_settled": len(settled),
+        "stages_prediction_compared": len(compared),
+        "predictions_contained": True,
+        "actuals_match_metrics": True,
+        "cost_model_misses": rep0.misses,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-analyze",
+            "wall_s": round(analyze_s, 4),
+            "plain_wall_s": round(plain_s, 4),
+            "overhead_pct": overhead, "lines": n_lines,
+            "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def smoke_kernels(out_path="BENCH_kernels.json", n=None, quiet=False):
     """Data-plane kernel micro-bench smoke (``python bench.py
     --smoke-kernels``, also rides ``--smoke``): DEVICE-TRUTH rows for the
@@ -1515,6 +1628,9 @@ if __name__ == "__main__":
     elif "--smoke-service" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-service"]
         smoke_service(out_path=args[0] if args else "BENCH_service.json")
+    elif "--smoke-analyze" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-analyze"]
+        smoke_analyze(out_path=args[0] if args else "BENCH_analyze.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
@@ -1532,5 +1648,7 @@ if __name__ == "__main__":
                       quiet=True)
         smoke_sql(out_path=os.path.join(base, "BENCH_sql.json"),
                   quiet=True)
+        smoke_analyze(out_path=os.path.join(base, "BENCH_analyze.json"),
+                      quiet=True)
     else:
         main()

@@ -68,6 +68,7 @@ def _selfcheck() -> int:
         print("docs/diagnostics.md: in sync with diagnostics.CODES")
 
     failures.extend(_sql_golden_check())
+    failures.extend(_obs_docs_check())
 
     import json as _json
     plans = [p for p in sorted((_REPO / "docs" / "plans").glob("*.json"))
@@ -93,6 +94,32 @@ def _selfcheck() -> int:
     for f in failures:
         print(f"SELFCHECK FAILURE: {f}", file=sys.stderr)
     return 1 if failures else 0
+
+
+def _obs_docs_check() -> list:
+    """docs/observability.md drift gate: the consolidated observability
+    guide must cover every ``python -m dryad_tpu.obs`` subcommand
+    (obs/__main__.OBS_COMMANDS is the source of truth) and the live
+    service-observability surfaces — an added/renamed tool or endpoint
+    that skips the doc fails the selfcheck the day it lands."""
+    doc = _REPO / "docs" / "observability.md"
+    if not doc.exists():
+        return [f"{doc}: missing (the consolidated observability "
+                f"guide — ISSUE 13)"]
+    text = doc.read_text()
+    from dryad_tpu.obs.__main__ import OBS_COMMANDS
+    missing = [f"obs subcommand {c!r}" for c in OBS_COMMANDS
+               if c not in text]
+    missing += [f"surface {s!r}" for s in
+                ("/events/", "/slo", "EXPLAIN ANALYZE",
+                 "regression_suspect", "slo_breach",
+                 "DRYAD_LOGGING_LEVEL")
+                if s not in text]
+    if missing:
+        return [f"{doc}: stale — not mentioned: {', '.join(missing)}"]
+    print("docs/observability.md: covers every obs subcommand + live "
+          "service surfaces")
+    return []
 
 
 def _sql_golden_check() -> list:

@@ -1221,7 +1221,75 @@ class Dataset:
                               levels=self.ctx.levels,
                               config=self.ctx.config)
 
-    def explain(self, verify: bool = False, cost: bool = False) -> str:
+    def analyze(self):
+        """EXPLAIN ANALYZE: execute this query ONCE under an explicit
+        event capture and return the measured per-stage actuals
+        annotated against the static cost model
+        (:class:`~dryad_tpu.obs.analyze.AnalyzeReport` — rows, output
+        bytes, wall/compile split, retries/replays/spills, compile-cache
+        hits, adaptive rewrites, and predicted-vs-actual deltas with the
+        runtime cross-check's ``cost_model_miss`` verdicts inline).
+
+        The capture is an explicit opt-in consumer (its own
+        ``EventLog(level=2)``), independent of ``DRYAD_LOGGING_LEVEL``
+        — asking for ANALYZE *is* asking for the telemetry.  The
+        pre-submit lint gate applies exactly as in ``collect()`` (a
+        plan ``lint="error"`` refuses to submit raises LintError here
+        too — ANALYZE executes, so it must not bypass the gate); the
+        cost pass itself still runs under ``lint="off"`` and can never
+        block the run (on failure the report simply carries no
+        predictions).
+        In-process mesh execution only — cluster/local_debug/streamed
+        runs record their streams to JSONL, which ``python -m
+        dryad_tpu.obs analyze`` annotates post-hoc."""
+        if self.ctx.local_debug or self.ctx.executor is None:
+            raise ValueError(
+                "EXPLAIN ANALYZE needs an in-process mesh Context "
+                "(local_debug and cluster contexts do not execute "
+                "through the instrumented executor — record a JSONL "
+                "and use `python -m dryad_tpu.obs analyze` instead)")
+        if self._streaming():
+            raise ValueError(
+                "EXPLAIN ANALYZE does not cover streamed (>RAM) plans "
+                "— per-stage HBM actuals do not apply; use `python -m "
+                "dryad_tpu.obs analyze` over the recorded stream")
+        from dryad_tpu.obs.analyze import analyze_events
+        from dryad_tpu.utils.events import EventLog
+        graph = plan_query(self.node, self.ctx.nparts,
+                           hosts=self.ctx.hosts, levels=self.ctx.levels,
+                           config=self.ctx.config)
+        # the SAME gate _materialize runs: lint="error" findings refuse
+        # to submit (LintError), "warn" logs them to the attached
+        # context log, and the gate's cost pass feeds the annotation
+        cost_rep = self.ctx._pre_submit_lint(self.node, cluster=False,
+                                             graph=graph)
+        cap = EventLog(level=2)
+        if cost_rep is None:
+            # lint="off" (or the gate's cost pass failed): ANALYZE
+            # still wants predictions, but the model must never block
+            # it — on failure the report carries actuals only
+            try:
+                from dryad_tpu.analysis.cost import estimate_graph
+                cost_rep = estimate_graph(graph, self.ctx.nparts,
+                                          config=self.ctx.config)
+            except Exception:
+                cost_rep = None
+        if cost_rep is not None:
+            cap({"event": "cost_report",
+                 "report": cost_rep.to_payload()})
+        self.ctx.executor.run(graph, spill_dir=self.ctx.spill_dir,
+                              cost_report=cost_rep, event_log=cap)
+        cap.close()
+        rep = analyze_events(cap.events)
+        if self.ctx._event_log is not None:
+            # the annotation is job telemetry too: a context with a
+            # JSONL attached records the machine-readable report
+            self.ctx._event_log({"event": "analyze_report",
+                                 "report": rep.to_payload()})
+        return rep
+
+    def explain(self, verify: bool = False, cost: bool = False,
+                analyze: bool = False) -> str:
         text = plan_query(self.node, self.ctx.nparts,
                           hosts=self.ctx.hosts,
                           levels=self.ctx.levels,
@@ -1240,4 +1308,9 @@ class Dataset:
             text += "\n\ndiagnostics:\n" + report.render()
         if cost_rep is not None:
             text += "\n\npredicted cost:\n" + cost_rep.render()
+        if analyze:
+            # EXPLAIN ANALYZE: the plan above, then what actually
+            # happened when it ran (measured actuals vs the model)
+            text += ("\n\nEXPLAIN ANALYZE (executed):\n"
+                     + self.analyze().render())
         return text

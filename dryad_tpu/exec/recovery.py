@@ -345,10 +345,12 @@ class Run:
         self._results[sid] = out
         self._save_spill(sid, out)
         # progress percentage pushed to the event stream (the reference
-        # pushes it to the launcher, DrGraph.cpp:109-110)
+        # pushes it to the launcher, DrGraph.cpp:109-110); the settled
+        # stage rides along so live consumers (the service dashboard's
+        # per-job progress bars, SSE followers) can label the tick
         total = len(self.graph.stages)
         self._event({"event": "progress", "done": len(self._results),
-                        "total": total,
+                        "total": total, "stage": sid,
                         "pct": round(100.0 * len(self._results) / total, 1)})
         # adaptive boundary: the unexecuted suffix may be rewritten from
         # this stage's observed stats BEFORE any dependent runs (the

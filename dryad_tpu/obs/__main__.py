@@ -6,13 +6,22 @@ The jobctl-style post-hoc tools over recorded telemetry:
                      includes resource-sample counter tracks)
 * ``critical-path``  print the job's critical-path decomposition
 * ``metrics``        print Prometheus text metrics derived from events
+* ``analyze``        EXPLAIN ANALYZE over a recorded stream: per-stage
+                     measured actuals vs the static cost model
+                     (obs/analyze.py)
 * ``replay``         re-execute a task-failure forensics bundle
                      in-process, reproducing the remote exception
 * ``history``        list a job-history directory with cross-run deltas
 
+``trace`` / ``critical-path`` / ``metrics`` / ``analyze`` accept
+``--job <id>``: a multi-job service JSONL (every record job-tagged by
+the daemon) is filtered to that one job's records first — no manual
+grep.
+
 Exit codes: 0 success (for ``replay``: the recorded failure was
 faithfully reproduced), 1 reproduction mismatch, 2 malformed input
-(missing/unreadable files, empty event streams, non-bundles).
+(missing/unreadable files, empty event streams, non-bundles, a --job
+id matching no records).
 """
 
 from __future__ import annotations
@@ -21,6 +30,11 @@ import argparse
 import json
 import os
 import sys
+
+# the post-hoc tool surface (docs/observability.md is drift-checked
+# against this by ``python -m dryad_tpu.analysis --selfcheck``)
+OBS_COMMANDS = ("trace", "critical-path", "metrics", "analyze",
+                "replay", "history")
 
 
 def _fail(msg: str) -> int:
@@ -120,14 +134,20 @@ def main(argv=None) -> int:
         description="telemetry tools over an EventLog JSONL stream")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
+    def _events_args(p):
+        p.add_argument("events", help="EventLog JSONL path")
+        p.add_argument("--job", default=None,
+                       help="filter to this job id's records (multi-"
+                            "job service JSONL)")
+
     t = sub.add_parser("trace", help="export Chrome trace-event JSON")
-    t.add_argument("events", help="EventLog JSONL path")
+    _events_args(t)
     t.add_argument("-o", "--out",
                    help="output path (default: <events>.trace.json)")
 
     c = sub.add_parser("critical-path",
                        help="critical-path decomposition")
-    c.add_argument("events", help="EventLog JSONL path")
+    _events_args(c)
     c.add_argument("--top", type=int, default=10,
                    help="segments to print (default 10)")
     c.add_argument("--json", action="store_true",
@@ -135,7 +155,15 @@ def main(argv=None) -> int:
 
     m = sub.add_parser("metrics",
                        help="Prometheus text metrics from events")
-    m.add_argument("events", help="EventLog JSONL path")
+    _events_args(m)
+
+    a = sub.add_parser("analyze",
+                       help="EXPLAIN ANALYZE: measured per-stage "
+                            "actuals vs the static cost model "
+                            "(obs/analyze.py)")
+    _events_args(a)
+    a.add_argument("--json", action="store_true",
+                   help="machine-readable report payload")
 
     r = sub.add_parser("replay",
                        help="re-execute a forensics bundle in-process "
@@ -163,6 +191,20 @@ def main(argv=None) -> int:
     if events is None:
         return _fail(f"{args.events!r} is missing or holds no "
                      f"parseable events")
+    if getattr(args, "job", None):
+        events = [e for e in events if e.get("job") == args.job]
+        if not events:
+            return _fail(f"no records tagged job={args.job!r} in "
+                         f"{args.events!r}")
+    if args.cmd == "analyze":
+        from dryad_tpu.obs.analyze import analyze_events
+        rep = analyze_events(events, job=None)   # already filtered
+        if args.json:
+            json.dump(rep.to_payload(), sys.stdout)
+            print()
+        else:
+            print(rep.render())
+        return 0
     if args.cmd == "trace":
         from dryad_tpu.obs.chrome import chrome_trace
         out = args.out or (args.events + ".trace.json")

@@ -29,16 +29,27 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["archive_job", "history_index", "render_history_text",
-           "index_html"]
+           "index_html", "regression_findings"]
 
 _SPLIT_KEYS = ("wall_s", "compile_s", "run_s", "io_s")
+
+# regression watch (the "did this run get slower?" archive-time gate):
+# a run whose wall/compile/run split reaches FACTOR x the median of the
+# app's recent ok runs — or that starts spilling when the baseline did
+# not — is flagged with a ``regression_suspect`` finding the moment it
+# archives (viewer.diagnose + the history index surface it).  The
+# baseline window and the sub-hundredth-of-a-second floor keep one
+# noisy micro-run from crying wolf.
+REGRESSION_FACTOR = 1.5
+_REGRESSION_BASELINE_RUNS = 5
+_REGRESSION_MIN_BASELINE_S = 0.02
 
 
 def _job_summary(events, app: Optional[str]) -> Dict[str, Any]:
     """Wall/compile/run/io split + failure verdict from one stream."""
     compile_s = run_s = io_s = 0.0
     wall = None
-    tasks = stages = 0
+    tasks = stages = spills = 0
     failure = None
     status = "ok"
     for e in events:
@@ -47,6 +58,8 @@ def _job_summary(events, app: Optional[str]) -> Dict[str, Any]:
             stages += 1
             compile_s += float(e.get("compile_s") or 0.0)
             run_s += float(e.get("wall_s") or 0.0)
+        elif k in ("stage_spilled", "stream_tee_spill"):
+            spills += 1
         elif k == "task_done":
             tasks += 1
         elif k == "span" and e.get("kind") == "io":
@@ -69,7 +82,66 @@ def _job_summary(events, app: Optional[str]) -> Dict[str, Any]:
                         if failure else None),
             "wall_s": round(wall, 4), "compile_s": round(compile_s, 4),
             "run_s": round(run_s, 4), "io_s": round(io_s, 4),
-            "stages": stages, "tasks": tasks}
+            "stages": stages, "tasks": tasks, "spills": spills}
+
+
+def regression_findings(history_dir: str, summary: Dict[str, Any],
+                        factor: float = REGRESSION_FACTOR
+                        ) -> List[Dict[str, Any]]:
+    """``regression_suspect`` findings for one fresh summary vs the
+    app's history baseline (the median of its last
+    ``_REGRESSION_BASELINE_RUNS`` ok runs): a wall/compile/run split at
+    ``factor`` x the baseline, or spills appearing where the baseline
+    had none (or doubling where it had some).  Empty for failed runs,
+    anonymous apps, and first runs (no baseline = nothing to regress
+    against)."""
+    import statistics
+    app = summary.get("app")
+    if summary.get("status") != "ok" or app in (None, "job"):
+        return []
+    prior: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(history_dir))
+    except OSError:
+        return []
+    for name in names:
+        p = os.path.join(history_dir, name, "summary.json")
+        if not os.path.isfile(p):
+            continue
+        try:
+            with open(p) as f:
+                s = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if s.get("app") == app and s.get("status") == "ok":
+            prior.append(s)
+    if not prior:
+        return []
+    prior.sort(key=lambda s: float(s.get("ts") or 0.0))
+    prior = prior[-_REGRESSION_BASELINE_RUNS:]
+    out: List[Dict[str, Any]] = []
+
+    def finding(what, measured, baseline):
+        out.append({"event": "regression_suspect", "app": app,
+                    "what": what, "measured": measured,
+                    "baseline_median": baseline,
+                    "ratio": (round(measured / baseline, 2)
+                              if baseline else None),
+                    "baseline_runs": len(prior), "factor": factor})
+
+    for key in ("wall_s", "compile_s", "run_s"):
+        base = statistics.median(float(p.get(key) or 0.0)
+                                 for p in prior)
+        cur = float(summary.get(key) or 0.0)
+        if base >= _REGRESSION_MIN_BASELINE_S and cur >= factor * base:
+            finding(key, round(cur, 4), round(base, 4))
+    sbase = statistics.median(int(p.get("spills") or 0) for p in prior)
+    scur = int(summary.get("spills") or 0)
+    if (sbase == 0 and scur > 0) or (sbase > 0
+                                     and scur >= factor * sbase
+                                     and scur > sbase):
+        finding("spills", scur, sbase)
+    return out
 
 
 def archive_job(history_dir: str, events, app: Optional[str] = None,
@@ -115,7 +187,13 @@ def archive_job(history_dir: str, events, app: Optional[str] = None,
             f.write(plan_json)
     with open(os.path.join(job_dir, "metrics.json"), "w") as f:
         json.dump(metrics_from_events(events).snapshot(), f, indent=1)
-    findings = diagnose_events(events)
+    # regression watch: compare THIS run against the app's baseline
+    # BEFORE this archive joins the index (the findings land in the
+    # archived stream like diagnosis findings, so viewer.diagnose()
+    # over the archive surfaces them)
+    regs = regression_findings(history_dir, summary)
+    summary["regressions"] = [r["what"] for r in regs]
+    findings = diagnose_events(events) + regs
     with open(os.path.join(job_dir, "events.jsonl"), "w") as f:
         for e in events + findings + [
                 {"event": "job_archived", "path": job_dir,
@@ -218,6 +296,10 @@ def render_history_text(entries: List[Dict[str, Any]]) -> str:
             f"{len(s.get('bundles') or ()):>7}")
         if s.get("failure"):
             lines.append(f"{'':<19}   ↳ {s['failure']}")
+        if s.get("regressions"):
+            lines.append(f"{'':<19}   ↳ regression suspect: "
+                         f"{', '.join(s['regressions'])} (vs the app's "
+                         f"history baseline)")
     return "\n".join(lines)
 
 
@@ -240,6 +322,10 @@ def index_html(entries: List[Dict[str, Any]],
         scls = "critical" if status == "failed" else "ink2"
         fail = (f'<div class="hl">{_html.escape(str(s["failure"]))}'
                 f'</div>' if s.get("failure") else "")
+        if s.get("regressions"):
+            fail += (f'<div class="rg">&#9888; regression suspect: '
+                     f'{_html.escape(", ".join(s["regressions"]))}'
+                     f'</div>')
         bundles = len(s.get("bundles") or ())
         rows.append(
             f"<tr><td>{_when(float(s.get('ts') or 0.0))}</td>"
@@ -276,6 +362,7 @@ def index_html(entries: List[Dict[str, Any]],
   td:nth-child(2), th:nth-child(2), td:nth-child(10) {{
     text-align: left; }}
   .hl {{ color: var(--critical); font-size: 12px; }}
+  .rg {{ color: var(--warning); font-size: 12px; }}
 </style></head>
 <body><h1>{_html.escape(title)}</h1>
 {extra_html}

@@ -67,6 +67,15 @@ FAMILIES = {
                    "stream Tee spills"),
     "jobs": ("dryad_jobs_total", "completed jobs"),
     "jobs_failed": ("dryad_jobs_failed_total", "failed jobs"),
+    "job_progress": ("dryad_job_progress_ratio",
+                     "per-job progress fraction (settled stages or "
+                     "tasks over total, 0..1)"),
+    "slo_attainment": ("dryad_slo_attainment_ratio",
+                       "rolling fraction of a tenant's jobs meeting "
+                       "its SLO"),
+    "slo_burn": ("dryad_slo_burn_rate",
+                 "SLO error-budget burn rate (>1 = burning faster "
+                 "than the objective allows)"),
     "io_requests": ("dryad_io_requests_total",
                     "IO provider operations"),
     "io_bytes": ("dryad_io_bytes_total", "IO provider bytes moved"),
@@ -82,7 +91,7 @@ FAMILIES = {
 PER_JOB_FAMILIES = ("queue_depth", "task_seconds", "graph_rewrites",
                     "cache_hits", "cache_misses", "tasks", "jobs",
                     "jobs_failed", "stage_runs", "shuffle_bytes",
-                    "compile_seconds", "run_seconds")
+                    "compile_seconds", "run_seconds", "job_progress")
 
 
 def family_counter(reg: "Registry", key: str, **labels) -> "Counter":
@@ -366,6 +375,13 @@ def metrics_from_events(events, registry: Optional[Registry] = None,
             C("jobs", e).inc()
         elif k == "job_failed":
             C("jobs_failed", e).inc()
+        elif k == "progress" and e.get("pct") is not None:
+            # the derived mirror of the service's live progress gauge:
+            # the LAST progress record wins (gauge semantics)
+            labels = ({"job": str(e["job"])}
+                      if by_job and e.get("job") is not None else {})
+            family_gauge(r, "job_progress",
+                         **labels).set(float(e["pct"]) / 100.0)
         elif k == "span" and e.get("kind") == "io":
             a = e.get("attrs") or {}
             op = e.get("name", "io")

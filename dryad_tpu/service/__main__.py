@@ -13,6 +13,9 @@
 * ``cancel``   cancel a queued/running job
 * ``list``     all jobs the daemon knows
 * ``tenants``  fair-share snapshot (slot-seconds, running, failures)
+* ``slo``      per-tenant SLO attainment + error-budget burn rate
+* ``events``   follow one job's live event stream (SSE; ``--after N``
+               resumes at a cursor) until the job is terminal
 
 Exit codes: 0 success; 1 the operation failed (job failed / unknown
 job); 2 typed rejection (the stable code is printed — DTA91x admission
@@ -127,6 +130,21 @@ def _cmd_tenants(args) -> int:
     return 0
 
 
+def _cmd_slo(args) -> int:
+    print(json.dumps(_client(args).slo(), indent=2))
+    return 0
+
+
+def _cmd_events(args) -> int:
+    try:
+        for e in _client(args).stream_events(args.job,
+                                             after=args.after):
+            print(json.dumps(e, default=str), flush=True)
+    except RuntimeError as e:     # unknown job -> 404
+        return _fail(str(e), rc=1)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dryad_tpu.service",
@@ -193,6 +211,18 @@ def main(argv=None) -> int:
     s = sub.add_parser("tenants", help="fair-share snapshot")
     _url(s)
     s.set_defaults(fn=_cmd_tenants)
+
+    s = sub.add_parser("slo", help="per-tenant SLO attainment + burn")
+    _url(s)
+    s.set_defaults(fn=_cmd_slo)
+
+    s = sub.add_parser("events",
+                       help="follow one job's live event stream (SSE)")
+    _url(s)
+    s.add_argument("job")
+    s.add_argument("--after", type=int, default=0,
+                   help="resume at this event cursor (default 0)")
+    s.set_defaults(fn=_cmd_events)
 
     args = ap.parse_args(argv)
     try:

@@ -89,6 +89,18 @@ class JobService:
         from dryad_tpu.utils.compile_cache import FileCache
         self.plan_cache = FileCache(os.path.join(root, "cache"))
         self.admission = AdmissionQueue(config.quota)
+        # per-tenant SLO tracking (obs/slo.py): every terminal job folds
+        # into the tenant's rolling window; attainment/burn served at
+        # GET /slo + the dashboard tenant table, slo_breach emitted on
+        # the transition past burn rate 1.0
+        from dryad_tpu.obs.slo import SloTracker
+        self.slo = SloTracker(config.slo_objective)
+        self._slo_breaching: set = set()
+        # record + transition-check must be atomic per tenant, or two
+        # fleet threads retiring the same tenant's jobs concurrently
+        # could both see "not yet breaching" and double-emit the
+        # once-per-transition slo_breach
+        self._slo_lock = threading.Lock()
         self.jobs: Dict[str, ServiceJob] = {}
         self._jobs_lock = threading.Lock()
         self._seq = 0
@@ -592,6 +604,46 @@ class JobService:
         with self._jobs_lock:
             return [j.to_row() for j in self.jobs.values()]
 
+    # -- per-tenant SLOs (obs/slo.py) --------------------------------------
+
+    def _job_terminal(self, job: ServiceJob) -> None:
+        """Fold one terminal job into its tenant's rolling SLO window,
+        refresh the live gauges, and emit ``slo_breach`` on the
+        transition into burn > 1.  Cancellations are neither good nor
+        bad (the tenant asked for them); tenants without a declared SLO
+        record nothing — at any logging level this path builds zero
+        events unless a breach actually transitions."""
+        if job.state == "cancelled":
+            return
+        wall = ((job.finished_ts - (job.started_ts or job.submitted_ts))
+                if job.finished_ts else None)
+        with self._slo_lock:
+            row = self.slo.record(job.tenant, job.state == "done", wall)
+            if row is None:
+                return
+            family_gauge(REGISTRY, "slo_attainment",
+                         tenant=job.tenant).set(row["attainment"])
+            family_gauge(REGISTRY, "slo_burn",
+                         tenant=job.tenant).set(row["burn_rate"])
+            if row["breaching"]:
+                if job.tenant not in self._slo_breaching:
+                    self._slo_breaching.add(job.tenant)
+                    self.log({"event": "slo_breach",
+                              "tenant": job.tenant,
+                              "attainment": row["attainment"],
+                              "burn_rate": row["burn_rate"],
+                              "target": row["target"],
+                              "latency_s": row["latency_s"],
+                              "window": row["window"],
+                              "jobs": row["jobs"]})
+            else:
+                self._slo_breaching.discard(job.tenant)
+
+    def slo_snapshot(self) -> Dict[str, dict]:
+        """{tenant: attainment/burn row} for every SLO-declaring tenant
+        that has recorded terminal jobs (``GET /slo``)."""
+        return self.slo.snapshot()
+
     # -- dashboard / metrics -----------------------------------------------
 
     def metrics_text(self) -> str:
@@ -606,25 +658,51 @@ class JobService:
         from dryad_tpu.obs.history import history_index, index_html
         rows = []
         for r in reversed(self.list_jobs()):
+            pct = float(r.get("progress_pct") or 0.0)
+            bar = (
+                f'<td><div style="background: var(--grid); '
+                f'width: 120px; height: 10px; border-radius: 4px">'
+                f'<div style="background: var(--series); height: 10px; '
+                f'border-radius: 4px; width: {pct:.1f}%"></div></div>'
+                f'<span style="font-size: 11px; color: var(--ink2)">'
+                f'{pct:.0f}%</span></td>')
             rows.append(
                 f"<tr><td>{_html.escape(r['job'])}</td>"
                 f"<td>{_html.escape(r['tenant'])}</td>"
                 f"<td>{_html.escape(r['app'])}</td>"
                 f"<td>{_html.escape(r['state'])}</td>"
+                f"{bar}"
                 f"<td>{r['tasks_done']}/{r['tasks']}</td>"
                 f"<td>{r['wall_s'] if r['wall_s'] is not None else '—'}"
                 f"</td></tr>")
         shares = self.admission.shares()
-        srows = [
-            f"<tr><td>{_html.escape(t)}</td><td>{v[0]:.3f}</td>"
-            f"<td>{v[1]}</td><td>{v[2]}</td></tr>"
-            for t, v in sorted(shares.items())]
+        slo = self.slo_snapshot()
+        srows = []
+        for t, v in sorted(shares.items()):
+            s = slo.get(t)
+            if s is None:
+                scol = "<td>—</td><td>—</td><td>—</td>"
+            else:
+                bcls = "critical" if s["breaching"] else "ink2"
+                scol = (
+                    f"<td>{s['target']:.2f}"
+                    + (f" / {s['latency_s']:g}s" if s["latency_s"]
+                       else "")
+                    + f"</td><td>{s['attainment']:.3f}</td>"
+                    f'<td style="color: var(--{bcls})">'
+                    f"{s['burn_rate']:.2f}"
+                    + (" &#9888;" if s["breaching"] else "")
+                    + "</td>")
+            srows.append(
+                f"<tr><td>{_html.escape(t)}</td><td>{v[0]:.3f}</td>"
+                f"<td>{v[1]}</td><td>{v[2]}</td>{scol}</tr>")
         extra = (
             "<h2>jobs</h2><table><tr><th>job</th><th>tenant</th>"
-            "<th>app</th><th>state</th><th>tasks</th><th>wall&nbsp;s"
-            "</th></tr>" + "".join(rows) + "</table>"
+            "<th>app</th><th>state</th><th>progress</th><th>tasks</th>"
+            "<th>wall&nbsp;s</th></tr>" + "".join(rows) + "</table>"
             "<h2>tenants</h2><table><tr><th>tenant</th>"
-            "<th>slot&nbsp;s</th><th>running</th><th>failures</th></tr>"
+            "<th>slot&nbsp;s</th><th>running</th><th>failures</th>"
+            "<th>SLO</th><th>attainment</th><th>burn</th></tr>"
             + "".join(srows) + "</table><h2>history</h2>")
         return index_html(history_index(self.history_dir),
                           title="dryad job service", extra_html=extra)
@@ -653,6 +731,7 @@ class JobService:
                 j.finish(False, error="service stopped with the job "
                                       "in flight")
                 self.admission.retire(j)
+                self._job_terminal(j)
         self.log({"event": "service_stopped"})
         self.log.close()
         if self._own_cluster and self.cluster is not None:
@@ -766,6 +845,7 @@ class _LocalFleet:
                                job=job.id).inc()
             else:
                 job.result = None
+            svc._job_terminal(job)
 
 
 class _ClusterFleet:
@@ -888,6 +968,7 @@ class _ClusterFleet:
         self.service.admission.retire(job)
         family_counter(REGISTRY, "jobs_failed", job=job.id).inc()
         family_gauge(REGISTRY, "queue_depth", job=job.id).set(0)
+        self.service._job_terminal(job)
 
     def _on_reply(self, pid: int, reply: dict) -> None:
         from dryad_tpu.obs import trace
@@ -943,6 +1024,7 @@ class _ClusterFleet:
             self.service.admission.retire(job)
             family_counter(REGISTRY, "jobs", job=job.id).inc()
             family_gauge(REGISTRY, "queue_depth", job=job.id).set(0)
+            self.service._job_terminal(job)
 
     # -- the loop ----------------------------------------------------------
 
@@ -1003,6 +1085,7 @@ class _ClusterFleet:
                     j.finish(False, error="all fleet workers died"
                              + self.cl.log_tails())
                     svc.admission.retire(j)
+                    svc._job_terminal(j)
             time.sleep(0.5)
             return
         # fill idle workers from the fair-share queue (belt+braces:

@@ -12,6 +12,25 @@ One small surface over the stdlib HTTP plumbing the repo already uses
                             combined result when done)
 ``GET /tenants``            fair-share snapshot {tenant: [slot_s,
                             running, failures]}
+``GET /slo``                per-tenant SLO attainment + error-budget
+                            burn rate (obs/slo.py; tenants declare
+                            objectives on their TenantQuota)
+``GET /events/<job>``       LONG-POLL the job's live event stream:
+                            ``?after=N`` resumes at cursor N,
+                            ``?timeout_s=S`` bounds the wait; returns
+                            {"events", "next", "state",
+                            "progress_pct"} the moment fresh records
+                            exist (or immediately when the job is
+                            terminal)
+``GET /events/<job>/stream``  the same stream as Server-Sent Events
+                            (``text/event-stream``): one ``data:``
+                            frame per record from cursor ``?after=N``,
+                            keepalive comments while idle, a final
+                            ``event: done`` frame at the terminal
+                            state — the Dryad GM web UI's live view,
+                            multi-jobbed (per-job logs, so two
+                            concurrent jobs' streams can never
+                            interleave)
 ``GET /metrics``            Prometheus text exposition of the live
                             registry (per-job labeled families incl.)
 ``POST /submit``            JSON {app, params?, tenant?, priority?} ->
@@ -95,6 +114,47 @@ def serve(service, port: int = 0, host: str = "127.0.0.1"):
         def _json(self, status: int, obj: Any) -> None:
             self._send(status, json.dumps(obj, default=str).encode())
 
+        def _qs(self, query: str) -> Dict[str, str]:
+            import urllib.parse
+            return {k: v[-1] for k, v
+                    in urllib.parse.parse_qs(query).items()}
+
+        def _sse(self, job, after: int) -> None:
+            """Server-Sent Events: stream the job's records from the
+            cursor, keepalive comments while idle, one final ``event:
+            done`` frame once the job is terminal and fully drained
+            (``log.closed`` guarantees the close-time ``job_archived``
+            record has landed).  A vanished client just ends the
+            stream — it holds no job state."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            n = after
+            try:
+                while True:
+                    evs, n = job.events_since(n, timeout=0.5)
+                    for e in evs:
+                        self.wfile.write(
+                            b"data: "
+                            + json.dumps(e, default=str).encode()
+                            + b"\n\n")
+                    if not evs and job.state not in ("queued",
+                                                     "running") \
+                            and job.log.closed:
+                        self.wfile.write(
+                            b"event: done\ndata: "
+                            + json.dumps({"state": job.state,
+                                          "next": n}).encode()
+                            + b"\n\n")
+                        self.wfile.flush()
+                        return
+                    if not evs:
+                        self.wfile.write(b": keepalive\n\n")
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return
+
         def do_GET(self):
             path, _, query = self.path.partition("?")
             try:
@@ -105,6 +165,27 @@ def serve(service, port: int = 0, host: str = "127.0.0.1"):
                     self._json(200, service.list_jobs())
                 elif path == "/tenants":
                     self._json(200, service.admission.shares())
+                elif path == "/slo":
+                    self._json(200, service.slo_snapshot())
+                elif path.startswith("/events/"):
+                    rest = path[len("/events/"):]
+                    sse = rest.endswith("/stream")
+                    jid = rest[:-len("/stream")] if sse else rest
+                    try:
+                        job = service.job(jid)
+                    except KeyError:
+                        return self._json(
+                            404, {"error": f"unknown job {jid}"})
+                    qs = self._qs(query)
+                    after = max(0, int(qs.get("after", 0)))
+                    if sse:
+                        return self._sse(job, after)
+                    timeout = min(30.0,
+                                  float(qs.get("timeout_s", 10.0)))
+                    evs, nxt = job.events_since(after, timeout=timeout)
+                    self._json(200, {"job": job.id, "state": job.state,
+                                     "progress_pct": job.progress_pct,
+                                     "events": evs, "next": nxt})
                 elif path == "/metrics":
                     self._send(200, service.metrics_text().encode(),
                                "text/plain; version=0.0.4; "
@@ -235,6 +316,48 @@ class Client:
 
     def tenants(self) -> Dict[str, Any]:
         return self._req("/tenants")
+
+    def slo(self) -> Dict[str, Any]:
+        """Per-tenant SLO attainment/burn snapshot (``GET /slo``)."""
+        return self._req("/slo")
+
+    def events(self, job: str, after: int = 0,
+               timeout_s: float = 10.0) -> Dict[str, Any]:
+        """One long-poll read of the job's live event stream: returns
+        {"events", "next", "state", "progress_pct"}; pass the returned
+        ``next`` as the next call's ``after`` to follow the job."""
+        return self._req(f"/events/{job}?after={after}"
+                         f"&timeout_s={timeout_s}")
+
+    def stream_events(self, job: str, after: int = 0):
+        """Generator over the job's SSE stream
+        (``GET /events/<job>/stream``): yields each recorded event dict
+        live, returning after the terminal ``done`` frame."""
+        req = urllib.request.Request(
+            self.url + f"/events/{job}/stream?after={after}")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            # same clean-failure contract as _req: an unknown job is a
+            # typed RuntimeError ("unknown job ..."), not a raw
+            # HTTPError traceback out of the CLI
+            payload = e.read()
+            try:
+                obj = json.loads(payload.decode())
+            except ValueError:
+                obj = {}
+            raise RuntimeError(obj.get("error",
+                                       f"service error {e.code}"))
+        with resp as r:
+            done = False
+            for raw in r:
+                line = raw.decode("utf-8", "replace").rstrip("\n")
+                if line == "event: done":
+                    done = True
+                elif line.startswith("data: "):
+                    if done:
+                        return      # the terminal frame's payload
+                    yield json.loads(line[len("data: "):])
 
     def metrics(self) -> str:
         req = urllib.request.Request(self.url + "/metrics")

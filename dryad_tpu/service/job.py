@@ -29,18 +29,28 @@ JOB_STATES = ("queued", "running", "done", "failed", "cancelled")
 
 
 class _JobLog(EventLog):
-    """An EventLog that stamps the owning job's id on EVERY record at
-    the sink itself — including the log's own close-time emissions
-    (``job_archived``) — so a job's JSONL is job-tagged end to end and
-    concurrent jobs' streams can never interleave anonymously."""
+    """An EventLog that stamps the owning job's id — and tenant — on
+    EVERY record at the sink itself, including the log's own close-time
+    emissions (``job_archived``), so a job's JSONL is job-tagged end to
+    end and concurrent jobs' streams can never interleave anonymously.
+    The tenant stamp makes the archived stream self-sufficient for
+    post-hoc SLO derivation (``obs/slo.slo_from_events``): the
+    Run-emitted ``job_done`` of an in-process query job carries no
+    tenant of its own, and without the sink stamp an archive would
+    count a tenant's failures (service-emitted, tenant-tagged) while
+    dropping its successes."""
 
-    def __init__(self, job_id: str, *a, **kw):
+    def __init__(self, job_id: str, *a, tenant: Optional[str] = None,
+                 **kw):
         self.job_id = job_id
+        self.tenant = tenant
         super().__init__(*a, **kw)
 
     def __call__(self, e: Dict[str, Any]) -> None:
         e = dict(e)
         e.setdefault("job", self.job_id)
+        if self.tenant is not None:
+            e.setdefault("tenant", self.tenant)
         super().__call__(e)
 
 
@@ -83,11 +93,19 @@ class ServiceJob:
         os.makedirs(job_dir, exist_ok=True)
         self.log = _JobLog(job_id,
                            os.path.join(job_dir, "events.jsonl"),
-                           history_dir=history_dir, app=app)
+                           history_dir=history_dir, app=app,
+                           tenant=tenant)
         self.config = config.replace(
             forensics_dir=os.path.join(job_dir, "bundles"))
         self._done = threading.Event()
         self._lock = threading.Lock()
+        # live progress (the Dryad GM web UI's per-job view, multi-
+        # jobbed): the latest settled-stages fraction from the Run's
+        # ``progress`` events (in-process jobs) or the tasks-done
+        # fraction (cluster-fleet jobs); ``_waiters`` wakes long-poll/
+        # SSE followers of this job's event stream (service/http.py)
+        self._progress = 0.0
+        self._waiters = threading.Condition()
 
     # -- event routing -----------------------------------------------------
 
@@ -95,8 +113,45 @@ class ServiceJob:
         """The job's event sink: every record lands in the job's own
         log, which tags it with the job id at the sink (:class:`_JobLog`
         — no extra copy here).  Spans gate on the log's level via the
-        ``level`` attribute."""
+        ``level`` attribute.
+
+        Recorded events additionally drive the LIVE view: ``progress``
+        records refresh the per-job progress fraction + gauge and every
+        append wakes this job's stream followers.  Gated on the log
+        actually admitting the record, so a level-0 job keeps the whole
+        live path a no-op (zero events built, zero wakeups)."""
         self.log(e)
+        if not self.log.admits(e.get("event")):
+            return
+        if e.get("event") == "progress" and e.get("pct") is not None:
+            self._set_progress(float(e["pct"]))
+        self._notify()
+
+    def _set_progress(self, pct: float) -> None:
+        from dryad_tpu.obs.metrics import REGISTRY, family_gauge
+        self._progress = max(self._progress, min(100.0, pct))
+        family_gauge(REGISTRY, "job_progress",
+                     job=self.id).set(round(self._progress / 100.0, 4))
+
+    def _notify(self) -> None:
+        with self._waiters:
+            self._waiters.notify_all()
+
+    def events_since(self, after: int,
+                     timeout: Optional[float] = None
+                     ) -> "tuple[List[Dict[str, Any]], int]":
+        """``(events[after:], next_cursor)`` — the long-poll/SSE read
+        side.  With no fresh events and the job still live, blocks up
+        to ``timeout`` for the next append.  The in-memory event list
+        is append-only, so a snapshot slice is safe cross-thread."""
+        if (timeout and len(self.log.events) <= after
+                and self.state in ("queued", "running")):
+            with self._waiters:
+                if len(self.log.events) <= after \
+                        and self.state in ("queued", "running"):
+                    self._waiters.wait(timeout)
+        evs = list(self.log.events[after:])
+        return evs, after + len(evs)
 
     @property
     def level(self) -> int:
@@ -120,7 +175,15 @@ class ServiceJob:
             if self.results[idx] is None:
                 self.results[idx] = table
                 self.done_tasks += 1
-            return self.done_tasks >= self.n_tasks
+            done = self.done_tasks >= self.n_tasks
+        # cluster-fleet progress is task-grained (each task is a whole
+        # per-worker plan run); same gauge + wakeup as the in-process
+        # path's progress events.  Gated like its driving record
+        # (task_done, level 1) so a level-0 job stays a no-op.
+        if self.n_tasks and self.log.admits("task_done"):
+            self._set_progress(100.0 * self.done_tasks / self.n_tasks)
+            self._notify()
+        return done
 
     def finish(self, ok: bool, error: Optional[str] = None,
                emit_job_done: bool = True) -> None:
@@ -157,6 +220,7 @@ class ServiceJob:
             self._release_inputs()
         self.log.close()
         self._done.set()
+        self._notify()          # stream followers see the terminal state
 
     def _release_inputs(self) -> None:
         """Drop the job's input-sized state on terminal transition (the
@@ -182,6 +246,7 @@ class ServiceJob:
             self._release_inputs()
         self.log.close()
         self._done.set()
+        self._notify()
         return True
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -189,9 +254,19 @@ class ServiceJob:
 
     # -- introspection -----------------------------------------------------
 
+    @property
+    def progress_pct(self) -> float:
+        """Live progress fraction (0..100): settled stages (in-process,
+        from the Run's ``progress`` events) or finished tasks (cluster
+        fleet); a done job is always 100."""
+        if self.state == "done":
+            return 100.0
+        return round(self._progress, 1)
+
     def to_row(self, with_result: bool = False) -> Dict[str, Any]:
         row = {"job": self.id, "tenant": self.tenant, "app": self.app,
                "priority": self.priority, "state": self.state,
+               "progress_pct": self.progress_pct,
                "tasks_done": self.done_tasks, "tasks": self.n_tasks,
                "submitted_ts": round(self.submitted_ts, 3),
                "wall_s": (round(self.finished_ts - self.started_ts, 4)

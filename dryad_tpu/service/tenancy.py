@@ -84,13 +84,26 @@ class TenantQuota:
     ``max_concurrent_jobs`` caps RUNNING jobs — excess jobs queue, they
     are not rejected.  ``failure_budget`` caps cumulative task failures
     charged to the tenant (0 = unlimited); beyond it submissions are
-    refused (DTA912) until reset."""
+    refused (DTA912) until reset.
+
+    The ``slo_*`` fields declare the tenant's service-level objective
+    (dryad_tpu/obs/slo.py): ``slo_target`` is the required good-job
+    fraction over a rolling window of ``slo_window`` terminal jobs
+    (0 = no SLO declared, nothing tracked); ``slo_latency_s``
+    additionally requires good jobs to finish within that wall
+    (0 = success-only).  The daemon tracks rolling attainment and
+    error-budget burn rate, serves them at ``GET /slo``, folds them
+    into the dashboard tenant table, and emits ``slo_breach`` on the
+    transition past burn rate 1.0."""
 
     share: float = 1.0
     max_concurrent_jobs: int = 4
     max_queued_jobs: int = 16
     worker_slots: int = 0
     failure_budget: int = 0
+    slo_latency_s: float = 0.0
+    slo_target: float = 0.0
+    slo_window: int = 64
 
     def __post_init__(self):
         checks = [
@@ -99,6 +112,9 @@ class TenantQuota:
             (self.max_queued_jobs >= 1, "max_queued_jobs >= 1"),
             (self.worker_slots >= 0, "worker_slots >= 0"),
             (self.failure_budget >= 0, "failure_budget >= 0"),
+            (0.0 <= self.slo_target < 1.0, "0 <= slo_target < 1"),
+            (self.slo_latency_s >= 0, "slo_latency_s >= 0"),
+            (self.slo_window >= 1, "slo_window >= 1"),
         ]
         for ok, msg in checks:
             if not ok:
@@ -137,6 +153,16 @@ class ServiceConfig:
 
     def quota(self, tenant: str) -> TenantQuota:
         return self.tenants.get(tenant, self.default_quota)
+
+    def slo_objective(self, tenant: str):
+        """The tenant's declared SLO as an
+        :class:`~dryad_tpu.obs.slo.SloObjective` (inactive when the
+        quota declares none) — the daemon's SloTracker resolves
+        through this, so per-tenant quota overrides apply."""
+        from dryad_tpu.obs.slo import SloObjective
+        q = self.quota(tenant)
+        return SloObjective(q.slo_latency_s, q.slo_target,
+                            q.slo_window)
 
     @staticmethod
     def tenants_from_json(obj: Dict[str, dict]) -> Dict[str, TenantQuota]:

@@ -100,13 +100,18 @@ def _emit(ctx, event, text: str, catalog: Catalog,
 def explain(ctx, catalog: Catalog, text: str, origin: str = "<sql>",
             event=None) -> str:
     """EXPLAIN text for a query (with or without a leading EXPLAIN
-    [COST] keyword; COST — or ``EXPLAIN COST`` in the text — adds the
-    DTA2xx predicted-cost table and the static diagnostics)."""
+    [COST | ANALYZE] keyword).  COST adds the DTA2xx predicted-cost
+    table and the static diagnostics; ANALYZE **executes the query
+    once** under an event capture and appends the measured per-stage
+    actuals annotated against the cost model (obs/analyze.py — needs a
+    real in-process Context with loadable tables, like running the
+    query does)."""
     mode, bound = compile_query(catalog, text, origin=origin)
     ds, _ = lower(ctx, catalog, bound)
     _emit(ctx, event, text, catalog, bound)
     cost = mode == "explain_cost"
-    return ds.explain(verify=cost, cost=cost)
+    return ds.explain(verify=cost, cost=cost,
+                      analyze=mode == "explain_analyze")
 
 
 def offline_explain(catalog: Catalog, text: str, nparts: int = 8,

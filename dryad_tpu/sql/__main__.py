@@ -1,8 +1,10 @@
 """SQL CLI — ``python -m dryad_tpu.sql --catalog cat.json [...]``.
 
-* one-shot: ``-e "EXPLAIN [COST] SELECT ..."`` or ``-f query.sql``
-  prints the plan (EXPLAIN) or executes and prints rows (plain SELECT,
-  when the catalog's tables are loadable);
+* one-shot: ``-e "EXPLAIN [COST | ANALYZE] SELECT ..."`` or ``-f
+  query.sql`` prints the plan (EXPLAIN; ANALYZE executes once and
+  appends measured per-stage actuals vs the cost model) or executes
+  and prints rows (plain SELECT, when the catalog's tables are
+  loadable);
 * REPL (default): reads ``;``-terminated statements; ``\\d`` lists
   catalog tables, ``\\q`` quits.
 
@@ -74,10 +76,15 @@ class _Session:
             print(plan_query(ds.node, self.nparts, hosts=1,
                              config=sctx.config).explain())
             return 0
-        # cost needs real source statistics -> real Context
+        # cost needs real source statistics -> real Context; ANALYZE
+        # additionally EXECUTES the query once and annotates the
+        # executed stages with measured actuals (obs/analyze.py)
         ds, _ = lower(self.ctx(), self.catalog, bound)
         if mode == "explain_cost":
             print(ds.explain(verify=True, cost=True))
+            return 0
+        if mode == "explain_analyze":
+            print(ds.explain(analyze=True))
             return 0
         _print_table(ds.collect())
         return 0
@@ -132,8 +139,8 @@ def main(argv=None) -> int:
     ap.add_argument("--catalog", required=True,
                     help="serialized catalog JSON (sql.Catalog.save)")
     ap.add_argument("-e", "--execute", default=None, metavar="QUERY",
-                    help="one-shot statement (EXPLAIN [COST] ... or "
-                         "SELECT ...)")
+                    help="one-shot statement (EXPLAIN [COST | ANALYZE]"
+                         " ... or SELECT ...)")
     ap.add_argument("-f", "--file", default=None,
                     help="read the one-shot statement from a .sql file")
     ap.add_argument("--nparts", type=int, default=8,

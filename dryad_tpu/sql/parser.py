@@ -333,7 +333,10 @@ def parse(query: str, origin: str = "<sql>") -> N.Select:
 
 def parse_statement(query: str, origin: str = "<sql>"):
     """(mode, Select) where mode is "run" | "explain" | "explain_cost"
-    depending on a leading ``EXPLAIN [COST]``."""
+    | "explain_analyze" depending on a leading ``EXPLAIN [COST |
+    ANALYZE]``.  ANALYZE is deliberately NOT a reserved keyword (it
+    stays usable as a column/table name) — it only has meaning directly
+    after EXPLAIN."""
     toks = tokenize(query, origin)
     mode = "run"
     if toks and toks[0].kind == "kw" and toks[0].text == "EXPLAIN":
@@ -342,4 +345,8 @@ def parse_statement(query: str, origin: str = "<sql>"):
         if toks and toks[0].kind == "kw" and toks[0].text == "COST":
             toks = toks[1:]
             mode = "explain_cost"
+        elif (toks and toks[0].kind == "ident"
+                and toks[0].text.upper() == "ANALYZE"):
+            toks = toks[1:]
+            mode = "explain_analyze"
     return mode, _Parser(toks, origin).parse_select()

@@ -47,6 +47,11 @@ _LEVELS = {
     "job_submitted": 1, "job_started": 1, "job_cancelled": 1,
     "job_rejected": 1, "service_started": 1, "service_stopped": 1,
     "service_error": 0,
+    # live service observability (dryad_tpu/obs/{analyze,slo}.py,
+    # obs/history.py regression watch): an EXPLAIN ANALYZE annotation,
+    # an SLO error-budget breach, and a cross-run perf-regression
+    # suspicion are job-lifecycle-grade findings
+    "analyze_report": 1, "slo_breach": 1, "regression_suspect": 1,
     # SQL front end (dryad_tpu/sql): every lowering emits sql_query
     # (normalized query text + catalog fingerprint — history/forensics
     # bundles identify SQL jobs by it); sql_lowered carries the lowered
@@ -96,8 +101,15 @@ class EventLog:
         self.level = (level if level is not None
                       else int(os.environ.get("DRYAD_LOGGING_LEVEL", "2")))
 
+    def admits(self, kind: Optional[str]) -> bool:
+        """Would an event of ``kind`` pass this log's level filter?
+        Consumers that do per-event side work (the service's live
+        progress/SSE wakeups) gate on this so a level-0 log keeps the
+        whole path a no-op."""
+        return _LEVELS.get(kind, 0) <= self.level
+
     def __call__(self, event: Dict[str, Any]) -> None:
-        if _LEVELS.get(event.get("event"), 0) > self.level:
+        if not self.admits(event.get("event")):
             return
         e = dict(event)
         e.setdefault("ts", round(time.time(), 4))

@@ -317,6 +317,18 @@ def diagnose(events) -> List[Dict[str, Any]]:
                         "headline": f"stage {e.get('stage')} replayed "
                                     f"(attempt {e.get('attempt', '?')})",
                         "detail": "", "log_tails": ""})
+        elif k == "regression_suspect":
+            # archive-time regression watch (obs/history.py): this run
+            # measured past the app's history baseline
+            out.append({
+                "kind": "perf regression", "workers": None,
+                "headline": f"{e.get('what')} "
+                            f"{e.get('measured')} is "
+                            f"{e.get('ratio')}x the baseline median "
+                            f"{e.get('baseline_median')} over "
+                            f"{e.get('baseline_runs')} prior run(s) "
+                            f"of {e.get('app')}",
+                "detail": "", "log_tails": ""})
         elif k == "task_forensics":
             out.append({
                 "kind": "forensics bundle",
@@ -439,6 +451,57 @@ def _cost_html(events) -> str:
         body += ("<p class='ink2'>runtime cross-check: no "
                  "cost-model misses</p>")
     return "<h2>Predicted cost (static analysis)</h2>" + body
+
+
+def _analyze_html(events) -> str:
+    """"EXPLAIN ANALYZE" section (obs/analyze.py): measured per-stage
+    actuals against the static cost model's predictions, with the
+    runtime cross-check's verdicts inline.  Rendered when the stream
+    carries a ``cost_report`` (without one the per-stage table already
+    shows the plain actuals)."""
+    from dryad_tpu.obs.analyze import analyze_events
+    if not any(e.get("event") == "cost_report" for e in events):
+        return ""
+    rep = analyze_events(events)
+    if not rep.stages:
+        return ""
+    rows = []
+    for s in rep.stages:
+        if s.pred_rows is None:
+            pr = "—"
+        else:
+            lo, hi = s.pred_rows
+            pr = ("~" if s.approx else "") + (
+                f"[{lo}, {hi}]" if hi is not None else f"[{lo}, ∞)")
+        delta = ("—" if s.bytes_delta_pct is None
+                 else f"{s.bytes_delta_pct:+.1f}%")
+        dcls = ("warning" if s.bytes_in_bounds is False
+                or s.rows_in_bounds is False else "ink2")
+        flags = " ".join(
+            (["cache"] if s.runs and s.cache_hits == s.runs else [])
+            + list(s.rewrites)
+            + [f"&#9888; miss: {m}" for m in s.misses])
+        rows.append(
+            f"<tr><td>{s.stage}</td>"
+            f"<td>{html.escape(str(s.label))}</td><td>{s.runs}</td>"
+            f"<td>{s.rows}</td><td>{html.escape(pr)}</td>"
+            f"<td>{s.out_bytes / (1 << 20):.2f}</td>"
+            f'<td style="color: var(--{dcls})">{delta}</td>'
+            f"<td>{s.compile_s:.3f}</td><td>{s.wall_s:.3f}</td>"
+            f"<td>{s.spills}</td><td>{s.replays}</td>"
+            f"<td>{html.escape(flags)}</td></tr>")
+    inb = len([s for s in rep.settled if s.bytes_in_bounds])
+    cmp_n = len([s for s in rep.settled
+                 if s.bytes_in_bounds is not None])
+    verdict = (f"<p class='ink2'>predictions contained {inb}/{cmp_n} "
+               f"settled stage(s); {rep.misses} cost-model miss(es); "
+               f"{rep.rewrites} adaptive rewrite(s)</p>")
+    head = ("<tr><th>stage</th><th>label</th><th>runs</th>"
+            "<th>rows</th><th>pred rows</th><th>out&nbsp;MiB</th>"
+            "<th>Δbytes</th><th>compile&nbsp;s</th><th>wall&nbsp;s</th>"
+            "<th>spills</th><th>replays</th><th>flags</th></tr>")
+    return ("<h2>EXPLAIN ANALYZE (measured vs predicted)</h2>"
+            + verdict + f"<table>{head}{''.join(rows)}</table>")
 
 
 def _critical_path_html(events) -> str:
@@ -630,6 +693,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
 {_diagnosis_html(events)}
 {_lint_html(events)}
 {_cost_html(events)}
+{_analyze_html(events)}
 {_adaptive_html(events)}
 {_critical_path_html(events)}
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}

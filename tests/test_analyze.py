@@ -1,0 +1,301 @@
+"""EXPLAIN ANALYZE tests (dryad_tpu/obs/analyze.py + surfaces).
+
+Covers: the event-walk unit semantics (retries/replays/spills/rewrites/
+miss attachment, prediction pairing), payload round-trip, the ORACLE
+SWEEP over the five bench apps (every settled stage annotated; the
+static predictions contain the measured actuals; totals exactly equal
+the event-derived metrics), Dataset.explain(analyze=True) / .analyze(),
+the SQL front end's ``EXPLAIN ANALYZE`` statement, the obs CLI
+``analyze`` subcommand + the ``--job`` filter satellite on the event
+tools, the viewer's ANALYZE section, and the ``bench.py
+--smoke-analyze`` wiring."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from dryad_tpu.api.dataset import Context
+from dryad_tpu.obs import trace
+from dryad_tpu.obs.analyze import AnalyzeReport, analyze_events
+from dryad_tpu.obs.metrics import metrics_from_events
+from dryad_tpu.utils.config import JobConfig
+from dryad_tpu.utils.events import EventLog
+
+from test_cost import APPS  # noqa: E402  (the five bench apps)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _detach_tracer():
+    yield
+    trace.install(None)
+
+
+# -- unit: the event walk ----------------------------------------------------
+
+
+def _ev_stage_done(stage, rows, out_bytes, wall=0.1, compile_s=0.2,
+                   scale=1, overflow=False, **kw):
+    return dict({"event": "stage_done", "stage": stage,
+                 "label": f"s{stage}", "rows": rows,
+                 "out_bytes": out_bytes, "wall_s": wall,
+                 "compile_s": compile_s, "scale": scale,
+                 "overflow": overflow}, **kw)
+
+
+def _pred(stage, rows, out_bytes, approx=False):
+    return {"stage": stage, "label": f"s{stage}", "rows": list(rows),
+            "capacity": 0, "out_bytes": list(out_bytes),
+            "work_bytes": [0, None], "approx": approx, "notes": []}
+
+
+def test_analyze_events_pairs_predictions_and_actuals():
+    events = [
+        {"event": "cost_report",
+         "report": {"stages": [_pred(0, (0, 100), (64, 64)),
+                               _pred(1, (5, None), (10, 20))]}},
+        _ev_stage_done(0, rows=[3, 4], out_bytes=64),
+        _ev_stage_done(1, rows=[9], out_bytes=30),   # outside [10, 20]
+        {"event": "stage_replay", "stage": 0},
+        {"event": "stage_spilled", "stage": 1},
+        {"event": "cost_model_miss", "stage": 1, "what": "out_bytes"},
+        {"event": "graph_rewrite", "stage": 1, "kind": "shrink"},
+        {"event": "job_done", "wall_s": 1.5},
+    ]
+    rep = analyze_events(events)
+    s0, s1 = rep.stage(0), rep.stage(1)
+    assert s0.rows == 7 and s0.out_bytes == 64 and s0.settled
+    assert s0.pred_bytes == (64, 64) and s0.bytes_in_bounds
+    assert s0.bytes_delta_pct == 0.0
+    assert s0.replays == 1
+    assert s1.spills == 1 and s1.bytes_in_bounds is False
+    assert s1.pred_rows == (5, None) and s1.rows_in_bounds
+    assert s1.misses == ("out_bytes",) and s1.rewrites == ("shrink",)
+    assert rep.misses == 1 and rep.rewrites == 1
+    assert rep.wall_s == 1.5 and rep.predicted
+
+
+def test_analyze_overflow_run_is_not_compared():
+    events = [
+        {"event": "cost_report",
+         "report": {"stages": [_pred(0, (0, 10), (8, 8))]}},
+        _ev_stage_done(0, rows=[50], out_bytes=999, overflow=True),
+        _ev_stage_done(0, rows=[50], out_bytes=400, scale=2),
+    ]
+    rep = analyze_events(events)
+    s = rep.stage(0)
+    # the overflow attempt counts as a retry; the settled run at scale
+    # 2 records actuals but validates nothing (planned-shape contract)
+    assert s.retries == 1 and s.runs == 2 and s.settled
+    assert s.rows == 50 and s.out_bytes == 400
+    assert s.bytes_in_bounds is None and s.pred_bytes is None
+
+
+def test_analyze_job_filter_and_payload_roundtrip():
+    events = [_ev_stage_done(0, rows=[1], out_bytes=4, job="a"),
+              _ev_stage_done(0, rows=[9], out_bytes=8, job="b")]
+    rep = analyze_events(events, job="a")
+    assert [s.rows for s in rep.stages] == [1]
+    back = AnalyzeReport.from_payload(rep.to_payload())
+    assert back.to_payload() == rep.to_payload()
+    assert back.stage(0).rows == 1
+    assert "s0" in rep.render()
+
+
+# -- the oracle sweep: all five bench apps -----------------------------------
+
+
+@pytest.mark.parametrize("app", sorted(APPS))
+def test_analyze_oracle_sweep(app):
+    """EXPLAIN ANALYZE over the five bench apps: every settled stage is
+    annotated, the static predictions CONTAIN the measured actuals
+    (rows + bytes, zero cost-model misses), and the report's totals
+    exactly equal the event-derived metrics of the same capture."""
+    ctx = Context(config=JobConfig())
+    rep = APPS[app](ctx).analyze()
+    assert rep.predicted, f"{app}: no cost report in the capture"
+    assert rep.misses == 0, f"{app}: cost model missed"
+    # every stage_done in the capture has an annotated entry
+    done_ids = {e["stage"] for e in rep._events
+                if e.get("event") == "stage_done"}
+    assert done_ids, f"{app}: no stages executed"
+    for sid in done_ids:
+        s = rep.stage(sid)
+        assert s is not None and s.runs >= 1
+    settled = rep.settled
+    assert settled, f"{app}: nothing settled"
+    compared = [s for s in settled if s.bytes_in_bounds is not None]
+    assert compared, f"{app}: no stage carried a prediction comparison"
+    for s in compared:
+        assert s.bytes_in_bounds, \
+            f"{app} stage {s.stage}: measured {s.out_bytes} outside " \
+            f"predicted {s.pred_bytes}"
+        assert s.rows_in_bounds, \
+            f"{app} stage {s.stage}: rows {s.rows} outside " \
+            f"{s.pred_rows}"
+    # totals are bit-identical with the derived metrics (same event
+    # order, same truthiness gates)
+    d = metrics_from_events(rep._events).snapshot()
+    assert rep.stage_runs == d.get("dryad_stage_runs_total", 0)
+    assert round(rep.run_s, 6) == d.get("dryad_run_seconds_total", 0.0)
+    assert round(rep.compile_s, 6) == d.get(
+        "dryad_compile_seconds_total", 0.0)
+    assert rep.out_bytes_total == d.get("dryad_shuffle_bytes_total", 0)
+
+
+# -- surfaces ----------------------------------------------------------------
+
+
+def test_explain_analyze_text_and_report_event():
+    log = EventLog(level=2)
+    ctx = Context(event_log=log)
+    ds = ctx.from_columns(
+        {"k": np.arange(64, dtype=np.int32) % 8,
+         "v": np.ones(64, np.float32)}).group_by(
+             ["k"], {"s": ("sum", "v")})
+    text = ds.explain(analyze=True)
+    assert "EXPLAIN ANALYZE (executed)" in text
+    assert "cost-model miss(es)" in text
+    # the machine-readable report landed in the context's own stream
+    recs = log.of_type("analyze_report")
+    assert len(recs) == 1
+    rep = AnalyzeReport.from_payload(recs[0]["report"])
+    assert rep.settled and rep.misses == 0
+
+
+def test_analyze_rejects_local_debug():
+    ctx = Context(local_debug=True)
+    ds = ctx.from_columns({"v": np.arange(4, dtype=np.int32)})
+    with pytest.raises(ValueError, match="in-process mesh"):
+        ds.analyze()
+
+
+def test_analyze_respects_pre_submit_lint_gate(monkeypatch):
+    """ANALYZE executes, so it must pass the same gate as collect(): a
+    plan lint="error" refuses to submit (DTA201 >HBM) raises LintError
+    out of analyze() with ZERO executor work — it is not a side door
+    around the pre-submit rejection."""
+    from dryad_tpu.analysis import LintError
+    from dryad_tpu.exec.executor import Executor
+    runs = []
+    orig = Executor.run
+
+    def counting(self, *a, **k):
+        runs.append(1)
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(Executor, "run", counting)
+    ctx = Context(config=JobConfig(lint="error",
+                                   device_hbm_bytes=1 << 20))
+    big = (ctx.from_columns({"x": np.zeros(8, np.float32)})
+              .with_capacity(1 << 22))
+    with pytest.raises(LintError) as ei:
+        big.order_by([("x", True)]).analyze()
+    assert ei.value.report.by_code("DTA201")
+    assert runs == [], "executor ran despite the pre-submit rejection"
+
+
+def test_sql_explain_analyze():
+    from dryad_tpu import sql
+    cat = sql.Catalog()
+    cat.register_columns(
+        "t", {"k": (np.arange(100, dtype=np.int32) % 10),
+              "v": np.arange(100, dtype=np.float32)})
+    ctx = Context()
+    out = sql.explain(ctx, cat,
+                      "EXPLAIN ANALYZE SELECT k, SUM(v) AS s FROM t "
+                      "GROUP BY k")
+    assert "EXPLAIN ANALYZE (executed)" in out
+    # plain EXPLAIN still never executes; ANALYZE stays unreserved as
+    # an identifier elsewhere
+    mode, _ = sql.parse_statement("EXPLAIN SELECT k FROM t")
+    assert mode == "explain"
+    mode, stmt = sql.parse_statement("SELECT analyze FROM t")
+    assert mode == "run"
+
+
+def test_viewer_analyze_section():
+    from dryad_tpu.utils.viewer import job_report_html
+    log = EventLog(level=2)
+    ctx = Context(event_log=log, config=JobConfig(lint="warn"))
+    ctx.from_columns(
+        {"k": np.arange(32, dtype=np.int32) % 4,
+         "v": np.ones(32, np.float32)}).group_by(
+             ["k"], {"s": ("sum", "v")}).collect()
+    assert any(e["event"] == "cost_report" for e in log.events)
+    html = job_report_html(log.events)
+    assert "EXPLAIN ANALYZE (measured vs predicted)" in html
+    # without a cost report the section stays absent (the plain stage
+    # table already shows actuals)
+    bare = [e for e in log.events if e["event"] != "cost_report"]
+    assert "EXPLAIN ANALYZE" not in job_report_html(bare)
+
+
+# -- satellite: the obs CLI analyze subcommand + --job filter ----------------
+
+
+def _write_two_job_jsonl(path):
+    with open(path, "w") as f:
+        for job in ("j-a", "j-b"):
+            f.write(json.dumps(
+                {"event": "span", "name": f"run {job}", "kind": "job",
+                 "trace": job, "span": f"{job}-1", "t0": 1.0,
+                 "dur_s": 0.2, "job": job}) + "\n")
+            f.write(json.dumps(_ev_stage_done(
+                0, rows=[5], out_bytes=40, job=job,
+                **{"ts": 1.1})) + "\n")
+    return path
+
+
+def test_obs_cli_analyze_and_job_filter(tmp_path, capsys):
+    from dryad_tpu.obs.__main__ import main as obs_main
+    p = _write_two_job_jsonl(str(tmp_path / "multi.jsonl"))
+    assert obs_main(["analyze", p, "--job", "j-a", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["stage_runs"] == 1      # one job's records only
+    assert obs_main(["metrics", p, "--job", "j-a"]) == 0
+    out = capsys.readouterr().out
+    assert "dryad_stage_runs_total 1" in out
+    assert obs_main(["critical-path", p, "--job", "j-a",
+                     "--json"]) == 0
+    res = json.loads(capsys.readouterr().out)
+    assert all("j-b" not in str(s.get("name", ""))
+               for s in res["segments"])
+    trace_out = str(tmp_path / "t.json")
+    assert obs_main(["trace", p, "--job", "j-b", "-o",
+                     trace_out]) == 0
+    capsys.readouterr()
+    tr = json.load(open(trace_out))
+    names = {e.get("name", "") for e in tr["traceEvents"]}
+    assert any("j-b" in n for n in names)
+    assert not any("run j-a" in n for n in names)
+    # a job id matching nothing is malformed input (exit 2)
+    assert obs_main(["analyze", p, "--job", "nope"]) == 2
+
+
+# -- satellite: bench --smoke-analyze runs as a fast pytest ------------------
+
+
+def test_bench_smoke_analyze(tmp_path):
+    sys.path.insert(0, _REPO)
+    import bench
+    os.environ["BENCH_TREND_PATH"] = str(tmp_path / "trend.jsonl")
+    try:
+        out = bench.smoke_analyze(
+            out_path=str(tmp_path / "BENCH_analyze.json"),
+            n_lines=2000, reps=3, quiet=True)
+    finally:
+        os.environ.pop("BENCH_TREND_PATH", None)
+    assert out["actuals_match_metrics"] is True
+    assert out["predictions_contained"] is True
+    assert out["cost_model_misses"] == 0
+    assert out["stages_settled"] >= 1
+    assert out["wall_s_plain"] > 0 and out["wall_s_analyze"] > 0
+    data = json.loads((tmp_path / "BENCH_analyze.json").read_text())
+    assert data["metric"].startswith("analyze smoke")
+    trend = (tmp_path / "trend.jsonl").read_text().strip().splitlines()
+    assert json.loads(trend[-1])["app"] == "bench-analyze"

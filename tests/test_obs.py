@@ -64,6 +64,13 @@ def test_every_emitted_event_kind_is_registered():
     # is chatter
     assert _LEVELS["sql_query"] == 1
     assert _LEVELS["sql_lowered"] == 2
+    # live service observability (obs/analyze.py, obs/slo.py,
+    # obs/history.py regression watch): all job-lifecycle-grade
+    # findings, never chatter — an SLO breach or a regression suspect
+    # must survive level 1
+    assert _LEVELS["analyze_report"] == 1
+    assert _LEVELS["slo_breach"] == 1
+    assert _LEVELS["regression_suspect"] == 1
 
 
 # -- satellite: EventLog lifecycle -------------------------------------------

@@ -1,0 +1,375 @@
+"""Live service observability tests: per-tenant SLOs (obs/slo.py +
+service wiring), the archive-time regression watch (obs/history.py),
+live per-job progress, and the long-poll/SSE event-stream endpoints —
+including the two-concurrent-jobs zero-interleave regression that
+extends the PR 8 isolation guard, and the level-0 no-op contract over
+the new live paths."""
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from dryad_tpu.obs import trace
+from dryad_tpu.obs.slo import (SloObjective, SloTracker, burn_rate,
+                               slo_from_events)
+
+
+@pytest.fixture(autouse=True)
+def _detach_tracer():
+    yield
+    trace.install(None)
+
+
+# -- SLO math ----------------------------------------------------------------
+
+
+def test_slo_objective_good_and_validation():
+    obj = SloObjective(latency_s=2.0, target=0.9)
+    assert obj.active
+    assert obj.good(True, 1.5)
+    assert not obj.good(True, 2.5)         # too slow
+    assert not obj.good(False, 0.1)        # failed
+    assert not obj.good(True, None)        # no wall recorded => not good
+    assert SloObjective(target=0.9).good(True, None)   # success-only SLO
+    assert not SloObjective().active
+    with pytest.raises(ValueError):
+        SloObjective(target=1.0)
+    with pytest.raises(ValueError):
+        SloObjective(target=-0.1)
+    with pytest.raises(ValueError):
+        SloObjective(target=0.5, window=0)
+    with pytest.raises(ValueError):
+        SloObjective(target=0.5, latency_s=-1)
+
+
+def test_burn_rate_math():
+    # 99% target => 1% budget; 2% bad => burning 2x budget
+    assert burn_rate(0.98, 0.99) == pytest.approx(2.0)
+    assert burn_rate(0.99, 0.99) == pytest.approx(1.0)
+    assert burn_rate(1.0, 0.99) == 0.0
+    assert burn_rate(0.5, 0.5) == pytest.approx(1.0)
+
+
+def test_tracker_rolling_window_and_rows():
+    obj = SloObjective(target=0.5, window=4)
+    tr = SloTracker(lambda t: obj)
+    for ok in (True, True, False, False):
+        tr.record("acme", ok, 0.1)
+    row = tr.row("acme")
+    assert row["jobs"] == 4 and row["good"] == 2
+    assert row["attainment"] == 0.5
+    assert row["burn_rate"] == pytest.approx(1.0)
+    assert row["breaching"] is False
+    # one more failure rolls the oldest GOOD job out of the window:
+    # 1 good / 4 => burn 1.5 => breaching
+    tr.record("acme", False, 0.1)
+    row = tr.record("acme", False, 0.1) or tr.row("acme")
+    assert row["jobs"] == 4 and row["good"] <= 1
+    assert row["breaching"] is True
+    assert "acme" in tr.snapshot()
+
+
+def test_tracker_inactive_tenant_records_nothing():
+    tr = SloTracker(lambda t: SloObjective())
+    assert tr.record("free", True, 0.1) is None
+    assert tr.row("free") is None
+    assert tr.snapshot() == {}
+
+
+def test_slo_from_events():
+    obj = SloObjective(latency_s=1.0, target=0.5, window=8)
+    events = [
+        {"event": "job_done", "tenant": "a", "wall_s": 0.5},
+        {"event": "job_done", "tenant": "a", "wall_s": 5.0},  # too slow
+        {"event": "job_failed", "tenant": "a"},
+        {"event": "job_cancelled", "tenant": "a"},            # ignored
+        {"event": "job_done", "wall_s": 0.1},                 # untagged
+    ]
+    tr = slo_from_events(events, lambda t: obj)
+    row = tr.row("a")
+    assert row["jobs"] == 3 and row["good"] == 1
+    assert row["breaching"] is True
+
+
+def test_job_log_tenant_stamp_keeps_event_derived_slo_honest():
+    """A service job's sink stamps the tenant on EVERY record, because
+    the Run-emitted ``job_done`` of an in-process query job carries no
+    tenant of its own — without the stamp, slo_from_events over an
+    archive would count the tenant's failures (service-emitted,
+    tenant-tagged) while dropping its successes."""
+    from dryad_tpu.service.job import _JobLog
+    log = _JobLog("j-1", tenant="acme")
+    log({"event": "job_done", "wall_s": 0.5})      # as the Run emits it
+    log({"event": "job_failed", "tenant": "other",  # explicit wins
+         "error": "x"})
+    assert log.events[0]["tenant"] == "acme"
+    assert log.events[0]["job"] == "j-1"
+    assert log.events[1]["tenant"] == "other"
+    obj = SloObjective(latency_s=1.0, target=0.5, window=8)
+    row = slo_from_events(log.events, lambda t: obj).row("acme")
+    assert row["jobs"] == 1 and row["good"] == 1
+
+
+# -- regression watch (obs/history.py) ---------------------------------------
+
+
+def _run_events(wall, ts, spills=0):
+    ev = [{"event": "stage_done", "stage": 0, "label": "x",
+           "wall_s": wall / 2, "compile_s": 0.0, "ts": ts,
+           "rows": [1], "scale": 1}]
+    ev += [{"event": "stage_spilled", "stage": 0, "ts": ts}] * spills
+    ev.append({"event": "job_done", "wall_s": wall, "ts": ts + wall})
+    return ev
+
+
+def test_regression_watch_triggers_on_2x_slowdown(tmp_path):
+    from dryad_tpu.obs.history import (archive_job, history_index,
+                                       index_html,
+                                       render_history_text)
+    from dryad_tpu.utils.viewer import diagnose
+    hist = str(tmp_path)
+    t0 = time.time()
+    # first run: no baseline, no finding
+    first = archive_job(hist, _run_events(1.0, t0), app="myapp")
+    assert json.load(open(os.path.join(
+        first, "summary.json")))["regressions"] == []
+    for i, w in enumerate((1.1, 0.9)):
+        archive_job(hist, _run_events(w, t0 + 1 + i), app="myapp")
+    slow = archive_job(hist, _run_events(2.0, t0 + 10), app="myapp")
+    summary = json.load(open(os.path.join(slow, "summary.json")))
+    assert "wall_s" in summary["regressions"]
+    # the finding is IN the archived stream and diagnose() surfaces it
+    evs = [json.loads(line)
+           for line in open(os.path.join(slow, "events.jsonl"))]
+    regs = [e for e in evs if e["event"] == "regression_suspect"]
+    assert regs and regs[0]["ratio"] == pytest.approx(2.0)
+    assert any(r["kind"] == "perf regression" for r in diagnose(evs))
+    # ... and the history index highlights it, text + HTML
+    idx = history_index(hist)
+    assert any(s.get("regressions") for s in idx)
+    assert "regression suspect" in render_history_text(idx)
+    assert "regression suspect" in index_html(idx)
+
+
+def test_regression_watch_spills_and_failed_runs(tmp_path):
+    from dryad_tpu.obs.history import archive_job, regression_findings
+    hist = str(tmp_path)
+    t0 = time.time()
+    for i in range(2):
+        archive_job(hist, _run_events(1.0, t0 + i), app="sp")
+    # spills appearing where the baseline had none => suspect
+    d = archive_job(hist, _run_events(1.0, t0 + 5, spills=2), app="sp")
+    s = json.load(open(os.path.join(d, "summary.json")))
+    assert s["spills"] == 2 and "spills" in s["regressions"]
+    # a FAILED run is never a perf-regression suspect
+    failed = _run_events(5.0, t0 + 6) + [
+        {"event": "job_failed", "error": "boom", "ts": t0 + 7}]
+    d2 = archive_job(hist, failed, app="sp")
+    s2 = json.load(open(os.path.join(d2, "summary.json")))
+    assert s2["status"] == "failed" and s2["regressions"] == []
+    # anonymous apps have no baseline identity
+    assert regression_findings(hist, {"app": "job", "status": "ok",
+                                      "wall_s": 99.0}) == []
+
+
+# -- service wiring: SLOs, progress, event streaming -------------------------
+
+
+def _make_service(tmp_dir, tenants=None, slots=2):
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+    cfg = ServiceConfig(service_dir=tmp_dir, slots=slots,
+                        tenants=tenants or {})
+    return JobService(cfg)
+
+
+def _serve(svc):
+    from dryad_tpu.service.http import Client, serve
+    srv, port = serve(svc)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, Client(f"http://127.0.0.1:{port}")
+
+
+def test_service_slo_endpoint_breach_and_dashboard():
+    from dryad_tpu.service.tenancy import TenantQuota
+    d = tempfile.mkdtemp(prefix="slo-svc-")
+    quota = TenantQuota(slo_target=0.5, slo_latency_s=60.0,
+                        slo_window=8)
+    svc = _make_service(d, tenants={"acme": quota})
+    srv, cl = _serve(svc)
+    try:
+        def ok_job(env):
+            return {"ok": True}
+
+        def bad_job(env):
+            raise RuntimeError("boom")
+
+        j = svc.submit_callable(ok_job, tenant="acme")
+        svc.wait(j, timeout=60)
+        snap = cl.slo()
+        assert snap["acme"]["attainment"] == 1.0
+        assert snap["acme"]["breaching"] is False
+        for _ in range(2):
+            j = svc.submit_callable(bad_job, tenant="acme")
+            svc.wait(j, timeout=60)
+        snap = cl.slo()
+        row = snap["acme"]
+        assert row["jobs"] == 3 and row["good"] == 1
+        assert row["burn_rate"] > 1.0 and row["breaching"] is True
+        # exactly ONE slo_breach on the transition, in the service log
+        breaches = [e for e in svc.log.events
+                    if e["event"] == "slo_breach"]
+        assert len(breaches) == 1
+        assert breaches[0]["tenant"] == "acme"
+        # live gauges + dashboard columns
+        mt = cl.metrics()
+        assert 'dryad_slo_burn_rate{tenant="acme"}' in mt
+        assert 'dryad_slo_attainment_ratio{tenant="acme"}' in mt
+        html = svc.dashboard_html()
+        assert "burn" in html and "attainment" in html
+        # a tenant with no declared SLO reports nothing
+        j = svc.submit_callable(ok_job, tenant="other")
+        svc.wait(j, timeout=60)
+        assert "other" not in cl.slo()
+    finally:
+        svc.close()
+        srv.shutdown()
+
+
+def test_events_streaming_two_concurrent_jobs_no_interleave():
+    """The live-stream extension of the PR 8 isolation regression: two
+    jobs running CONCURRENTLY on the shared fleet, each followed over
+    SSE while running and over long-poll after — every frame of a job's
+    stream is tagged with exactly that job's id, start to
+    job_archived."""
+    d = tempfile.mkdtemp(prefix="sse-svc-")
+    svc = _make_service(d, slots=2)
+    srv, cl = _serve(svc)
+    try:
+        both_running = threading.Barrier(2, timeout=30)
+        release = threading.Event()
+
+        def work(env):
+            env.event({"event": "progress", "pct": 25.0, "done": 1,
+                       "total": 4})
+            both_running.wait()          # prove true concurrency
+            release.wait(30)
+            env.event({"event": "progress", "pct": 100.0, "done": 4,
+                       "total": 4})
+            return {"ok": True}
+
+        ja = svc.submit_callable(work, tenant="ta")
+        jb = svc.submit_callable(work, tenant="tb")
+        streams = {ja: [], jb: []}
+
+        def follow(jid):
+            for e in cl.stream_events(jid):
+                streams[jid].append(e)
+
+        threads = [threading.Thread(target=follow, args=(j,),
+                                    daemon=True) for j in (ja, jb)]
+        for t in threads:
+            t.start()
+        time.sleep(0.6)                  # streams attach mid-run
+        release.set()
+        assert svc.wait(ja, timeout=60)["state"] == "done"
+        assert svc.wait(jb, timeout=60)["state"] == "done"
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "SSE stream never terminated"
+        for jid in (ja, jb):
+            evs = streams[jid]
+            kinds = [e["event"] for e in evs]
+            assert "job_submitted" in kinds and "job_done" in kinds
+            assert "job_archived" in kinds     # drained to the close
+            assert kinds.count("progress") == 2
+            # ZERO cross-job leakage: every frame tagged with THIS job
+            assert all(e.get("job") == jid for e in evs), evs
+        # long-poll: cursor semantics + immediate return when terminal
+        first = cl.events(ja, after=0, timeout_s=1)
+        assert first["state"] == "done"
+        assert [e["event"] for e in first["events"]] == \
+            [e["event"] for e in streams[ja]]
+        again = cl.events(ja, after=first["next"], timeout_s=0)
+        assert again["events"] == [] and again["next"] == first["next"]
+        assert cl.events(ja, after=0)["progress_pct"] == 100.0
+        # unknown job is a plain 404 — on BOTH read sides (the SSE
+        # client translates HTTPError like _req, not a raw traceback)
+        with pytest.raises(RuntimeError):
+            cl.events("nope-1")
+        with pytest.raises(RuntimeError, match="unknown job"):
+            next(iter(cl.stream_events("nope-2")))
+    finally:
+        svc.close()
+        srv.shutdown()
+
+
+def test_progress_fraction_live_gauge_and_dashboard():
+    d = tempfile.mkdtemp(prefix="prog-svc-")
+    svc = _make_service(d, slots=1)
+    try:
+        seen = threading.Event()
+        release = threading.Event()
+
+        def work(env):
+            env.event({"event": "progress", "pct": 50.0, "done": 1,
+                       "total": 2, "stage": 0})
+            seen.set()
+            release.wait(30)
+            return {"ok": True}
+
+        jid = svc.submit_callable(work)
+        assert seen.wait(30)
+        row = svc.status(jid)
+        assert row["state"] == "running"
+        assert row["progress_pct"] == 50.0
+        # live gauge, per-job labeled
+        assert f'dryad_job_progress_ratio{{job="{jid}"}} 0.5' \
+            in svc.metrics_text()
+        # dashboard renders the bar mid-run
+        html = svc.dashboard_html()
+        assert "progress" in html and "50%" in html
+        release.set()
+        assert svc.wait(jid, timeout=60)["state"] == "done"
+        assert svc.status(jid)["progress_pct"] == 100.0
+    finally:
+        svc.close()
+
+
+def test_level0_live_paths_are_noop(monkeypatch):
+    """The no-op contract extended to the service layer: at
+    DRYAD_LOGGING_LEVEL=0 a job's log records nothing below level 0,
+    the progress machinery never engages (no gauge, no fraction), and
+    real work still completes."""
+    monkeypatch.setenv("DRYAD_LOGGING_LEVEL", "0")
+    from dryad_tpu.obs.metrics import REGISTRY
+    d = tempfile.mkdtemp(prefix="lvl0-svc-")
+    svc = _make_service(d, slots=1)
+    try:
+        def work(env):
+            env.event({"event": "progress", "pct": 50.0, "done": 1,
+                       "total": 2})
+            env.event({"event": "span", "name": "x"})
+            return {"ok": True}
+
+        # job ids restart per service instance, and the registry is
+        # process-global: compare the progress-series SET before/after
+        # (an absolute check could trip on an earlier test's series)
+        before = {k for k in REGISTRY.snapshot()
+                  if k.startswith("dryad_job_progress_ratio")}
+        jid = svc.submit_callable(work)
+        row = svc.wait(jid, timeout=60)
+        assert row["state"] == "done"
+        job = svc.job(jid)
+        # zero events built: nothing below level 0 was recorded
+        assert job.log.events == []
+        # the progress path never engaged: no NEW gauge series
+        after = {k for k in REGISTRY.snapshot()
+                 if k.startswith("dryad_job_progress_ratio")}
+        assert after == before
+    finally:
+        svc.close()
