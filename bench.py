@@ -547,6 +547,194 @@ def smoke_analyze(out_path="BENCH_analyze.json", n_lines=None,
     return out
 
 
+def smoke_ooc(out_path="BENCH_ooc.json", n_edges=None, reps=None,
+              quiet=False):
+    """Out-of-core re-streaming smoke (``python bench.py --smoke-ooc``,
+    also rides ``--smoke``): ONE streamed PageRank superstep over an
+    hdfs:// store served by the in-process fake WebHDFS with a
+    simulated per-request RTT + response-bandwidth cap — a loopback
+    that behaves like a REMOTE namenode/datanode — measured
+    INTERLEAVED >= 3 reps each in two configs:
+
+    * **cold** — the pre-PR out-of-core posture and the committed A/B
+      lever (``ooc_restream_cache=False``, ``ooc_prefetch_depth=0``,
+      no ``cache()``): every superstep re-streams the edges from
+      remote and recomputes the loop-invariant per-edge weight table
+      (edges ⋈ out-degree) before the rank join.
+    * **warm** — the ISSUE-14 tier (``cache()`` on the invariant
+      weight table — the DryadLINQ materialized-intermediate pattern
+      ``pagerank_stream`` hoists — with default prefetch): the warmup
+      pass pays one cold write, every timed pass re-streams the local
+      fingerprinted chunk cache with the prefetcher overlapping host
+      IO and device compute.
+
+    Correctness gate, not just timing: both configs must produce
+    IDENTICAL rows (bit-equal node ids and float32 ranks after a host
+    sort by node — same chunk boundaries, same reduction order), the
+    warm run must show exactly one ``ooc_cache_write`` and >= one
+    ``ooc_cache_hit`` per timed pass, and the speedup is asserted
+    positive here / >= 30% by the committed-number regression guard.
+    Written to ``BENCH_ooc.json`` + appended to ``BENCH_trend.jsonl``
+    (app ``bench-ooc``)."""
+    import statistics
+
+    from dryad_tpu import Context
+    from dryad_tpu.apps import pagerank
+    from dryad_tpu.utils.config import JobConfig
+    from dryad_tpu.utils.events import EventLog
+
+    # the fake namenode/datanode lives with the tests on purpose — it is
+    # a protocol double, not product code
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from webhdfs_fake import FakeWebHdfs
+
+    n_nodes = int(os.environ.get("BENCH_OOC_NODES", "2000"))
+    n_edges = n_edges or int(os.environ.get("BENCH_OOC_EDGES", "300000"))
+    reps = max(3, reps or int(os.environ.get("BENCH_OOC_REPS", "3")))
+    latency_s = float(os.environ.get("BENCH_OOC_LATENCY_S", "0.002"))
+    # a busy shared / cross-region link, not RAM-to-loopback
+    bandwidth_bps = float(os.environ.get("BENCH_OOC_BANDWIDTH_BPS",
+                                         str(8 << 20)))
+    chunk_rows = 1 << 13
+
+    edges = pagerank.gen_graph(n_nodes, n_edges, seed=0)
+    srv = FakeWebHdfs()
+    url = srv.url + "/graphs/edges"
+    Context().from_columns(edges).to_store(url)
+    # upload free; every READ pays RTT + transfer at the capped rate
+    srv.latency_s = latency_s
+    srv.throttle_bps = bandwidth_bps
+
+    damping = 0.85
+
+    def inv_weight(c):
+        return {"src": c["src"], "dst": c["dst"], "w": 1.0 / c["deg"]}
+
+    def contrib(c):
+        return {"node": c["dst"], "c": c["rank"] * c["w"]}
+
+    def damp(c):
+        return {"node": c["node"],
+                "rank": (1.0 - damping) / n_nodes + damping * c["s"]}
+
+    def build_step(ctx, cached):
+        """One pagerank_stream body evaluation as a collectable query:
+        the loop-invariant per-edge weight table (edges ⋈ out-degree,
+        the part ``cache()`` hoists out of iteration 2..N) feeding the
+        per-superstep rank join + contribution group-sum."""
+        e = ctx.read_store_stream(url, chunk_rows=chunk_rows)
+        links = (e.join(e.group_by(["src"], {"deg": ("count", None)}),
+                        ["src"], ["src"], expansion=2.0)
+                 .select(inv_weight))
+        if cached:
+            links = links.cache()
+        ranks = ctx.from_columns(
+            {"node": np.arange(n_nodes, dtype=np.int32),
+             "rank": np.full(n_nodes, 1.0 / n_nodes, np.float32)})
+        # exactly one rank row matches each link row: capacity 1.0
+        return (links.join(ranks, ["src"], ["node"], expansion=1.0)
+                .select(contrib)
+                .group_by(["node"], {"s": ("sum", "c")})
+                .select(damp))
+
+    import shutil
+    cache_dir = tempfile.mkdtemp(prefix="bench-ooc-cache-")
+    try:
+        cold_ctx = Context(config=JobConfig(
+            ooc_chunk_rows=chunk_rows, ooc_restream_cache=False,
+            ooc_prefetch_depth=0))
+        warm_log = EventLog(level=2)
+        warm_ctx = Context(config=JobConfig(
+            ooc_chunk_rows=chunk_rows, ooc_cache_dir=cache_dir),
+            event_log=warm_log)
+        cold_q = build_step(cold_ctx, cached=False)
+        warm_q = build_step(warm_ctx, cached=True)
+
+        out_cold = cold_q.collect()         # warmup: compile
+        out_warm = warm_q.collect()         # warmup: compile + cold write
+
+        def by_node(t):
+            o = np.argsort(np.asarray(t["node"]), kind="stable")
+            return (np.asarray(t["node"])[o], np.asarray(t["rank"])[o])
+
+        nc, rc = by_node(out_cold)
+        nw, rw = by_node(out_warm)
+        rows_identical = (np.array_equal(nc, nw)
+                          and np.array_equal(rc, rw))
+        assert rows_identical, "warm rows diverged from cold rows"
+
+        walls_cold, walls_warm = [], []
+        for _ in range(reps):
+            t0 = time.time()
+            cold_q.collect()
+            walls_cold.append(time.time() - t0)
+            t0 = time.time()
+            warm_q.collect()
+            walls_warm.append(time.time() - t0)
+        cold_s = statistics.median(walls_cold)
+        warm_s = statistics.median(walls_warm)
+
+        writes = sum(1 for e in warm_log.events
+                     if e["event"] == "ooc_cache_write")
+        hits = sum(1 for e in warm_log.events
+                   if e["event"] == "ooc_cache_hit")
+        stall_evs = [e for e in warm_log.events
+                     if e["event"] == "prefetch_stall"]
+        assert writes == 1, f"expected ONE cold write (links): {writes}"
+        assert hits >= reps, f"warm passes must hit the cache: {hits}"
+    finally:
+        srv.close()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    speedup = (round(100.0 * (cold_s - warm_s) / cold_s, 1)
+               if cold_s > 0 else None)
+    assert speedup is not None and speedup > 0, \
+        f"warm must beat cold remote re-streaming: {speedup}"
+    out = {
+        "metric": "ooc smoke (streamed PageRank step: warm re-streaming "
+                  "cache + prefetch vs cold remote)",
+        "nodes": n_nodes,
+        "edges": n_edges,
+        "reps": reps,
+        "remote_latency_s": latency_s,
+        "remote_bandwidth_mbps": round(bandwidth_bps / (1 << 20), 1),
+        "wall_s_cold": round(cold_s, 4),
+        "wall_s_warm": round(warm_s, 4),
+        "wall_s_cold_all": [round(w, 4) for w in walls_cold],
+        "wall_s_warm_all": [round(w, 4) for w in walls_warm],
+        "warm_speedup_pct": speedup,
+        "rows_identical": rows_identical,
+        "warm_cache_writes": writes,
+        "warm_cache_hits": hits,
+        "prefetch_stalls": sum(int(e.get("stalls", 1))
+                               for e in stall_evs),
+        # the committed A/B levers the regression guard keeps
+        "cold_config": {"ooc_restream_cache": False,
+                        "ooc_prefetch_depth": 0, "cache_calls": False},
+        "warm_config": {"ooc_restream_cache": True,
+                        "ooc_prefetch_depth":
+                            JobConfig().ooc_prefetch_depth,
+                        "cache_calls": True},
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-ooc",
+            "wall_s": round(warm_s, 4),
+            "cold_wall_s": round(cold_s, 4),
+            "speedup_pct": speedup, "edges": n_edges,
+            "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def smoke_kernels(out_path="BENCH_kernels.json", n=None, quiet=False):
     """Data-plane kernel micro-bench smoke (``python bench.py
     --smoke-kernels``, also rides ``--smoke``): DEVICE-TRUTH rows for the
@@ -1631,6 +1819,9 @@ if __name__ == "__main__":
     elif "--smoke-analyze" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-analyze"]
         smoke_analyze(out_path=args[0] if args else "BENCH_analyze.json")
+    elif "--smoke-ooc" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-ooc"]
+        smoke_ooc(out_path=args[0] if args else "BENCH_ooc.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
@@ -1650,5 +1841,7 @@ if __name__ == "__main__":
                   quiet=True)
         smoke_analyze(out_path=os.path.join(base, "BENCH_analyze.json"),
                       quiet=True)
+        smoke_ooc(out_path=os.path.join(base, "BENCH_ooc.json"),
+                  quiet=True)
     else:
         main()

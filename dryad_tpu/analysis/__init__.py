@@ -67,12 +67,6 @@ def check_plan_json(plan_json: str, stream: bool = False
             + [(o, "body") for o in st.get("body", [])]
         for op, where in ops:
             span = op.get("span")
-            if stream and op["kind"] == "take" \
-                    and op.get("params", {}).get("global"):
-                report.add("DTA001", "error",
-                           f"stage {st['id']}: global take() is not "
-                           f"supported over cluster streams", span=span,
-                           node=op["kind"])
             found = []
             walk_params(op.get("params", {}), found)
             for kind, name in found:

@@ -712,11 +712,16 @@ def cost_diagnostics(report: CostReport, config=None) -> List[Diagnostic]:
 
 def cache_diagnostic(report: CostReport, config=None
                      ) -> Optional[Diagnostic]:
-    """DTA204: ``cache()`` pins its result in device memory for the
-    Context's lifetime — edge-scale data (a sizable fraction of the HBM
-    budget) should take the streamed/store-backed path instead.  Applies
-    to the MATERIALIZED bytes of the cached dataset (the last stage's
-    output), not a transient working set."""
+    """DTA204: ``cache()`` of edge-scale data (a sizable fraction of the
+    HBM budget).  Applies to the MATERIALIZED bytes of the cached
+    dataset (the last stage's output), not a transient working set.
+
+    Severity follows ``JobConfig.ooc_restream_cache``: with the
+    store-backed re-streaming cache tier ON (default) the cache()
+    LOWERS to a local chunked cache instead of pinning HBM, so the
+    finding is informational and points at the tier's knobs; with the
+    tier OFF it warns — the result pins device memory for the Context's
+    lifetime."""
     hbm = int(getattr(config, "device_hbm_bytes", 0) or 0)
     if not hbm or report.streamed or not report.stages:
         return None
@@ -724,13 +729,24 @@ def cache_diagnostic(report: CostReport, config=None
     ob = last.out_bytes.hi
     if ob is None or ob <= CACHE_HBM_FRACTION * hbm:
         return None
+    scale = (f"{fmt_bytes(ob)} ({100.0 * ob / hbm:.0f}% of "
+             f"device_hbm_bytes={fmt_bytes(hbm)})")
+    if getattr(config, "ooc_restream_cache", False):
+        return Diagnostic(
+            "DTA204", "info",
+            f"edge-scale cache(): {scale} lowers to the store-backed "
+            f"re-streaming cache tier (local chunked cache, per-chunk "
+            f"fingerprints; iterations re-stream local sequential "
+            f"reads) — set JobConfig.ooc_cache_dir for restart reuse, "
+            f"or ooc_restream_cache=False to pin device-resident",
+            Span.of(last.span), node=f"stage{last.stage}:{last.label}")
     return Diagnostic(
         "DTA204", "warn",
-        f"cache() would pin {fmt_bytes(ob)} ("
-        f"{100.0 * ob / hbm:.0f}% of device_hbm_bytes="
-        f"{fmt_bytes(hbm)}) in device memory for the Context's "
-        f"lifetime — persist with to_store() and read_store_stream() "
-        f"(the >HBM path) instead of cache() at this scale",
+        f"cache() would pin {scale} in device memory for the Context's "
+        f"lifetime (ooc_restream_cache is off) — re-enable the "
+        f"re-streaming cache tier, or persist with to_store() and "
+        f"read_store_stream() (the >HBM path) instead of cache() at "
+        f"this scale",
         Span.of(last.span), node=f"stage{last.stage}:{last.label}")
 
 

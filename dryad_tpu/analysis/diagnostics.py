@@ -38,7 +38,8 @@ SEVERITIES = {"error": 0, "warn": 1, "info": 2}
 # the static rules and the runtime raise sites draw from
 CODES = {
     # -- plan verifier (DTA0xx) -------------------------------------------
-    "DTA001": "global take() is not supported over cluster streams",
+    # DTA001 (global take over cluster streams) RETIRED: the streamed
+    # runner grew a real lowering (runtime/stream_plan._global_take)
     "DTA002": "placeholder (do_while loop input) in a streamed cluster "
               "plan",
     "DTA003": "operator not supported over cluster streams",
@@ -66,7 +67,9 @@ CODES = {
               "device_hbm_bytes (predicted spill)",
     "DTA203": "unbounded fan-out reaches an exchange (buffer sized "
               "blind)",
-    "DTA204": "cache() of edge-scale data that should be streamed",
+    "DTA204": "cache() of edge-scale data (info: lowered to the "
+              "store-backed re-streaming cache tier; warn when the "
+              "tier is disabled and the result pins device memory)",
     "DTA205": "per-stage predicted cost summary",
     # -- SQL front end (DTA3xx) --------------------------------------------
     "DTA301": "SQL parse error",

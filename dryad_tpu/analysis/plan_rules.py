@@ -124,20 +124,9 @@ def _span(n: E.Node) -> Optional[Span]:
 
 # ---------------------------------------------------------------------------
 # stream-mode rules — mirror every StreamPlanError raise site
-
-
-def _rule_stream_take(c: PlanCheck) -> List[Diagnostic]:
-    if not (c.cluster and c.has_stream):
-        return []
-    out = []
-    for n in c.nodes:
-        if isinstance(n, E.Take):
-            out.append(Diagnostic(
-                "DTA001", "error",
-                "global take() is not supported over cluster streams — "
-                "collect() then slice, or take() before streaming",
-                _span(n), _node_label(n)))
-    return out
+# (DTA001 — global take over cluster streams — RETIRED: the runtime
+# grew a real lowering, runtime/stream_plan._global_take, so there is
+# no raise site left to mirror)
 
 
 def _rule_stream_placeholder(c: PlanCheck) -> List[Diagnostic]:
@@ -446,7 +435,6 @@ def _rule_udf_determinism(c: PlanCheck) -> List[Diagnostic]:
 
 
 RULES: List[Rule] = [
-    Rule("DTA001", "stream-global-take", _rule_stream_take),
     Rule("DTA002", "stream-placeholder", _rule_stream_placeholder),
     Rule("DTA003", "stream-unsupported-op", _rule_stream_unsupported),
     Rule("DTA010", "capacity-hazard", _rule_capacity_hazard),

@@ -49,6 +49,179 @@ def _add_agg_key(cols):
 __all__ = ["Context", "Dataset"]
 
 
+# ---------------------------------------------------------------------------
+# stable query fingerprints (re-streaming cache keys, exec/ooc cache tier)
+
+# fallback salt for values with no restart-stable identity (device data,
+# opaque closures): cache entries keyed through it stay valid within
+# THIS process — warm do_while iterations still hit — but a restarted
+# job re-streams cold (conservative, never stale)
+import itertools as _itertools
+import uuid as _uuid
+
+_PROCESS_SALT = _uuid.uuid4().hex
+
+# id() reuse guard for the process-salt fingerprint fallback: a cached
+# dataset keyed by id(obj) whose object is GC'd could alias a NEW object
+# allocated at the same address — a stale HIT, the one thing the salt
+# contract forbids.  Pin a monotonic sequence to each object via weakref
+# instead; un-weakrefable objects get a fresh sequence per call (pure
+# miss every time, never stale).
+_LOCAL_ID_SEQ = _itertools.count()
+_LOCAL_IDS: Dict[int, Any] = {}     # id -> (weakref, seq)
+
+
+def _local_identity(v) -> str:
+    import weakref
+    ent = _LOCAL_IDS.get(id(v))
+    if ent is not None and ent[0]() is v:
+        return f"local:{_PROCESS_SALT}:{ent[1]}"
+    seq = next(_LOCAL_ID_SEQ)
+    try:
+        def _drop(ref, k=id(v)):
+            cur = _LOCAL_IDS.get(k)
+            if cur is not None and cur[0] is ref:
+                del _LOCAL_IDS[k]
+        _LOCAL_IDS[id(v)] = (weakref.ref(v, _drop), seq)
+    except TypeError:
+        pass
+    return f"local:{_PROCESS_SALT}:{seq}"
+
+
+def _code_const_fp(c) -> str:
+    """repr() of a const, except nested code objects (whose repr embeds
+    a memory address — it would silently defeat restart-stable keys for
+    any callable with an inner def/lambda/comprehension) recurse into
+    bytecode + consts, and frozensets repr in sorted order (their
+    iteration order is PYTHONHASHSEED-dependent)."""
+    import types
+    if isinstance(c, types.CodeType):
+        inner = ",".join(_code_const_fp(x) for x in c.co_consts)
+        return f"code({c.co_name},{c.co_code.hex()},[{inner}])"
+    if isinstance(c, frozenset):
+        return "frozenset{" + ",".join(sorted(map(repr, c))) + "}"
+    if isinstance(c, tuple):
+        return "(" + ",".join(_code_const_fp(x) for x in c) + ")"
+    return repr(c)
+
+
+def _stable_fn_fp(fn) -> Optional[str]:
+    """Restart-stable identity of a user callable: module/qualname +
+    bytecode + consts + hashable closure/default values.  None when the
+    callable's behavior depends on values we cannot hash byte-exactly
+    (bound objects, large arrays) — callers fall back to the process
+    salt, which can only cause a cache MISS, never a stale hit."""
+    import hashlib
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    parts = [getattr(fn, "__module__", "") or "", fn.__qualname__,
+             code.co_code.hex()]
+    try:
+        parts.append(_code_const_fp(code.co_consts))
+    except Exception:
+        return None
+    captured = []
+    if getattr(fn, "__closure__", None):
+        try:
+            captured.extend(c.cell_contents for c in fn.__closure__)
+        except ValueError:          # empty cell
+            return None
+    captured.extend(getattr(fn, "__defaults__", None) or ())
+    for v in captured:
+        if isinstance(v, (int, float, complex, str, bytes, bool,
+                          type(None))):
+            parts.append(repr(v))
+        elif isinstance(v, np.ndarray) and v.nbytes <= (1 << 20):
+            parts.append(hashlib.sha256(
+                np.ascontiguousarray(v).tobytes()).hexdigest())
+        else:
+            return None
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _stable_value_fp(v) -> str:
+    import hashlib
+    if callable(v):
+        return _stable_fn_fp(v) or _local_identity(v)
+    if isinstance(v, E.Decomposable):
+        return "dec(" + ",".join(
+            _stable_value_fp(getattr(v, part))
+            for part in ("seed", "merge", "finalize")) + ")"
+    if isinstance(v, np.ndarray):
+        if v.nbytes <= (1 << 20):
+            return hashlib.sha256(
+                np.ascontiguousarray(v).tobytes()).hexdigest()
+        return _local_identity(v)
+    if isinstance(v, dict):
+        return "{" + ",".join(
+            f"{k!r}:{_stable_value_fp(v[k])}" for k in sorted(v)) + "}"
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_stable_value_fp(x) for x in v) + "]"
+    if isinstance(v, (int, float, complex, str, bytes, bool,
+                      type(None))):
+        return repr(v)
+    import dataclasses as _dc
+    if _dc.is_dataclass(v) and not isinstance(v, type):
+        inner = ",".join(
+            f"{f.name}={_stable_value_fp(getattr(v, f.name))}"
+            for f in _dc.fields(v))
+        return f"{type(v).__name__}({inner})"
+    return _local_identity(v)
+
+
+def _stable_source_fp(data) -> str:
+    """Content identity of a Source node's data.  Store-backed streams
+    carry a fingerprint over path + per-partition checksums (set by
+    ChunkSource.from_store / from_text), so changed SOURCE BYTES change
+    the cache key; everything else degrades to the process salt."""
+    from dryad_tpu.exec.stream_exec import StreamSource
+    cs = data.cs if isinstance(data, StreamSource) else data
+    fp = getattr(cs, "fingerprint", None)
+    if fp:
+        return fp
+    spec = getattr(data, "spec", None)
+    if isinstance(spec, dict) and spec.get("kind") == "store_stream":
+        try:
+            from dryad_tpu.io.store import store_meta
+            meta = store_meta(spec["path"])
+            import hashlib
+            return hashlib.sha256(repr(
+                ("store", spec["path"], meta.get("counts"),
+                 meta.get("checksums"))).encode()).hexdigest()
+        except Exception:
+            pass
+    return _local_identity(data)
+
+
+def _stable_node_fp(root: E.Node) -> str:
+    """Restart-stable structural fingerprint of a query DAG — the
+    re-streaming cache key (exec/ooc cache tier).  Walks the logical
+    nodes parents-first and hashes type + every dataclass field
+    (callables by bytecode+captures, sources by content identity);
+    anything unhashable folds in the per-process salt, so an uncertain
+    key can only MISS across restarts, never serve a stale entry."""
+    import dataclasses as _dc
+    import hashlib
+    parts = []
+    ids: Dict[int, int] = {}
+    for i, n in enumerate(E.walk(root)):
+        ids[n.id] = i
+        fields = []
+        for f in _dc.fields(n):
+            if f.name in ("parents", "host"):
+                continue
+            v = getattr(n, f.name)
+            if f.name == "data":
+                fields.append(f"data={_stable_source_fp(v)}"
+                              if v is not None else "data=None")
+            else:
+                fields.append(f"{f.name}={_stable_value_fp(v)}")
+        parents = ",".join(str(ids[p.id]) for p in n.parents)
+        parts.append(f"{type(n).__name__}({parents})[{';'.join(fields)}]")
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+
+
 class Context:
     """Owns the mesh + executor and creates root Datasets."""
 
@@ -271,6 +444,52 @@ class Context:
                              None)
         weakref.finalize(node, self.cluster.pending_release.append, token)
         return Dataset(self, node)
+
+    # -- re-streaming cache plumbing (exec/ooc cache tier) ------------------
+
+    def _ooc_cache_root(self) -> str:
+        """Root directory for re-streaming cache entries:
+        ``JobConfig.ooc_cache_dir`` (persistent — a restarted job with an
+        intact cache dir skips the cold pass) or a lazily created
+        per-Context temp dir removed at Context GC.  A REMOTE
+        ``ooc_cache_dir`` (scheme://) falls through to the temp dir:
+        entry sidecars are written with local file semantics, and
+        naively os.makedirs-ing the URL would split-brain the entry
+        (data remote, sidecar in a literal local 'scheme:/...' dir)."""
+        if self.config.ooc_cache_dir and "://" not in \
+                self.config.ooc_cache_dir:
+            os.makedirs(self.config.ooc_cache_dir, exist_ok=True)
+            return self.config.ooc_cache_dir
+        root = getattr(self, "_ooc_cache_tmp", None)
+        if root is None:
+            import shutil
+            import tempfile
+            import weakref
+            root = tempfile.mkdtemp(prefix="dryad-ooc-cache-",
+                                    dir=self.spill_dir)
+            weakref.finalize(self, shutil.rmtree, root,
+                             ignore_errors=True)
+            self._ooc_cache_tmp = root
+        return root
+
+    def _cache_event(self):
+        """Event sink for ooc cache lifecycle records: forwards to the
+        Context's log AND keeps the live ``dryad_ooc_cache_hits_total``
+        counter current (the derived mirror counts the same events)."""
+        sink = self._event_log
+
+        def ev(e):
+            kind = e.get("event")
+            if kind in ("ooc_cache_hit", "ooc_cache_write"):
+                from dryad_tpu.obs.metrics import (REGISTRY,
+                                                   family_counter)
+                family_counter(
+                    REGISTRY,
+                    "ooc_cache_hits" if kind == "ooc_cache_hit"
+                    else "ooc_cache_writes").inc()
+            if sink is not None:
+                sink(e)
+        return ev
 
     # -- dataset constructors ---------------------------------------------
 
@@ -568,6 +787,26 @@ class Context:
             node = E.Source(parents=(), data=None,
                             _npartitions=self.nparts, host=cur_host)
             return Dataset(self, node)
+        probe_ph = E.Placeholder(parents=(), name="__loop",
+                                 _npartitions=self.nparts, capacity=1)
+        if (init._streaming()
+                or body(Dataset(self, probe_ph))._streaming()):
+            # streamed (>RAM) loop body on the single-process path: the
+            # loop STATE is a small host table (ranks / centroids); the
+            # body references stream sources (edges at 10x HBM) and
+            # re-executes through the streamed engine every superstep —
+            # re-reading its >RAM inputs from the store or, with
+            # .cache(), the local re-streaming chunk cache.  This is the
+            # iteration story Known-limit #3 was missing: loop-invariant
+            # >HBM inputs now iterate with device working set
+            # O(chunk_rows).
+            cur_host = init.collect()
+            for _ in range(n_iters):
+                prev = self.from_columns(cur_host)
+                cur_host = body(prev).collect()
+                if cond is not None and not cond(cur_host):
+                    break
+            return self.from_columns(cur_host)
         cur = init._materialize()
         ph = E.Placeholder(parents=(), name="__loop", _npartitions=self.nparts,
                            capacity=cur.capacity)
@@ -923,21 +1162,37 @@ class Dataset:
         around loop-invariant subqueries; temp outputs committed at
         DrVertex.h:325).  Essential under ``do_while``: the loop body
         re-executes everything it references each iteration, so hoist
-        loop-invariant joins/aggregations with ``.cache()`` first."""
+        loop-invariant joins/aggregations with ``.cache()`` first.
+
+        Streamed / edge-scale data takes the store-backed RE-STREAMING
+        cache tier (``JobConfig.ooc_restream_cache``, default on): the
+        cold pass writes a local chunked cache — io/store layout, the
+        spill-sidecar chunk format with its per-chunk fingerprints —
+        keyed by the producing query's stable fingerprint, and warm
+        passes (iteration 2..N of ``do_while`` bodies, or a restarted
+        job with an intact ``ooc_cache_dir``) re-stream from local
+        sequential reads instead of ranged hdfs://, s3://, or http://
+        fetches.  A corrupt or stale entry falls back to a clean
+        re-stream — never wrong rows."""
         if self.ctx.local_debug:
             t = _oracle.run_oracle(self.node)
             node = E.Source(parents=(), data=None,
                             _npartitions=self.ctx.nparts, host=t)
             return Dataset(self.ctx, node)
+        cfg = self.ctx.config
+        diag = None
         if not self._streaming():
-            # DTA204: cache() pins the result in device memory for the
-            # Context's lifetime — warn pre-materialization when the
-            # predicted bytes are edge-scale vs device_hbm_bytes (the
-            # streamed cache path below spools to a store instead, so
-            # it is exempt by construction)
-            self._warn_cache_cost()
+            # DTA204: cache() of edge-scale data.  With the re-streaming
+            # tier ON this is informational (the cache lowers to the
+            # local chunked store below); with the tier OFF it warns —
+            # the result pins device memory for the Context's lifetime.
+            diag = self._cache_cost_diag()
         part = self.node.partitioning
         if self.ctx.cluster is not None:
+            if cfg.ooc_restream_cache and (
+                    self._stream_sourced()
+                    or (diag is not None and diag.severity == "info")):
+                return self._cache_restream_cluster()
             # materialize cluster-resident: later queries ship only the
             # token, and the partitioning claim SURVIVES (hash-partitioned
             # cache feeds shuffle-free joins/groupbys) — VERDICT r2 item 4
@@ -951,10 +1206,11 @@ class Dataset:
                 token, reply["resident_capacity"], partitioning=part,
                 producer=self.node)
         if self._streaming():
-            # materialize once to a temp store, stream reads from there;
-            # the dir lives as long as the Context (weakref finalizer
-            # removes it at Context GC / interpreter exit — no unbounded
-            # dataset-sized leak)
+            if cfg.ooc_restream_cache:
+                return self._cache_restream_local()
+            # legacy (ooc_restream_cache=False — the A/B lever):
+            # materialize once to an unvalidated temp store, stream
+            # reads from there; the dir lives as long as the Context
             import shutil
             import tempfile
             import weakref
@@ -965,20 +1221,39 @@ class Dataset:
             target = d + "/data"
             self.to_store(target)
             return self.ctx.read_store_stream(target)
+        if diag is not None and diag.severity == "info" \
+                and cfg.ooc_restream_cache:
+            # edge-scale in-memory cache(): pin a LOCAL store instead of
+            # device HBM — later queries stream it (the DTA204 story)
+            return self._cache_restream_inmem()
         pd = self._materialize()
         if getattr(self, "_last_salted", False):
             part = E.Partitioning.none()
         return self.ctx.from_pdata(pd, partitioning=part)
 
-    def _warn_cache_cost(self) -> None:
-        """Emit the DTA204 edge-scale-cache warning (lint-gated, best
-        effort — a cost-model failure must never block a cache())."""
-        if getattr(self.ctx.config, "lint", "off") == "off" \
-                or not getattr(self.ctx.config, "device_hbm_bytes", 0) \
-                or self.ctx._event_log is None:
-            # no sink to surface the finding: skip the (planning +
-            # eval_shape) estimate instead of computing and dropping it
-            return
+    def _stream_sourced(self) -> bool:
+        """True when any source is a stream (local StreamSource OR a
+        cluster ``store_stream`` deferred source) — the streamed-data
+        half of the re-streaming cache tier's applicability test."""
+        from dryad_tpu.analysis.plan_rules import _is_stream_source
+        return any(isinstance(n, E.Source) and n.data is not None
+                   and _is_stream_source(n.data)
+                   for n in E.walk(self.node))
+
+    def _cache_cost_diag(self):
+        """The DTA204 edge-scale-cache diagnostic for this query (None
+        when not edge-scale or not computable).  Best effort — a
+        cost-model failure must never block a cache().  Also emits the
+        lint_finding when a sink is attached and lint is on."""
+        cfg = self.ctx.config
+        if not getattr(cfg, "device_hbm_bytes", 0):
+            return None
+        has_sink = (getattr(cfg, "lint", "off") != "off"
+                    and self.ctx._event_log is not None)
+        if not (cfg.ooc_restream_cache or has_sink):
+            # neither a lowering decision nor a finding to surface:
+            # skip the (planning + eval_shape) estimate entirely
+            return None
         try:
             from dryad_tpu.analysis.cost import (cache_diagnostic,
                                                  estimate_query)
@@ -988,13 +1263,117 @@ class Dataset:
                                  config=self.ctx.config)
             d = cache_diagnostic(rep, self.ctx.config)
         except Exception:
-            return
-        if d is not None and self.ctx._event_log is not None:
+            return None
+        if d is not None and has_sink:
             self.ctx._event_log(
                 {"event": "lint_finding", "code": d.code,
                  "severity": d.severity, "message": d.message,
                  "node": d.node,
                  "span": str(d.span) if d.span else None})
+        return d
+
+    # -- re-streaming cache tier (exec/ooc.py cache machinery) --------------
+
+    def _cache_restream_local(self) -> "Dataset":
+        """Streamed cache(): fingerprinted local chunk cache.  Cold =
+        one pass through the streamed engine writing the entry
+        (``ooc_cache_write``); warm — including a fresh process with an
+        intact ``ooc_cache_dir`` — skips the pass entirely and every
+        later iteration re-streams local sequential reads
+        (``ooc_cache_hit`` per pass)."""
+        from dryad_tpu.exec import ooc
+        root = self.ctx._ooc_cache_root()
+        key = _stable_node_fp(self.node)
+        ev = self.ctx._cache_event()
+        warm = ooc.cached_chunk_source(root, key)
+        if warm is None:
+            cs = self._stream_run()
+            sc = ooc.write_chunk_cache(root, key, cs)
+            ev({"event": "ooc_cache_write",
+                "path": ooc.cache_entry_paths(root, key)[0],
+                "rows": sc["rows"], "bytes": sc["bytes"]})
+            chunk_rows, schema = sc["chunk_rows"], cs.schema
+        else:
+            chunk_rows = int(warm[1]["chunk_rows"])
+            schema = warm[0].schema
+        src = ooc.cache_source(root, key, chunk_rows, schema,
+                               make_producer=self._stream_run,
+                               on_event=ev)
+        return self.ctx.from_stream(src)
+
+    def _cache_restream_inmem(self) -> "Dataset":
+        """Edge-scale in-memory cache(): materialize once to a local
+        partitioned store (per-chunk fingerprints) and hand back a
+        streamed read over it — the result no longer pins HBM for the
+        Context's lifetime."""
+        from dryad_tpu.exec import ooc
+        cfg = self.ctx.config
+        root = self.ctx._ooc_cache_root()
+        key = _stable_node_fp(self.node)
+        ev = self.ctx._cache_event()
+        warm = ooc.cached_chunk_source(root, key)
+        if warm is None:
+            entry, data, _side = ooc.cache_entry_paths(root, key)
+            os.makedirs(entry, exist_ok=True)
+            self.to_store(data)
+            sc = ooc.adopt_chunk_cache(root, key, cfg.ooc_chunk_rows)
+            ev({"event": "ooc_cache_write", "path": entry,
+                "rows": sc["rows"], "bytes": sc["bytes"]})
+            chunk_rows = sc["chunk_rows"]
+            schema = ooc.cached_chunk_source(root, key)[0].schema
+        else:
+            chunk_rows = int(warm[1]["chunk_rows"])
+            schema = warm[0].schema
+
+        def producer():
+            # fallback after a mid-stream invalidation: re-materialize
+            # in memory and slice to chunks (it fit on device anyway)
+            t = pdata_to_host(self._materialize())
+            return ooc.ChunkSource.from_arrays(
+                t, chunk_rows, str_max_len=cfg.string_max_len)
+
+        src = ooc.cache_source(root, key, chunk_rows, schema,
+                               make_producer=producer, on_event=ev)
+        return self.ctx.from_stream(src)
+
+    def _cache_restream_cluster(self) -> "Dataset":
+        """Cluster cache() of streamed / edge-scale data: the gang
+        writes the entry's data store in parallel (one writer per
+        worker) instead of pinning a dataset-sized resident, and later
+        queries stream the store.  Needs a worker-reachable local/shared
+        filesystem root (``ooc_cache_dir`` > ``cluster_stream_spool_dir``
+        > driver temp — valid for single-machine clusters)."""
+        from dryad_tpu.exec import ooc
+        cfg = self.ctx.config
+        root = cfg.ooc_cache_dir or cfg.cluster_stream_spool_dir
+        if root is None:
+            root = self.ctx._ooc_cache_root()
+        elif "://" in root:
+            # remote roots have no sidecar file semantics — fall back
+            # to the driver-local temp root (single-machine clusters)
+            root = self.ctx._ooc_cache_root()
+        else:
+            os.makedirs(root, exist_ok=True)
+        key = _stable_node_fp(self.node)
+        ev = self.ctx._cache_event()
+        entry, data, _side = ooc.cache_entry_paths(root, key)
+        warm = ooc.cached_chunk_source(root, key)
+        if warm is None:
+            os.makedirs(entry, exist_ok=True)
+            part = self.node.partitioning
+            self.ctx._cluster_run(
+                self.node, collect=False, store_path=data,
+                store_partitioning={"kind": part.kind,
+                                    "keys": list(part.keys)})
+            sc = ooc.adopt_chunk_cache(root, key, cfg.ooc_chunk_rows)
+            ev({"event": "ooc_cache_write", "path": entry,
+                "rows": sc["rows"], "bytes": sc["bytes"]})
+        else:
+            sc = warm[1]
+            ev({"event": "ooc_cache_hit", "path": entry,
+                "rows": sc.get("rows"), "bytes": sc.get("bytes")})
+        return self.ctx.read_store_stream(
+            data, chunk_rows=int(sc["chunk_rows"]))
 
     # -- terminals ---------------------------------------------------------
 

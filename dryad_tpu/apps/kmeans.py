@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from dryad_tpu.api.dataset import Context, Dataset
 from dryad_tpu.data.columnar import Batch
 
-__all__ = ["gen_points", "kmeans", "kmeans_numpy"]
+__all__ = ["gen_points", "kmeans", "kmeans_stream", "kmeans_numpy"]
 
 
 def gen_points(n: int, dim: int, k: int, seed: int = 0):
@@ -74,6 +74,29 @@ def kmeans(ctx: Context, points: dict, k: int, n_iters: int = 10,
 
     out = ctx.do_while(cents0.with_capacity(k_cap), body, n_iters=n_iters)
     t = out.collect()
+    order = np.argsort(t["cid"])
+    return np.asarray(t["cx"])[order]
+
+
+def kmeans_stream(ctx: Context, pts_ds: Dataset, k: int,
+                  init_centers: np.ndarray, n_iters: int = 10
+                  ) -> np.ndarray:
+    """k-means over >HBM points on the OOC path: ``pts_ds`` is a
+    STREAMED dataset (``read_store_stream`` + optional ``.cache()``);
+    the k-row centroid table iterates as a small host table through the
+    streamed ``do_while`` while every assignment superstep re-streams
+    the points with device working set O(chunk_rows)."""
+    cents0 = ctx.from_columns(
+        {"cid": np.arange(k, dtype=np.int32),
+         "cx": np.asarray(init_centers, np.float32)})
+
+    def body(cents: Dataset) -> Dataset:
+        assigned = pts_ds.cross_apply(cents, _assign_fn,
+                                      host_fn=_assign_host,
+                                      label="assign")
+        return assigned.group_by(["cid"], {"cx": ("mean", "x")})
+
+    t = ctx.do_while(cents0, body, n_iters=n_iters).collect()
     order = np.argsort(t["cid"])
     return np.asarray(t["cx"])[order]
 

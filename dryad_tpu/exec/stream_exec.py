@@ -93,6 +93,39 @@ def _unsupported(kind: str) -> StreamExecutionError:
 
 _LOCAL_UNSCALABLE = 1 << 30
 
+# compiled chunk-program cache: an ITERATIVE streamed job (a do_while
+# body re-planned every superstep, or a re-drained cached dataset)
+# rebuilds structurally identical _stream_local pipelines around the
+# SAME user callables — a fresh jax.jit closure per pass would retrace
+# (and, off the persistent XLA cache, re-compile) every superstep.
+# Keyed on the fused ops' full content with callables by IDENTITY; each
+# entry holds strong refs to those callables so a key can never alias a
+# garbage-collected-and-reallocated id.  Bounded FIFO eviction.
+from collections import OrderedDict as _OrderedDict
+
+_PROG_CACHE: "_OrderedDict[tuple, Any]" = _OrderedDict()
+_PROG_CACHE_MAX = 256
+
+
+def _op_sig(op: Optional[StageOp]):
+    if op is None:
+        return None
+    items = tuple(sorted(
+        (k, id(v) if callable(v) else repr(v))
+        for k, v in op.params.items()))
+    return (op.kind, items)
+
+
+def _op_refs(op: Optional[StageOp]):
+    if op is None:
+        return ()
+    return tuple(v for v in op.params.values() if callable(v))
+
+
+def _cached_program(key, refs, builder):
+    return ooc.fifo_memo(_PROG_CACHE, _PROG_CACHE_MAX, key, refs,
+                         builder)
+
 
 def _local_op(b: Batch, op: StageOp, scale: int):
     """One chunk-local op; returns (batch, need_scale) where need_scale is
@@ -150,7 +183,9 @@ def _ops_out_capacity(in_cap: int, ops: List[StageOp]) -> int:
 def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
                   extra_right: Optional[Batch] = None,
                   right_chunk: Optional[HChunk] = None,
-                  body_op: Optional[StageOp] = None) -> ChunkSource:
+                  body_op: Optional[StageOp] = None,
+                  stats: Optional[ooc.PrefetchStats] = None
+                  ) -> ChunkSource:
     """Fuse a run of chunk-local ops (plus an optional binary body op with
     a materialized right side) into one jitted program and stream chunks
     through it, double-buffered, with per-chunk right-sized retries.
@@ -161,7 +196,6 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
     form of hash_join's in-batch synthesis."""
     chunk_rows = cs.chunk_rows
     depth = config.ooc_inflight
-    fns: Dict[int, Any] = {}
 
     join_how = (body_op.params.get("how", "inner")
                 if body_op is not None and body_op.kind == "join" else None)
@@ -194,12 +228,23 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
             return b, need_all, matched
         return jax.jit(f)
 
+    # one program per (fused-op content, scale) ACROSS passes: iterative
+    # streamed jobs reuse the compiled chunk pipeline instead of
+    # retracing it every superstep (_PROG_CACHE above)
+    prog_key = (tuple(_op_sig(o) for o in ops), _op_sig(body_op),
+                track_right)
+    prog_refs = (tuple(r for o in ops for r in _op_refs(o))
+                 + _op_refs(body_op))
+
+    def _fn_for(scale: int):
+        return _cached_program(prog_key + (scale,), prog_refs,
+                               lambda: build(scale))
+
     # probe the output schema with one empty chunk (the probe program IS
     # the scale-1 program — cache it).  For right-tracking joins, also
     # probe the LEFT-side column names (post leg ops) for synth naming.
-    fns[1] = build(1)
-    probe_b, _, _ = fns[1](_chunk_to_batch(HChunk.empty_like(cs.schema), 1),
-                           extra_right)
+    probe_b, _, _ = _fn_for(1)(
+        _chunk_to_batch(HChunk.empty_like(cs.schema), 1), extra_right)
     out_schema = chunk_schema(_batch_to_chunk(probe_b))
     if track_right:
         # unmatched right rows carry RIGHT key bytes in the left key
@@ -222,12 +267,6 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
     out_cap = _ops_out_capacity(chunk_rows, ops)
     if body_op is not None and body_op.kind == "join":
         out_cap = body_op.params["out_capacity"]
-
-    def _fn_for(scale: int):
-        fn = fns.get(scale)
-        if fn is None:
-            fn = fns[scale] = build(scale)
-        return fn
 
     def launch(chunk: HChunk):
         # dispatch device work NOW — jax async dispatch overlaps this
@@ -271,7 +310,8 @@ def _stream_local(cs: ChunkSource, ops: List[StageOp], config,
             yield from _slices(
                 _widen_strs(_batch_to_chunk(out), out_schema))
 
-        for chunk in cs:
+        for chunk in ooc.prefetch_iter(iter(cs),
+                                       config.ooc_prefetch_depth, stats):
             pending.append(launch(chunk))
             if len(pending) >= depth:
                 yield from drain(pending.popleft())
@@ -402,7 +442,9 @@ def _materialize_small(cs: ChunkSource, config, what: str
 
 
 def _stream_global(cs: ChunkSource, op: StageOp, config,
-                   spill_dir: Optional[str]) -> ChunkSource:
+                   spill_dir: Optional[str],
+                   stats: Optional[ooc.PrefetchStats] = None
+                   ) -> ChunkSource:
     k, p = op.kind, op.params
     if k == "sort":
         keys = tuple(p["keys"])
@@ -411,7 +453,9 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
             return ooc.external_sort(cs, list(keys),
                                      spill_dir=_fresh_spill(spill_dir),
                                      depth=config.ooc_inflight,
-                                     incore_bytes=config.ooc_incore_bytes)
+                                     incore_bytes=config.ooc_incore_bytes,
+                                     prefetch=config.ooc_prefetch_depth,
+                                     stats=stats)
 
         return ChunkSource(it_sort, cs.schema, cs.chunk_rows)
     if k == "group":
@@ -425,7 +469,8 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
         def it_group():
             return ooc.streaming_group_aggregate(
                 cs, keys, aggs, n_buckets=config.ooc_hash_buckets,
-                depth=config.ooc_inflight)
+                depth=config.ooc_inflight,
+                prefetch=config.ooc_prefetch_depth, stats=stats)
 
         return ChunkSource(it_group, schema, cs.chunk_rows)
     if k == "dgroup_local":
@@ -440,7 +485,8 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
         def it_dgroup():
             return ooc.streaming_group_decomposable(
                 cs, keys, decs, n_buckets=config.ooc_hash_buckets,
-                depth=config.ooc_inflight)
+                depth=config.ooc_inflight,
+                prefetch=config.ooc_prefetch_depth, stats=stats)
 
         return ChunkSource(it_dgroup, schema, cs.chunk_rows)
     if k == "group_top_k":
@@ -450,7 +496,8 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
             return ooc.streaming_group_topk(
                 cs, keys, p["k"], p["by"], p["descending"],
                 n_buckets=config.ooc_hash_buckets,
-                depth=config.ooc_inflight)
+                depth=config.ooc_inflight,
+                prefetch=config.ooc_prefetch_depth, stats=stats)
 
         return ChunkSource(it_topk, cs.schema, cs.chunk_rows)
     if k == "group_rank":
@@ -470,7 +517,8 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
                 cs, keys, fn, schema, n_buckets=config.ooc_hash_buckets,
                 depth=config.ooc_inflight,
                 max_bucket_rows=config.ooc_group_bucket_rows,
-                what="group_rank")
+                what="group_rank",
+                prefetch=config.ooc_prefetch_depth, stats=stats)
 
         return ChunkSource(it_rank, schema, cs.chunk_rows)
     if k == "group_apply":
@@ -513,7 +561,8 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
                 n_buckets=config.ooc_hash_buckets,
                 depth=config.ooc_inflight,
                 max_bucket_rows=config.ooc_group_bucket_rows,
-                what="group_apply")
+                what="group_apply",
+                prefetch=config.ooc_prefetch_depth, stats=stats)
 
         return ChunkSource(it_apply, schema, cs.chunk_rows)
     if k == "distinct":
@@ -522,7 +571,8 @@ def _stream_global(cs: ChunkSource, op: StageOp, config,
         def it_dist():
             return ooc.streaming_distinct(
                 cs, keys, n_buckets=config.ooc_hash_buckets,
-                depth=config.ooc_inflight)
+                depth=config.ooc_inflight,
+                prefetch=config.ooc_prefetch_depth, stats=stats)
 
         return ChunkSource(it_dist, cs.schema, cs.chunk_rows)
     if k == "take":
@@ -771,6 +821,10 @@ def run_stream_graph(graph: StageGraph, config,
     # sort bucket spill only when the caller opted into disk spill;
     # otherwise sorts keep buckets in host RAM (faster)
     sort_spill = job_root if spill_dir is not None else None
+    # one prefetch-stats box per job: every prefetch_iter in this graph's
+    # pipelines feeds it; the drained total surfaces as ONE
+    # prefetch_stall event (EXPLAIN ANALYZE folds it into the report)
+    stats = ooc.PrefetchStats()
     consumers: Dict[int, int] = {}
     for st in graph.stages:
         for sid in st.input_stage_ids():
@@ -793,9 +847,10 @@ def run_stream_graph(graph: StageGraph, config,
                     "placeholders (do_while bodies) are not yet streamed")
             for kind, payload in _split_leg_ops(list(leg.ops)):
                 if kind == "local":
-                    cs = _stream_local(cs, payload, config)
+                    cs = _stream_local(cs, payload, config, stats=stats)
                 else:
-                    cs = _stream_global(cs, payload, config, sort_spill)
+                    cs = _stream_global(cs, payload, config, sort_spill,
+                                        stats=stats)
             legs_cs.append(cs)
 
         cur = legs_cs[0]
@@ -805,16 +860,18 @@ def run_stream_graph(graph: StageGraph, config,
                 right_b, right_h = _materialize_small(rest.pop(0), config,
                                                       "right/build")
                 cur = _stream_local(cur, [], config, extra_right=right_b,
-                                    right_chunk=right_h, body_op=op)
+                                    right_chunk=right_h, body_op=op,
+                                    stats=stats)
             elif op.kind == "concat":
                 cur = _concat_sources(cur, rest.pop(0))
             elif op.kind == "zip":
                 cur = _zip_sources(cur, rest.pop(0),
                                    op.params.get("suffix", "_r"))
             elif op.kind in _STREAM_KINDS:
-                cur = _stream_global(cur, op, config, sort_spill)
+                cur = _stream_global(cur, op, config, sort_spill,
+                                     stats=stats)
             elif op.kind in _LOCAL_KINDS:
-                cur = _stream_local(cur, [op], config)
+                cur = _stream_local(cur, [op], config, stats=stats)
             else:
                 raise _unsupported(op.kind)
 
@@ -832,6 +889,9 @@ def run_stream_graph(graph: StageGraph, config,
             yield from out
         finally:
             shutil.rmtree(job_root, ignore_errors=True)
+            snap = stats.snapshot()
+            if snap["stalls"]:
+                ev({"event": "prefetch_stall", **snap})
 
     return ChunkSource(final_it, out.schema, out.chunk_rows)
 

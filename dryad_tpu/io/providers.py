@@ -25,11 +25,43 @@ from typing import Any, Callable, Dict, List, Tuple
 
 __all__ = ["register_provider", "parse_uri", "expand_paths",
             "read_text_files", "text_dataset_from_fetches",
-            "UnknownSchemeError"]
+            "retry_transient", "UnknownSchemeError"]
 
 
 class UnknownSchemeError(ValueError):
     pass
+
+
+def retry_transient(fn: Callable[[], Any], what: str = "",
+                    retries: int = 3, base_delay_s: float = 0.2) -> Any:
+    """Run an IDEMPOTENT provider read with bounded exponential-backoff
+    retries on TRANSIENT failures — the same policy the per-request
+    provider clients apply (io/webhdfs._attempt, io/s3._request), lifted
+    one level so multi-request operations (a ranged chunk fetch that
+    spans several redirects/GETs) re-issue from scratch when a single
+    flaky hop slips past the per-request retries (empty 200 bodies,
+    truncated streams, dropped datanode connections mid-redirect).  A
+    mid-stream transient must degrade to a retry, never kill a
+    multi-hour streamed job.
+
+    Definite client errors stay fatal: an exception carrying a 4xx
+    ``status`` (provider error classes set it) re-raises immediately —
+    retrying a FileNotFound only delays the diagnosis."""
+    import time
+
+    last: Exception = None  # type: ignore[assignment]
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except (IOError, OSError, ConnectionError) as e:
+            status = getattr(e, "status", None)
+            if status is not None and 400 <= int(status) < 500:
+                raise
+            if attempt >= retries:
+                raise
+            last = e
+            time.sleep(min(base_delay_s * (2 ** attempt), 2.0))
+    raise last  # unreachable; keeps type checkers honest
 
 
 def parse_uri(uri: str) -> Tuple[str, str]:

@@ -526,9 +526,20 @@ def hdfs_part_chunks(url: str, meta: Dict[str, Any], p: int,
             base += cnt * rb
     import concurrent.futures
 
+    from dryad_tpu.io.providers import retry_transient
+
     def fetch(args, s, e):
         _k, _sp, dt, tail, rb, base_off = args
-        raw = _read_exact(c, part, base_off + s * rb, (e - s) * rb)
+        # route MID-STREAM ranged reads through the provider
+        # retry/backoff path whole-partition reads already enjoy: the
+        # whole segment range re-issues from scratch (ranged GETs are
+        # idempotent), so one flaky datanode hop — an empty 200, a
+        # truncated body, a dropped connection past the per-request
+        # retries — costs a backoff, not a multi-hour streamed job
+        raw = retry_transient(
+            lambda: _read_exact(c, part, base_off + s * rb,
+                                (e - s) * rb),
+            what=f"hdfs ranged read {part!r}", retries=2)
         # bytearray copy -> writable array (frombuffer over bytes
         # would hand downstream kernels read-only buffers)
         return np.frombuffer(bytearray(raw), dt).reshape((e - s,) + tail)

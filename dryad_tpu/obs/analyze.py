@@ -56,6 +56,8 @@ class StageActuals:
     wall_s: float = 0.0           # summed across runs
     compile_s: float = 0.0
     cache_hits: int = 0           # compiled-stage cache hits
+    prefetch_stalls: int = 0      # chunk-prefetch stalls (streamed)
+    prefetch_stall_s: float = 0.0
     scale: int = 1
     deferred: bool = False
     settled: bool = False         # >= 1 non-overflow run recorded
@@ -109,6 +111,11 @@ class AnalyzeReport:
     predicted: bool = False       # a cost_report covered this stream
     misses: int = 0               # cost_model_miss events seen
     rewrites: int = 0             # graph_rewrite events seen
+    # out-of-core re-streaming cache + prefetch pipeline (streamed runs)
+    ooc_cache_hits: int = 0       # passes served from the local cache
+    ooc_cache_writes: int = 0     # cold cache writes
+    prefetch_stalls: int = 0      # host-IO-bound waits in the pipeline
+    prefetch_stall_s: float = 0.0
 
     def __post_init__(self):
         self._events: List[dict] = []   # source stream (not serialized)
@@ -128,6 +135,10 @@ class AnalyzeReport:
                 "stage_runs": self.stage_runs,
                 "predicted": self.predicted, "misses": self.misses,
                 "rewrites": self.rewrites,
+                "ooc_cache_hits": self.ooc_cache_hits,
+                "ooc_cache_writes": self.ooc_cache_writes,
+                "prefetch_stalls": self.prefetch_stalls,
+                "prefetch_stall_s": round(self.prefetch_stall_s, 6),
                 "stages": [s.to_payload() for s in self.stages]}
 
     @staticmethod
@@ -137,7 +148,9 @@ class AnalyzeReport:
             d.get("job"), d.get("wall_s", 0.0), d.get("run_s", 0.0),
             d.get("compile_s", 0.0), d.get("out_bytes_total", 0),
             d.get("stage_runs", 0), d.get("predicted", False),
-            d.get("misses", 0), d.get("rewrites", 0))
+            d.get("misses", 0), d.get("rewrites", 0),
+            d.get("ooc_cache_hits", 0), d.get("ooc_cache_writes", 0),
+            d.get("prefetch_stalls", 0), d.get("prefetch_stall_s", 0.0))
 
     def render(self) -> str:
         """The ANALYZE table: one row per executed stage, measured
@@ -164,6 +177,8 @@ class AnalyzeReport:
                 flags.append("deferred")
             if s.streamed:
                 flags.append("streamed")
+            if s.prefetch_stalls:
+                flags.append(f"io-stall x{s.prefetch_stalls}")
             if not s.settled and s.runs:
                 flags.append("overflowed")
             if s.rows_in_bounds is False:
@@ -191,6 +206,13 @@ class AnalyzeReport:
                "; no cost_report in the stream — actuals only")
             + (f"; {n_set}/{len(self.stages)} settled" if self.stages
                else ""))
+        if (self.ooc_cache_hits or self.ooc_cache_writes
+                or self.prefetch_stalls):
+            lines.append(
+                f"out-of-core: {self.ooc_cache_hits} stream cache "
+                f"hit(s), {self.ooc_cache_writes} cold write(s); "
+                f"{self.prefetch_stalls} prefetch stall(s) "
+                f"({self.prefetch_stall_s:.3f}s waiting on host IO)")
         return "\n".join(lines)
 
 
@@ -258,6 +280,9 @@ def analyze_events(events, job: Optional[str] = None) -> AnalyzeReport:
             s.deferred = s.deferred or bool(e.get("deferred"))
             if k == "stream_stage_done":
                 s.streamed = s.settled = True
+                s.prefetch_stalls += int(e.get("prefetch_stalls") or 0)
+                s.prefetch_stall_s += float(
+                    e.get("prefetch_stall_s") or 0.0)
                 continue
             if e.get("overflow"):
                 s.retries += 1
@@ -288,6 +313,16 @@ def analyze_events(events, job: Optional[str] = None) -> AnalyzeReport:
             s = by_id.get(e.get("stage"))
             if s is not None:
                 s.misses = s.misses + (str(e.get("what")),)
+        elif k == "ooc_cache_hit":
+            rep.ooc_cache_hits += 1
+        elif k == "ooc_cache_write":
+            rep.ooc_cache_writes += 1
+        elif k == "prefetch_stall":
+            # job-level summary record (the per-stage split already
+            # rides stream_stage_done fields — do not double-count the
+            # stage rows, only the report totals)
+            rep.prefetch_stalls += int(e.get("stalls") or 1)
+            rep.prefetch_stall_s += float(e.get("stall_s") or 0.0)
         elif k == "graph_rewrite":
             # a rewrite usually reshapes a stage that has NOT run yet —
             # buffer by id and attach after the walk, when the

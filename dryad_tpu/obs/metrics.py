@@ -65,6 +65,14 @@ FAMILIES = {
                          "1 when the on-disk XLA cache is active"),
     "tee_spills": ("dryad_stream_tee_spills_total",
                    "stream Tee spills"),
+    "ooc_cache_hits": ("dryad_ooc_cache_hits_total",
+                       "re-streaming cache passes served from the "
+                       "local chunk cache"),
+    "ooc_cache_writes": ("dryad_ooc_cache_writes_total",
+                         "re-streaming cache cold writes"),
+    "prefetch_stalls": ("dryad_ooc_prefetch_stalls_total",
+                        "chunk-prefetch stalls (host IO was the "
+                        "bottleneck)"),
     "jobs": ("dryad_jobs_total", "completed jobs"),
     "jobs_failed": ("dryad_jobs_failed_total", "failed jobs"),
     "job_progress": ("dryad_job_progress_ratio",
@@ -371,6 +379,13 @@ def metrics_from_events(events, registry: Optional[Registry] = None,
               rule=e.get("rule", "?"), kind=e.get("kind", "?")).inc()
         elif k == "stream_tee_spill":
             family_counter(r, "tee_spills").inc()
+        elif k == "ooc_cache_hit":
+            family_counter(r, "ooc_cache_hits").inc()
+        elif k == "ooc_cache_write":
+            family_counter(r, "ooc_cache_writes").inc()
+        elif k == "prefetch_stall":
+            family_counter(r, "prefetch_stalls").inc(
+                int(e.get("stalls", 1)))
         elif k == "job_done":
             C("jobs", e).inc()
         elif k == "job_failed":

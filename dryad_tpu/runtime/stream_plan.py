@@ -45,7 +45,7 @@ __all__ = ["execute_stream_plan", "has_stream_sources", "StreamPlanError"]
 class StreamPlanError(DiagnosticError):
     """Streamed-plan contract violation.  Every raise carries the stable
     diagnostic code of the dryad_tpu/analysis rule that catches the same
-    condition pre-submit (DTA001/002/003), or a DTA9xx runtime-only code
+    condition pre-submit (DTA002/003), or a DTA9xx runtime-only code
     for data-dependent overflows and internal invariants — see
     analysis/diagnostics.CODES; tests/test_analysis.py asserts the
     mapping has no drift."""
@@ -61,8 +61,10 @@ _WAVE_FUSABLE = {"fn", "filter", "mean_fin", "flat_tokens", "flat_map",
 # exec/ooc.streaming_group_whole — post-exchange bucket streams are
 # key-aligned, so each device materializes complete groups; zip pairs
 # per-device streams positionally (the in-memory executor's
-# per-partition zip semantics).  Nothing is unsupported here anymore
-# (channelinterface.h:212 — reference channels stream EVERY operator).
+# per-partition zip semantics); global take coordinates across the gang
+# through one mirrored host allgather (_global_take).  Nothing is
+# unsupported here anymore (channelinterface.h:212 — reference channels
+# stream EVERY operator).
 _UNSUPPORTED: Dict[str, str] = {}
 
 
@@ -246,8 +248,8 @@ def _compact_fn_for(stage: Stage):
 
 
 def _run_leg_waves(dev: _DevStreams, leg_ops: List[StageOp], ex: Exchange,
-                   mesh, config, bounds_arr, compact_fn, job_root: str
-                   ) -> _DevStreams:
+                   mesh, config, bounds_arr, compact_fn, job_root: str,
+                   stats=None) -> _DevStreams:
     """Lockstep chunk waves for one leg's exchange; returns per-device
     bucket streams holding ALL received rows (spilled to disk for
     unbounded kinds, RAM + compaction for group partials)."""
@@ -301,7 +303,10 @@ def _run_leg_waves(dev: _DevStreams, leg_ops: List[StageOp], ex: Exchange,
     # recompiles) — wire bytes converge to ~useful bytes
     slot_rows: Optional[int] = None
     jbounds = jnp.asarray(bounds_arr)
-    its = [iter(cs) for cs in dev.streams]
+    # prefetch: the NEXT wave's chunk reads/unpacks overlap the current
+    # wave's collective (exec/ooc.prefetch_iter, per-device threads)
+    its = [ooc.prefetch_iter(iter(cs), config.ooc_prefetch_depth, stats)
+           for cs in dev.streams]
     while True:
         chunks = [next(it, None) for it in its]
         live = _host_allgather(
@@ -431,71 +436,144 @@ def _put_aligned(chunks, schema, chunk_rows: int, mesh):
 # leg / body streaming through the single-partition machinery
 
 
-def _apply_whole_stream_ops(cs, ops: List[StageOp], config, job_root):
-    """Leg ops with whole-stream (per-partition) semantics, applied to one
-    device's stream via exec/stream_exec."""
+def _global_take(dev: _DevStreams, n: int, mesh) -> _DevStreams:
+    """Global take over cluster streams — a REAL lowering (this used to
+    be a typed DTA001 error).  Every device drains AT MOST n rows from
+    its stream (the pull stops early, upstream chunks past the bound
+    are never fetched); ONE mirrored host allgather of the per-device
+    prefix counts then assigns device d exactly
+    ``clip(n - rows_before_d, 0, local)`` rows in DEVICE-MAJOR order —
+    the same order streamed ``collect()``/``to_store`` emit rows, so
+    ``take(n)`` is precisely the head of the streamed output (and after
+    a range-exchanged ``order_by``, the exact global top-n).  The kept
+    rows are materialized on host, bounded by n per device."""
+    import jax
+
+    from dryad_tpu.exec.ooc import ChunkSource, _slice_hchunk
+    from dryad_tpu.runtime.stream_cluster import _host_allgather
+
+    dpp = len(dev.streams)
+    start = jax.process_index() * dpp
+    schema, chunk_rows = dev.schema, dev.chunk_rows
+    frags_per_dev: List[List[Any]] = []
+    counts: List[int] = []
+    for cs in dev.streams:
+        frags: List[Any] = []
+        got = 0
+        for c in cs:
+            if c.n == 0:
+                continue
+            take = min(c.n, n - got)
+            frags.append(c if take == c.n else _slice_hchunk(c, 0, take))
+            got += take
+            if got >= n:
+                break           # stop BEFORE pulling another chunk
+        frags_per_dev.append(frags)
+        counts.append(got)
+    allc = _host_allgather(np.asarray(counts, np.int32), mesh
+                           ).reshape(-1)          # [P] device-major
+    outs: List[Any] = []
+    for d, frags in enumerate(frags_per_dev):
+        before = int(allc[: start + d].sum())
+        keep = max(0, min(n - before, counts[d]))
+        kept: List[Any] = []
+        acc = 0
+        for c in frags:
+            if acc >= keep:
+                break
+            t = min(c.n, keep - acc)
+            kept.append(c if t == c.n else _slice_hchunk(c, 0, t))
+            acc += t
+        outs.append(ChunkSource(lambda ks=tuple(kept): iter(ks),
+                                schema, chunk_rows))
+    return _DevStreams(outs)
+
+
+def _apply_leg_ops(dev: _DevStreams, ops: List[StageOp], config, job_root,
+                   mesh, stats=None) -> _DevStreams:
+    """Leg ops with whole-stream semantics over a stage input's
+    per-device streams: chunk-local runs and per-partition globals apply
+    per device through exec/stream_exec; a GLOBAL take coordinates
+    across the gang eagerly (mirrored — every process walks the same
+    stages in the same order, so the allgather lines up)."""
     from dryad_tpu.exec import stream_exec
 
     for kind, payload in stream_exec._split_leg_ops(list(ops)):
         if kind == "local":
-            cs = stream_exec._stream_local(cs, payload, config)
-        else:
-            if payload.kind in _UNSUPPORTED:
-                raise StreamPlanError(
-                    f"op {payload.kind!r} is not supported over cluster "
-                    f"streams: {_UNSUPPORTED[payload.kind]}",
-                    code="DTA003", span=payload.span)
-            if payload.kind == "take" and payload.params.get("global"):
-                raise StreamPlanError(
-                    "global take over cluster streams is not supported — "
-                    "collect() then slice, or take() before streaming",
-                    code="DTA001", span=payload.span)
-            cs = stream_exec._stream_global(cs, payload, config, job_root)
-    return cs
+            dev = _DevStreams([
+                stream_exec._stream_local(cs, payload, config,
+                                          stats=stats)
+                for cs in dev.streams])
+            continue
+        if payload.kind in _UNSUPPORTED:
+            raise StreamPlanError(
+                f"op {payload.kind!r} is not supported over cluster "
+                f"streams: {_UNSUPPORTED[payload.kind]}",
+                code="DTA003", span=payload.span)
+        if payload.kind == "take" and payload.params.get("global"):
+            dev = _global_take(dev, payload.params["n"], mesh)
+            continue
+        dev = _DevStreams([
+            stream_exec._stream_global(cs, payload, config, job_root,
+                                       stats=stats)
+            for cs in dev.streams])
+    return dev
 
 
 def _run_body(legs_out: List[_DevStreams], body: List[StageOp], config,
-              job_root) -> _DevStreams:
-    """Stage body per device over its (bucket-aligned) streams."""
+              job_root, mesh, stats=None) -> _DevStreams:
+    """Stage body over (bucket-aligned) per-device streams; per-device
+    ops stream independently, a global take coordinates via
+    ``_global_take``."""
     from dryad_tpu.exec import stream_exec
 
     dpp = len(legs_out[0].streams)
-    outs = []
-    for d in range(dpp):
-        cur = legs_out[0].streams[d]
-        rest = [ds.streams[d] for ds in legs_out[1:]]
-        for op in body:
-            if op.kind in ("join", "apply2", "semi_anti"):
+    cur = legs_out[0]
+    rest = list(legs_out[1:])
+    for op in body:
+        if op.kind in ("join", "apply2", "semi_anti"):
+            r = rest.pop(0)
+            outs = []
+            for d in range(dpp):
                 right_b, right_h = stream_exec._materialize_small(
-                    rest.pop(0), config, "right/build")
-                cur = stream_exec._stream_local(
-                    cur, [], config, extra_right=right_b,
-                    right_chunk=right_h, body_op=op)
-            elif op.kind == "concat":
-                cur = stream_exec._concat_sources(cur, rest.pop(0))
-            elif op.kind == "zip":
-                cur = stream_exec._zip_sources(
-                    cur, rest.pop(0), op.params.get("suffix", "_r"))
-            elif op.kind in _UNSUPPORTED:
-                raise StreamPlanError(
-                    f"op {op.kind!r} is not supported over cluster "
-                    f"streams: {_UNSUPPORTED[op.kind]}",
-                    code="DTA003", span=op.span)
-            elif op.kind == "take" and op.params.get("global"):
-                raise StreamPlanError(
-                    "global take over cluster streams is not supported",
-                    code="DTA001", span=op.span)
-            elif op.kind in stream_exec._STREAM_KINDS \
-                    or op.kind == "dgroup_merge":
-                cur = _body_stream_global(cur, op, config, job_root)
-            elif op.kind in stream_exec._LOCAL_KINDS:
-                cur = stream_exec._stream_local(cur, [op], config)
-            else:
-                raise StreamPlanError(
-                    f"op {op.kind!r} unsupported over cluster streams",
-                    code="DTA003", span=op.span)
-        outs.append(cur)
-    return _DevStreams(outs)
+                    r.streams[d], config, "right/build")
+                outs.append(stream_exec._stream_local(
+                    cur.streams[d], [], config, extra_right=right_b,
+                    right_chunk=right_h, body_op=op, stats=stats))
+            cur = _DevStreams(outs)
+        elif op.kind == "concat":
+            r = rest.pop(0)
+            cur = _DevStreams([
+                stream_exec._concat_sources(cur.streams[d], r.streams[d])
+                for d in range(dpp)])
+        elif op.kind == "zip":
+            r = rest.pop(0)
+            cur = _DevStreams([
+                stream_exec._zip_sources(cur.streams[d], r.streams[d],
+                                         op.params.get("suffix", "_r"))
+                for d in range(dpp)])
+        elif op.kind in _UNSUPPORTED:
+            raise StreamPlanError(
+                f"op {op.kind!r} is not supported over cluster "
+                f"streams: {_UNSUPPORTED[op.kind]}",
+                code="DTA003", span=op.span)
+        elif op.kind == "take" and op.params.get("global"):
+            cur = _global_take(cur, op.params["n"], mesh)
+        elif op.kind in stream_exec._STREAM_KINDS \
+                or op.kind == "dgroup_merge":
+            cur = _DevStreams([
+                _body_stream_global(cur.streams[d], op, config, job_root)
+                for d in range(dpp)])
+        elif op.kind in stream_exec._LOCAL_KINDS:
+            cur = _DevStreams([
+                stream_exec._stream_local(cur.streams[d], [op], config,
+                                          stats=stats)
+                for d in range(dpp)])
+        else:
+            raise StreamPlanError(
+                f"op {op.kind!r} unsupported over cluster streams",
+                code="DTA003", span=op.span)
+    return cur
 
 
 def _body_stream_global(cs, op: StageOp, config, job_root):
@@ -595,8 +673,12 @@ def execute_stream_plan(plan_json: str, fn_table, source_specs, mesh,
     import time
 
     results: Dict[int, _DevStreams] = {}
+    stage_stats: List[Tuple[int, Any, Dict[str, Any]]] = []
     for st in graph.topo_order():
         t0 = time.time()
+        # per-stage prefetch accounting: stalls measured while this
+        # stage's waves/legs drain surface on its stream_stage_done
+        stats = ooc.PrefetchStats()
         legs_out: List[_DevStreams] = []
         for leg in st.legs:
             if isinstance(leg.src, int):
@@ -610,11 +692,9 @@ def execute_stream_plan(plan_json: str, fn_table, source_specs, mesh,
                     code="DTA002")
             src = as_dev_streams(src)
             if leg.exchange is None:
-                streams = [
-                    _apply_whole_stream_ops(cs, list(leg.ops), config,
-                                            job_root)
-                    for cs in src.streams]
-                legs_out.append(_DevStreams(streams))
+                legs_out.append(_apply_leg_ops(src, list(leg.ops),
+                                               config, job_root, mesh,
+                                               stats=stats))
                 continue
             # split leg ops: whole-stream prefix runs host-side per
             # device; the trailing wave-fusable suffix rides the program
@@ -623,12 +703,10 @@ def execute_stream_plan(plan_json: str, fn_table, source_specs, mesh,
             while cut > 0 and ops[cut - 1].kind in _WAVE_FUSABLE:
                 cut -= 1
             pre, fus = ops[:cut], ops[cut:]
-            streams = src.streams
+            pre_dev = src
             if pre:
-                streams = [_apply_whole_stream_ops(cs, pre, config,
-                                                   job_root)
-                           for cs in streams]
-            pre_dev = _DevStreams(streams)
+                pre_dev = _apply_leg_ops(src, pre, config, job_root,
+                                         mesh, stats=stats)
             bounds = np.zeros((0,), np.uint32)
             if leg.exchange.kind == "range":
                 # sampled global quantile bounds (DryadLinqSampler.cs:42
@@ -652,11 +730,22 @@ def execute_stream_plan(plan_json: str, fn_table, source_specs, mesh,
                 for o in fus) else None
             legs_out.append(_run_leg_waves(pre_dev, fus, leg.exchange,
                                            mesh, config, bounds, compact,
-                                           job_root))
-        out = _run_body(legs_out, list(st.body), config, job_root)
+                                           job_root, stats=stats))
+        out = _run_body(legs_out, list(st.body), config, job_root, mesh,
+                        stats=stats)
         results[st.id] = out
+        snap = stats.snapshot()
         ev({"event": "stream_stage_done", "stage": st.id,
-            "label": st.label, "wall_s": round(time.time() - t0, 4)})
+            "label": st.label, "wall_s": round(time.time() - t0, 4),
+            "prefetch_stalls": snap["stalls"],
+            "prefetch_stall_s": snap["stall_s"]})
+        if snap["stalls"]:
+            ev({"event": "prefetch_stall", "stage": st.id, **snap})
+        # exchange-free stages compose LAZY streams: their prefetchers
+        # stall later, when the final drain (or a downstream stage's
+        # waves) actually pulls — keep the stats object so those late
+        # stalls can be reported after the drain instead of lost
+        stage_stats.append((st.id, stats, snap))
 
     final = results[graph.out_stage]
     extras: Dict[str, Any] = {}
@@ -709,6 +798,19 @@ def execute_stream_plan(plan_json: str, fn_table, source_specs, mesh,
                           partitioning=store_partitioning,
                           compression=store_compression,
                           capacity=final.chunk_rows)
+
+    # late stalls: every consumer path above has drained by now — emit
+    # the per-stage delta beyond what the stage's own stream_stage_done
+    # already carried (obs/analyze folds prefetch_stall events into the
+    # report TOTALS only, so this cannot double-count stage rows)
+    for sid, stats, snap in stage_stats:
+        late = stats.snapshot()
+        d_stalls = late["stalls"] - snap["stalls"]
+        if d_stalls > 0:
+            ev({"event": "prefetch_stall", "stage": sid,
+                "stalls": d_stalls,
+                "stall_s": round(late["stall_s"] - snap["stall_s"], 6),
+                "chunks": late["chunks"], "late": True})
 
     import shutil
     shutil.rmtree(job_root, ignore_errors=True)

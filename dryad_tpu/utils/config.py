@@ -115,6 +115,30 @@ class JobConfig:
     ooc_auto_stream_rows: int = 0
     # max rows the materialized build side of a streamed join may hold
     ooc_join_build_rows: int = 1 << 18
+    # host-IO prefetch depth for the chunk pipeline (exec/ooc.py
+    # prefetch_iter): a background thread pulls up to this many chunks
+    # ahead of the device, overlapping the next chunk's store read /
+    # ranged fetch / unpack with the current chunk's compute (the
+    # reference's completion-port double buffering,
+    # channelbuffernativereader.cpp).  0 disables (the A/B lever the
+    # regression guard keeps).
+    ooc_prefetch_depth: int = 2
+    # store-backed re-streaming cache tier for Dataset.cache() on
+    # streamed / edge-scale data (exec/ooc.py cache_source): the cold
+    # pass writes a LOCAL chunked cache (io/store layout, per-chunk
+    # fingerprints) keyed by the producing query's stable fingerprint;
+    # warm passes — iteration 2..N of do_while bodies, or a restarted
+    # job with an intact cache dir — re-stream from local sequential
+    # reads instead of ranged hdfs://, s3://, or http:// fetches.
+    # False restores the legacy behavior (device-/cluster-resident
+    # cache(); streamed cache() spools to an unvalidated temp store) —
+    # the cache-off A/B lever.
+    ooc_restream_cache: bool = True
+    # root directory for re-streaming cache entries.  None = a
+    # per-Context temp dir (removed at Context GC — warm iterations
+    # still hit, restarts do not); set a persistent path to let a
+    # restarted job with an intact cache dir skip the cold pass.
+    ooc_cache_dir: Optional[str] = None
 
     # -- cluster runtime (runtime/cluster.py) ------------------------------
     cluster_processes: int = 2
@@ -285,6 +309,7 @@ class JobConfig:
             (self.ooc_incore_bytes >= 0, "ooc_incore_bytes >= 0"),
             (self.ooc_auto_stream_rows >= 0, "ooc_auto_stream_rows >= 0"),
             (self.ooc_join_build_rows >= 1, "ooc_join_build_rows >= 1"),
+            (self.ooc_prefetch_depth >= 0, "ooc_prefetch_depth >= 0"),
             (self.cluster_processes >= 1, "cluster_processes >= 1"),
             (self.cluster_devices_per_process >= 1,
              "cluster_devices_per_process >= 1"),

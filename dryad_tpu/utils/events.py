@@ -37,6 +37,12 @@ _LEVELS = {
     # prediction and the runtime model-validation misses
     "cost_report": 1, "cost_model_miss": 1,
     "stream_stage_done": 1, "stream_tee_spill": 1, "job_done": 1,
+    # out-of-core re-streaming cache tier (exec/ooc.py + Dataset.cache):
+    # a cold cache write, a warm pass served from the local entry, and
+    # an entry invalidated by a chunk-fingerprint mismatch (falls back
+    # to a clean re-stream) are job-lifecycle grade; prefetch_stall is
+    # the "host IO was the bottleneck" chatter EXPLAIN ANALYZE folds in
+    "ooc_cache_write": 1, "ooc_cache_hit": 1, "ooc_cache_invalid": 1,
     "job_archived": 1, "diagnosis_skew": 1, "diagnosis_slow_worker": 1,
     # adaptive execution: an applied stage-graph rewrite is a scheduling
     # decision (level 1, dryad_tpu/adapt)
@@ -62,7 +68,7 @@ _LEVELS = {
     # and declined rewrites (dryad_tpu/adapt)
     "progress": 2, "task_duplicate_ignored": 2,
     "task_duplicate_failed_ignored": 2, "task_locality_dispatch": 2,
-    "span": 2, "resource_sample": 2,
+    "span": 2, "resource_sample": 2, "prefetch_stall": 2,
     "adapt_stats": 2, "adapt_skipped": 2,
 }
 

@@ -82,13 +82,16 @@ def _kv(ctx):
 # rule-by-rule
 
 
-def test_dta001_stream_global_take(ctx):
+def test_dta001_retired_global_take_streams(ctx):
+    """DTA001 is RETIRED: global take over cluster streams grew a real
+    lowering (runtime/stream_plan._global_take), so the analyzer must
+    not flag it on either path — and the code is gone from the rule
+    table entirely."""
+    from dryad_tpu.analysis.plan_rules import RULES
     q = _stream_ds(ctx).take(3)
-    rep = q.check(cluster=True)
-    assert "DTA001" in rep.codes()
-    assert all(d.severity == "error" for d in rep.by_code("DTA001"))
-    # local (non-cluster) streams support global take: no finding
+    assert "DTA001" not in q.check(cluster=True).codes()
     assert "DTA001" not in q.check(cluster=False).codes()
+    assert "DTA001" not in {r.code for r in RULES}
 
 
 def test_dta002_stream_placeholder(ctx):
@@ -451,8 +454,9 @@ def test_all_findings_one_pass_no_execution(ctx):
     finally:
         ctx.executor.run = orig_run
     codes = rep.codes()
-    assert {"DTA001", "DTA011", "DTA014", "DTA101"} <= codes
-    for code in ("DTA001", "DTA011", "DTA014", "DTA101"):
+    assert {"DTA011", "DTA014", "DTA101"} <= codes
+    assert "DTA001" not in codes          # retired: take streams now
+    for code in ("DTA011", "DTA014", "DTA101"):
         assert any(d.span is not None for d in rep.by_code(code)), code
     # one report carries everything, sorted errors-first
     sevs = [d.severity for d in rep]
@@ -634,7 +638,8 @@ def test_check_plan_json_and_cli(ctx, tmp_path):
     rep = check_plan_json(js)
     assert "DTA905" in rep.codes()
     rep_s = check_plan_json(js, stream=True)
-    assert {"DTA905", "DTA001"} <= rep_s.codes()
+    assert "DTA905" in rep_s.codes()
+    assert "DTA001" not in rep_s.codes()   # retired: take streams now
 
     from dryad_tpu.analysis.__main__ import main
     p = tmp_path / "plan.json"

@@ -258,16 +258,37 @@ def test_dta203_unbounded_fanout_at_exchange():
 
 
 def test_dta204_edge_scale_cache_warn():
-    """cache() pinning a sizable fraction of HBM for the Context's
-    lifetime is flagged toward the streamed/store path (lint event,
-    never a gate failure: cache() still works)."""
+    """cache() of edge-scale data: with the re-streaming cache tier ON
+    (default) the finding is INFO and the cache LOWERS to a local
+    chunked store (the cached dataset streams); with the tier OFF it
+    WARNS and the result pins device memory (legacy).  Never a gate
+    failure: cache() works either way."""
     log = EventLog(level=2)
     ctx = _ctx(log, device_hbm_bytes=1 << 20)
     big = ctx.from_columns({"x": np.zeros((64, 4096), np.float32)})
-    big.cache()
+    cached = big.cache()
     found = [e for e in log.events
              if e["event"] == "lint_finding" and e["code"] == "DTA204"]
-    assert found and all(e["severity"] == "warn" for e in found)
+    assert found and all(e["severity"] == "info" for e in found)
+    assert "re-streaming cache tier" in found[0]["message"]
+    # the lowering really happened: the cached dataset is streamed, a
+    # cold cache write was recorded, and the rows survive intact
+    assert cached._streaming()
+    assert any(e["event"] == "ooc_cache_write" for e in log.events)
+    out = cached.collect()
+    assert np.asarray(out["x"]).shape == (64, 4096)
+    # tier off (the A/B lever): legacy warn + device-resident cache
+    log_off = EventLog(level=2)
+    ctx_off = _ctx(log_off, device_hbm_bytes=1 << 20,
+                   ooc_restream_cache=False)
+    big_off = ctx_off.from_columns({"x": np.zeros((64, 4096),
+                                                  np.float32)})
+    cached_off = big_off.cache()
+    found_off = [e for e in log_off.events
+                 if e["event"] == "lint_finding"
+                 and e["code"] == "DTA204"]
+    assert found_off and all(e["severity"] == "warn" for e in found_off)
+    assert not cached_off._streaming()
     # a small cache stays silent
     log2 = EventLog(level=2)
     ctx2 = _ctx(log2, device_hbm_bytes=1 << 30)

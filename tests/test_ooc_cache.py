@@ -1,0 +1,328 @@
+"""Re-streaming chunk cache + async prefetch pipeline (ISSUE 14
+tentpole): Dataset.cache() on streamed data lowers to a fingerprinted
+LOCAL chunked cache (io/store layout, per-chunk fnv64 fingerprints —
+the spill-sidecar format), warm passes re-stream local sequential
+reads, corruption/staleness falls back to a clean re-stream (never
+wrong rows), and the bounded background-thread prefetcher overlaps the
+next chunk's host IO with the current chunk's device compute."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu import Context
+from dryad_tpu.exec import ooc
+from dryad_tpu.utils.config import JobConfig
+from dryad_tpu.utils.events import EventLog
+
+CHUNK = 512
+N = 8000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(7)
+    return {"k": rng.randint(0, 40, N).astype(np.int32),
+            "v": rng.randint(-1000, 1000, N).astype(np.int32)}
+
+
+@pytest.fixture(scope="module")
+def store(data, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("cache") / "src")
+    Context().from_columns(data).to_store(path)
+    return path
+
+
+def _ctx(cache_dir, log=None, **over):
+    cfg = JobConfig(ooc_chunk_rows=CHUNK, ooc_cache_dir=str(cache_dir),
+                    **over)
+    return Context(config=cfg, event_log=log)
+
+
+# ---------------------------------------------------------------------------
+# cache tier: cold write / warm hits / restart / invalidation
+
+
+def test_restream_cache_cold_write_then_warm_hits(store, data, tmp_path):
+    log = EventLog(level=2)
+    ctx = _ctx(tmp_path / "cc", log)
+    ds = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+          .where(lambda c: c["v"] > 0).cache())
+    exp_rows = int((data["v"] > 0).sum())
+    assert len(ds.collect()["v"]) == exp_rows          # pass 1 (cached)
+    assert ds.count() == exp_rows                      # pass 2 (cached)
+    kinds = [e["event"] for e in log.events
+             if e["event"].startswith("ooc_cache")]
+    assert kinds.count("ooc_cache_write") == 1
+    assert kinds.count("ooc_cache_hit") >= 2           # one per pass
+    # entry is the io/store layout with per-chunk checksums + sidecar
+    entries = glob.glob(str(tmp_path / "cc" / "ooc-cache-*"))
+    assert len(entries) == 1
+    assert os.path.exists(os.path.join(entries[0], "data", "meta.json"))
+    assert os.path.exists(os.path.join(entries[0], "cache.json"))
+
+
+def test_restream_cache_restart_skips_cold_pass(store, data, tmp_path):
+    """A restarted job (fresh Context/process state) with an intact
+    cache dir skips the cold pass entirely: same key, warm hit, zero
+    ooc_cache_write."""
+    cc = tmp_path / "cc"
+    ctx1 = _ctx(cc)
+    ds1 = (ctx1.read_store_stream(store, chunk_rows=CHUNK)
+           .where(lambda c: c["v"] > 0).cache())
+    n1 = ds1.count()
+    log2 = EventLog(level=2)
+    ctx2 = _ctx(cc, log2)       # "restart": a fresh Context, same dir
+    ds2 = (ctx2.read_store_stream(store, chunk_rows=CHUNK)
+           .where(lambda c: c["v"] > 0).cache())
+    assert ds2.count() == n1
+    kinds = [e["event"] for e in log2.events
+             if e["event"].startswith("ooc_cache")]
+    assert "ooc_cache_write" not in kinds
+    assert "ooc_cache_hit" in kinds
+
+
+def test_restart_stable_for_derived_cache(store, tmp_path):
+    """A query DERIVED from a cached stream — the pagerank_stream shape
+    deg = edges.cache().group_by(...).cache() — must also be
+    restart-stable: the cached stream's ChunkSource carries its entry
+    key as a content fingerprint, so the derived key cannot degrade to
+    the process salt (which would cold-write every derived entry on
+    restart)."""
+    cc = tmp_path / "cc"
+
+    def job(log=None):
+        ctx = _ctx(cc, log)
+        edges = ctx.read_store_stream(store, chunk_rows=CHUNK).cache()
+        deg = edges.group_by(["k"], {"n": ("count", None)}).cache()
+        return deg.count()
+
+    n1 = job()
+    log2 = EventLog(level=2)
+    assert job(log2) == n1
+    kinds = [e["event"] for e in log2.events
+             if e["event"].startswith("ooc_cache")]
+    assert "ooc_cache_write" not in kinds       # BOTH entries warm
+    # exactly one hit: the warm DERIVED entry serves directly, so the
+    # upstream edges cache is never even pulled — its hit only fires
+    # when some consumer actually streams it
+    assert kinds.count("ooc_cache_hit") == 1
+
+
+def test_corrupt_cache_chunk_falls_back_to_clean_restream(
+        store, data, tmp_path):
+    """THE integrity contract: a chunk whose bytes no longer match its
+    recorded fingerprint invalidates the entry mid-stream and the rows
+    come from a clean re-stream of the producer — row-exact, never
+    wrong rows."""
+    log = EventLog(level=2)
+    ctx = _ctx(tmp_path / "cc", log)
+    ds = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+          .where(lambda c: c["v"] > 0).cache())
+    ds.count()                      # cold write
+    parts = sorted(glob.glob(str(tmp_path / "cc") +
+                             "/*/data/part-*.bin"))
+    assert len(parts) > 3
+    with open(parts[2], "r+b") as f:    # flip bytes mid-entry
+        f.seek(8)
+        f.write(b"\xde\xad\xbe\xef")
+    out = ds.collect()
+    assert any(e["event"] == "ooc_cache_invalid" for e in log.events)
+    exp = sorted(data["v"][data["v"] > 0].tolist())
+    assert sorted(np.asarray(out["v"]).tolist()) == exp
+    # the wiped entry self-repairs on the next pass (fresh cold write)
+    n2 = ds.count()
+    assert n2 == len(exp)
+    assert sum(1 for e in log.events
+               if e["event"] == "ooc_cache_write") == 2
+
+
+def test_stale_cache_key_misses_on_changed_source(data, tmp_path):
+    """Changed SOURCE BYTES change the cache key (the key folds in the
+    store's per-partition checksums): a rewritten store can never be
+    served stale rows from an old entry."""
+    sp = str(tmp_path / "src")
+    Context().from_columns(data).to_store(sp)
+    cc = tmp_path / "cc"
+    ctx = _ctx(cc)
+    assert (ctx.read_store_stream(sp, chunk_rows=CHUNK).cache()
+            .sum("v")) == int(data["v"].sum())
+    # rewrite the store with DIFFERENT data at the same path
+    new = {"k": data["k"], "v": (data["v"] * 3).astype(np.int32)}
+    Context().from_columns(new).to_store(sp)
+    ctx2 = _ctx(cc)
+    got = ctx2.read_store_stream(sp, chunk_rows=CHUNK).cache().sum("v")
+    assert got == int(new["v"].sum())
+    # two distinct entries now exist (old key + new key)
+    assert len(glob.glob(str(cc / "ooc-cache-*"))) == 2
+
+
+def test_cache_off_lever_restores_legacy_path(store, data, tmp_path):
+    """ooc_restream_cache=False (the A/B lever): streamed cache() takes
+    the legacy unvalidated temp-store path — no cache events, no
+    entries under the cache root — and stays correct."""
+    log = EventLog(level=2)
+    ctx = _ctx(tmp_path / "cc", log, ooc_restream_cache=False)
+    ds = (ctx.read_store_stream(store, chunk_rows=CHUNK)
+          .where(lambda c: c["v"] > 0).cache())
+    assert ds.count() == int((data["v"] > 0).sum())
+    assert not any(e["event"].startswith("ooc_cache")
+                   for e in log.events)
+    assert glob.glob(str(tmp_path / "cc" / "ooc-cache-*")) == []
+
+
+def test_cache_key_stable_across_processes(store, tmp_path):
+    """The cache key must be restart-stable for store-backed queries
+    (bytecode-fingerprinted UDFs + content-fingerprinted sources): a
+    subprocess computing the same query's key gets the same hash."""
+    import subprocess
+    import sys
+    prog = f"""
+import numpy as np
+from dryad_tpu import Context
+from dryad_tpu.api.dataset import _stable_node_fp
+from dryad_tpu.utils.config import JobConfig
+ctx = Context(config=JobConfig(ooc_chunk_rows={CHUNK}))
+ds = ctx.read_store_stream({store!r}, chunk_rows={CHUNK}).distinct(["k"])
+print(_stable_node_fp(ds.node))
+"""
+    keys = set()
+    for _ in range(2):
+        out = subprocess.run(
+            [sys.executable, "-c", prog],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, check=True)
+        keys.add(out.stdout.strip().splitlines()[-1])
+    assert len(keys) == 1
+
+
+# ---------------------------------------------------------------------------
+# prefetch pipeline
+
+
+def test_prefetch_iter_order_and_exceptions():
+    from dryad_tpu.exec.ooc import PrefetchStats, prefetch_iter
+
+    # order-preserving at any depth, passthrough at depth 0
+    for depth in (0, 1, 2, 4):
+        assert list(prefetch_iter(iter(range(100)), depth)) \
+            == list(range(100))
+    # producer exceptions surface in the consumer
+
+    def boom():
+        yield 1
+        yield 2
+        raise RuntimeError("io died")
+
+    got = []
+    with pytest.raises(RuntimeError, match="io died"):
+        for x in prefetch_iter(boom(), 2):
+            got.append(x)
+    assert got == [1, 2]
+    # early consumer abandonment does not wedge (producer unblocks)
+    stats = PrefetchStats()
+    it = prefetch_iter(iter(range(10_000)), 2, stats)
+    assert next(it) == 0
+    it.close()
+    # stats count consumed chunks
+    assert stats.snapshot()["chunks"] >= 1
+
+
+def test_prefetch_off_lever_identical_rows(store, data, tmp_path):
+    """ooc_prefetch_depth=0 (the A/B lever) produces byte-identical
+    results to the prefetched pipeline."""
+    outs = []
+    for depth in (0, 2):
+        ctx = Context(config=JobConfig(ooc_chunk_rows=CHUNK,
+                                       ooc_prefetch_depth=depth))
+        outs.append(ctx.read_store_stream(store, chunk_rows=CHUNK)
+                    .group_by(["k"], {"s": ("sum", "v")})
+                    .order_by([("k", False)]).collect())
+    np.testing.assert_array_equal(np.asarray(outs[0]["k"]),
+                                  np.asarray(outs[1]["k"]))
+    np.testing.assert_array_equal(np.asarray(outs[0]["s"]),
+                                  np.asarray(outs[1]["s"]))
+
+
+def test_prefetch_stall_event_and_analyze_fold(tmp_path):
+    """A deliberately slow producer stalls the pipeline: the streamed
+    run emits ONE prefetch_stall summary, metrics_from_events derives
+    dryad_ooc_prefetch_stalls_total, and EXPLAIN ANALYZE's report folds
+    cache hits + stalls into its totals."""
+    import time
+
+    from dryad_tpu.exec.ooc import ChunkSource
+    from dryad_tpu.obs.analyze import AnalyzeReport, analyze_events
+    from dryad_tpu.obs.metrics import metrics_from_events
+
+    def gen(i):
+        time.sleep(0.02)          # IO slower than compute: must stall
+        return {"v": np.arange(64, dtype=np.int32) + i}
+
+    log = EventLog(level=2)
+    ctx = Context(config=JobConfig(ooc_chunk_rows=64), event_log=log)
+    cs = ChunkSource.from_generator(gen, 12, 64)
+    out = ctx.from_stream(cs).select(
+        lambda c: {"v": c["v"] * 2}).collect()
+    assert len(out["v"]) == 12 * 64
+    stalls = [e for e in log.events if e["event"] == "prefetch_stall"]
+    assert stalls and stalls[0]["stalls"] >= 1
+    assert stalls[0]["stall_s"] > 0
+    # derived metrics family
+    reg = metrics_from_events(log.events)
+    assert "dryad_ooc_prefetch_stalls_total" in reg.render()
+    # analyze fold-in + payload round trip
+    evs = list(log.events) + [
+        {"event": "ooc_cache_hit", "path": "x"},
+        {"event": "ooc_cache_write", "path": "x", "rows": 1}]
+    rep = analyze_events(evs)
+    assert rep.prefetch_stalls >= 1 and rep.prefetch_stall_s > 0
+    assert rep.ooc_cache_hits == 1 and rep.ooc_cache_writes == 1
+    back = AnalyzeReport.from_payload(rep.to_payload())
+    assert back.prefetch_stalls == rep.prefetch_stalls
+    assert back.ooc_cache_hits == rep.ooc_cache_hits
+    assert "stream cache hit" in rep.render()
+
+
+def test_ooc_cache_metrics_derived(store, tmp_path):
+    from dryad_tpu.obs.metrics import metrics_from_events
+
+    log = EventLog(level=2)
+    ctx = _ctx(tmp_path / "cc", log)
+    ds = ctx.read_store_stream(store, chunk_rows=CHUNK).cache()
+    ds.count()
+    ds.count()
+    reg = metrics_from_events(log.events)
+    txt = reg.render()
+    assert "dryad_ooc_cache_hits_total" in txt
+    assert "dryad_ooc_cache_writes_total 1" in txt
+
+
+# ---------------------------------------------------------------------------
+# global take over per-device streams (the cluster lowering's core,
+# exercised in-process: nprocs=1 short-circuits the allgather)
+
+
+def test_global_take_device_major_prefix():
+    from dryad_tpu.runtime.stream_plan import _DevStreams, _global_take
+
+    def mk(vals, chunk=3):
+        return ooc.ChunkSource.from_arrays(
+            {"v": np.asarray(vals, np.int32)}, chunk)
+
+    dev = _DevStreams([mk(range(0, 7)), mk(range(100, 105))])
+    out = _global_take(dev, 9, mesh=None)
+    rows = [c.cols["v"].tolist() for cs in out.streams for c in cs]
+    assert [x for r in rows for x in r] == [0, 1, 2, 3, 4, 5, 6,
+                                            100, 101]
+    # n past the total keeps everything; tiny n trims the first device
+    assert sum(c.n for cs in _global_take(dev, 99, None).streams
+               for c in cs) == 12
+    out2 = _global_take(dev, 2, mesh=None)
+    assert [c.cols["v"].tolist() for cs in out2.streams
+            for c in cs] == [[0, 1]]
+    # result streams stay re-iterable (ChunkSource contract)
+    cs0 = out.streams[0]
+    assert sum(c.n for c in cs0) == sum(c.n for c in cs0) == 7

@@ -163,6 +163,51 @@ def test_read_store_stream_ranged(srv, force_ranged):
     assert all("length" in q for q in opens)
 
 
+def test_ranged_reads_retry_transient_midstream(srv, force_ranged,
+                                                monkeypatch):
+    """A transient provider failure DURING a ranged chunk stream (an
+    error class the per-request retries can miss: empty body /
+    truncated stream / dropped datanode connection) re-issues the range
+    through the shared retry/backoff path (io/providers.retry_transient)
+    instead of killing the streamed job — and a definite 4xx stays
+    fatal."""
+    from dryad_tpu.io.webhdfs import WebHdfsClient, WebHdfsError
+
+    Context().from_columns(_table()).to_store(srv.url + "/stores/rt")
+    real_open = WebHdfsClient.open
+    fails = {"n": 3}
+
+    def flaky_open(self, path, offset=0, length=None):
+        if fails["n"] > 0 and offset > 0:
+            fails["n"] -= 1
+            raise WebHdfsError("synthetic transient mid-stream drop")
+        return real_open(self, path, offset=offset, length=length)
+
+    monkeypatch.setattr(WebHdfsClient, "open", flaky_open)
+    out = (Context().read_store_stream(srv.url + "/stores/rt",
+                                       chunk_rows=64)
+           .where(lambda c: c["v"] % 2 == 0).collect())
+    assert fails["n"] == 0          # the transient really fired
+    assert sorted(np.asarray(out["v"]).tolist()) == list(range(0, 500, 2))
+
+    # 4xx (definite) errors do NOT retry: they surface immediately
+    calls = {"n": 0}
+
+    def notfound_open(self, path, offset=0, length=None):
+        if offset > 0:
+            calls["n"] += 1
+            raise WebHdfsError("gone", status=404)
+        return real_open(self, path, offset=offset, length=length)
+
+    monkeypatch.setattr(WebHdfsClient, "open", notfound_open)
+    with pytest.raises(WebHdfsError):
+        Context().read_store_stream(srv.url + "/stores/rt",
+                                    chunk_rows=64).collect()
+    # one failure per concurrently fetched segment, NO retries (a
+    # retried 404 would show 4x the calls)
+    assert calls["n"] <= 4
+
+
 def test_read_store_stream_small_parts_verified(srv, client):
     """Below the ranged-streaming threshold, hdfs streamed reads keep
     their checksum protection: a flipped byte raises StoreIntegrityError

@@ -36,7 +36,8 @@ _V1 = "/webhdfs/v1"
 class FakeWebHdfs:
     def __init__(self, block_size: int = 256 << 10,
                  block_hosts: Optional[Callable[[str, int], List[str]]]
-                 = None):
+                 = None, latency_s: float = 0.0,
+                 throttle_bps: float = 0.0):
         self.files: Dict[str, bytes] = {}
         self.dirs = {"/"}
         self.block_size = block_size
@@ -44,6 +45,12 @@ class FakeWebHdfs:
                             or (lambda path, i: [f"datanode-{i % 3}"]))
         self.datanode_hits: List[Tuple[str, str, Dict[str, str]]] = []
         self.fail_next: Dict[str, int] = {}
+        # simulated per-request RTT and response bandwidth cap
+        # (bench.py --smoke-ooc uses these so a loopback fake behaves
+        # like a REMOTE namenode/datanode — RAM-to-loopback serves bytes
+        # at a rate no networked store reaches; 0 = off)
+        self.latency_s = latency_s
+        self.throttle_bps = throttle_bps
         srv = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -55,6 +62,11 @@ class FakeWebHdfs:
             # -- plumbing --------------------------------------------------
             def _reply(self, status: int, body: bytes = b"",
                        headers: Tuple[Tuple[str, str], ...] = ()):
+                if srv.latency_s or (srv.throttle_bps and body):
+                    import time
+                    time.sleep(srv.latency_s
+                               + (len(body) / srv.throttle_bps
+                                  if srv.throttle_bps else 0.0))
                 self.send_response(status)
                 for k, v in headers:
                     self.send_header(k, v)
