@@ -434,6 +434,136 @@ def smoke_sql(out_path="BENCH_sql.json", n_rows=None, reps=None,
     return out
 
 
+def smoke_inc(out_path="BENCH_inc.json", n_rows=None, rounds=None,
+              reps=None, quiet=False):
+    """Continuous-query smoke (``python bench.py --smoke`` /
+    ``--smoke-inc``): a standing group-sum query over a store growing
+    5% per round — each round measures one INCREMENTAL refresh
+    (watermark-scoped delta scan + host merge into persisted state,
+    dryad_tpu/inc) against a FULL re-run of the same statement over the
+    whole store, INTERLEAVED >=3 reps, median walls (the PR-4
+    protocol).  The rows must be BIT-IDENTICAL every round — the
+    decomposable-merge correctness claim is the point, the wall-clock
+    ratio is the payoff (ISSUE-16 bar: warm refresh >= 2x faster than
+    the full re-run at 5% growth).  Written to ``BENCH_inc.json`` +
+    appended to ``BENCH_trend.jsonl`` (app ``bench-inc``)."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from dryad_tpu import sql
+    from dryad_tpu.api.dataset import Context
+    from dryad_tpu.inc import state as inc_state
+    from dryad_tpu.inc.refresh import run_refresh
+    from dryad_tpu.io.store import append_store, store_meta
+
+    n_rows = n_rows or int(os.environ.get("BENCH_INC_ROWS", "200000"))
+    rounds = rounds or int(os.environ.get("BENCH_INC_ROUNDS", "3"))
+    reps = max(3, reps or int(os.environ.get("BENCH_INC_REPS", "3")))
+    growth = 0.05
+    n_keys = 64
+
+    tmp = tempfile.mkdtemp(prefix="dryad-bench-inc-")
+    store = os.path.join(tmp, "store")
+    state_dir = os.path.join(tmp, "state")
+    ctx = Context(install_trace=False)
+
+    def batch(n, seed):
+        r = np.random.RandomState(seed)
+        return {"k": r.randint(0, n_keys, n).astype(np.int32),
+                "v": r.randint(0, 1000, n).astype(np.int32)}
+
+    ctx.from_columns(batch(n_rows, 1)).to_store(store)
+    cat = sql.Catalog().register_store("t", store)
+    query = ("SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t "
+             "GROUP BY k EMIT EVERY 1")
+    norm = sql.normalize_query(query)
+    _mode, bound = sql.compile_query(cat, query)
+    full_bound = sql.compile_query(
+        cat, "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t GROUP BY k")[1]
+    sp = inc_state.state_path(
+        state_dir, inc_state.state_key(norm, "t", store,
+                                       store_meta(store)["schema"]))
+
+    def full_run():
+        ds, _ = sql.lower(ctx, cat, full_bound)
+        return ds.collect()
+
+    def rows_of(table):
+        return sorted(zip(np.asarray(table["k"]).tolist(),
+                          np.asarray(table["s"]).tolist(),
+                          np.asarray(table["c"]).tolist()))
+
+    # round 0 builds the initial state (the one full-priced refresh);
+    # warmup for both sides' compile caches too
+    run_refresh(ctx, cat, bound, norm, state_dir)
+    full_run()
+
+    identical = True
+    per_round = []
+    inc_medians, full_medians = [], []
+    for rnd in range(rounds):
+        n_new = max(1, int(n_rows * growth))
+        append_store(store, ctx.from_columns(
+            batch(n_new, 100 + rnd)).node.data)
+        snap = sp + ".snap"
+        shutil.copyfile(sp, snap)      # pre-append committed state
+        wi, wf = [], []
+        res = None
+        full_table = None
+        for _ in range(reps):
+            # interleaved: each rep restores the pre-append state so
+            # every incremental run merges the SAME 5% delta
+            shutil.copyfile(snap, sp)
+            t0 = time.time()
+            res = run_refresh(ctx, cat, bound, norm, state_dir)
+            wi.append(time.time() - t0)
+            t0 = time.time()
+            full_table = full_run()
+            wf.append(time.time() - t0)
+        os.unlink(snap)
+        same = rows_of(res.table) == rows_of(full_table)
+        identical = identical and same
+        mi, mf = statistics.median(wi), statistics.median(wf)
+        inc_medians.append(mi)
+        full_medians.append(mf)
+        per_round.append({
+            "round": rnd + 1, "appended_rows": n_new,
+            "mode": res.mode, "delta_parts": len(res.delta_parts),
+            "delta_rows": res.delta_rows,
+            "wall_s_incremental": round(mi, 4),
+            "wall_s_full": round(mf, 4),
+            "rows_identical": same})
+    inc_s = statistics.median(inc_medians)
+    full_s = statistics.median(full_medians)
+    out = {
+        "metric": "inc smoke (standing group-sum: incremental refresh "
+                  "vs full rescan, store growing 5%/round)",
+        "rows": n_rows, "rounds": rounds, "reps": reps,
+        "growth_pct": 5.0, "query": norm,
+        "wall_s_incremental": round(inc_s, 4),
+        "wall_s_full": round(full_s, 4),
+        "speedup_x": (round(full_s / inc_s, 2) if inc_s > 0 else None),
+        "rows_identical": identical,
+        "per_round": per_round,
+    }
+    shutil.rmtree(tmp, ignore_errors=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-inc",
+            "wall_s": round(inc_s, 4),
+            "full_wall_s": round(full_s, 4),
+            "speedup_x": out["speedup_x"], "rows": n_rows,
+            "rounds": rounds, "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def smoke_analyze(out_path="BENCH_analyze.json", n_lines=None,
                   reps=None, quiet=False):
     """EXPLAIN ANALYZE smoke (``python bench.py --smoke-analyze``, also
@@ -1822,6 +1952,9 @@ if __name__ == "__main__":
     elif "--smoke-ooc" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-ooc"]
         smoke_ooc(out_path=args[0] if args else "BENCH_ooc.json")
+    elif "--smoke-inc" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-inc"]
+        smoke_inc(out_path=args[0] if args else "BENCH_inc.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
@@ -1842,6 +1975,8 @@ if __name__ == "__main__":
         smoke_analyze(out_path=os.path.join(base, "BENCH_analyze.json"),
                       quiet=True)
         smoke_ooc(out_path=os.path.join(base, "BENCH_ooc.json"),
+                  quiet=True)
+        smoke_inc(out_path=os.path.join(base, "BENCH_inc.json"),
                   quiet=True)
     else:
         main()

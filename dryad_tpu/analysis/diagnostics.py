@@ -17,6 +17,9 @@ Code space:
 * DTA3xx — SQL front end (dryad_tpu/sql: lexer/parser/binder errors whose
   spans point INTO THE QUERY TEXT as line:column — the file slot of the
   Span holds the query's origin, e.g. ``<sql>`` or a ``.sql`` path)
+* DTA4xx — incremental execution (dryad_tpu/inc: info-grade verdicts on
+  how a standing query's refresh runs — incremental merge into persisted
+  state vs full re-run — shown by EXPLAIN and carried on refresh events)
 * DTA9xx — runtime-only conditions (data-dependent overflows, internal
   invariants, worker-side deploy errors) that no static rule can predict
 """
@@ -79,6 +82,20 @@ CODES = {
               "alias)",
     "DTA305": "type mismatch in SQL expression",
     "DTA306": "unsupported SQL construct",
+    "DTA307": "invalid standing query (EMIT EVERY misuse: non-positive "
+              "interval, or a base table that cannot grow)",
+    # -- incremental execution (DTA4xx, dryad_tpu/inc) ---------------------
+    # info-grade verdicts of the standing-query planner: how a refresh
+    # will execute, surfaced by EXPLAIN and carried on refresh events
+    "DTA401": "standing query runs incrementally (decomposable "
+              "aggregate suffix merges new chunks into persisted "
+              "state)",
+    "DTA402": "standing query falls back to full re-run (suffix not "
+              "decomposable: join / DISTINCT / ORDER BY / LIMIT / "
+              "HAVING over the growing table)",
+    "DTA403": "cost model chose a full re-run for this refresh (the "
+              "chunk delta is most of the store — state is rebuilt, "
+              "not merged)",
     # -- runtime-only (DTA9xx) ---------------------------------------------
     "DTA901": "internal: op kind cannot ride a wave program",
     "DTA902": "internal: unknown exchange kind in streamed plan",
@@ -243,6 +260,8 @@ _CODE_FAMILIES = (
              "forecasts)"),
     ("DTA3", "SQL front end (parse / bind / type errors with "
              "line:column spans into the query text)"),
+    ("DTA4", "incremental execution (standing-query refresh verdicts: "
+             "incremental merge vs full re-run)"),
     ("DTA9", "runtime-only (no static rule can predict these)"),
 )
 

@@ -1,0 +1,26 @@
+"""Incremental execution: standing queries over growing stores.
+
+The streaming door the reference never opened — Dryad/DryadLINQ runs
+every job once to completion (PAPER.md layer 4); here the SAME batch
+plan becomes a *standing query*: append-aware store manifests
+(io/store.py generations) scope each refresh's scan to the chunks that
+arrived since the last committed watermark, and plans whose aggregate
+suffix is decomposable ``merge`` the partial result into a persisted,
+fingerprint-keyed aggregate state instead of rescanning the world.
+
+* :mod:`dryad_tpu.inc.delta_plan` — the static verdict (DTA4xx): can
+  this plan's suffix merge incrementally, and how do persisted state
+  columns finalize into the query's outputs?
+* :mod:`dryad_tpu.inc.state` — the atomic state+watermark commit
+  (one ``os.replace``, same rename discipline as store writes).
+* :mod:`dryad_tpu.inc.refresh` — one refresh: delta scan through the
+  normal SQL lowering, host-side Decomposable merge, finalize, commit.
+* :mod:`dryad_tpu.inc.standing` — the service-resident registry and
+  scheduler: ``SELECT ... EMIT EVERY n`` registrations persist across
+  daemon restarts and resume from the last committed watermark.
+"""
+
+from dryad_tpu.inc.delta_plan import DeltaPlan, plan_delta
+from dryad_tpu.inc.refresh import RefreshResult, run_refresh
+
+__all__ = ["DeltaPlan", "plan_delta", "RefreshResult", "run_refresh"]

@@ -1,0 +1,76 @@
+"""Persisted standing-query state: one atomic state+watermark unit.
+
+Fingerprint-keyed like the OOC chunk cache (exec/ooc.py): the key
+hashes the normalized query text, the base table's name/path and its
+schema — NOT its row counts, which grow with every append — so a
+daemon restart (or a brand-new process) finds the same state file for
+the same standing query.
+
+The file is a single ``.npz`` holding the group-key columns, the raw
+state-aggregate columns (engine dtypes preserved — the merge must add
+in exactly the dtype the engine sums in, or incremental and full-scan
+results drift), and a JSON meta entry carrying the WATERMARK.  Commit
+is write-temp + ``os.replace``: state and watermark move as ONE atomic
+unit, so a crash mid-refresh leaves the previous (state, watermark)
+pair intact and the next refresh re-scans exactly the uncommitted
+delta — chunks are never double-counted and never skipped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["state_key", "state_path", "load_state", "commit_state"]
+
+_META = "__meta__"
+_COL = "c:"
+
+
+def state_key(norm_query: str, table: str, path: Optional[str],
+              schema: Dict[str, Any]) -> str:
+    """16-hex fingerprint naming one standing query's state file."""
+    blob = json.dumps({"sql": norm_query, "table": table,
+                       "path": path, "schema": schema},
+                      sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def state_path(state_dir: str, key: str) -> str:
+    return os.path.join(state_dir, f"state-{key}.npz")
+
+
+def load_state(path: str) -> Optional[Tuple[int, Dict[str, Any]]]:
+    """``(watermark, columns)`` of a committed state file, or None when
+    no refresh has ever committed.  String key columns come back as
+    ``S``-dtype arrays; numeric columns in their committed dtypes."""
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(bytes(z[_META]).decode())
+        cols = {name: np.array(z[_COL + name])
+                for name in meta["columns"]}
+    return int(meta["watermark"]), cols
+
+
+def commit_state(path: str, watermark: int,
+                 columns: Dict[str, Any]) -> None:
+    """Atomically publish ``(watermark, columns)`` — see module
+    docstring.  ``columns`` values are numpy arrays (string columns as
+    ``S`` dtype) of equal length."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays = {_META: np.frombuffer(
+        json.dumps({"watermark": int(watermark),
+                    "columns": sorted(columns)}).encode(), np.uint8)}
+    for name, arr in columns.items():
+        arrays[_COL + name] = np.asarray(arr)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

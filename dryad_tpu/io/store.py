@@ -30,7 +30,8 @@ from dryad_tpu.exec.data import PData
 
 __all__ = ["write_store", "read_store", "store_meta", "build_meta",
            "schema_row_bytes", "StoreIntegrityError", "is_remote_store",
-           "remote_read_part_views"]
+           "remote_read_part_views", "append_store", "store_generation",
+           "parts_since"]
 
 _FORMAT_VERSION = 3
 
@@ -80,7 +81,10 @@ def build_meta(schema: Dict[str, Any], counts: List[int],
                checksums: List[str],
                partitioning: Optional[Dict[str, Any]] = None,
                compression: Optional[str] = None,
-               capacity: Optional[int] = None) -> Dict[str, Any]:
+               capacity: Optional[int] = None,
+               generation: int = 0,
+               part_generations: Optional[List[int]] = None
+               ) -> Dict[str, Any]:
     """The ONE meta.json constructor — every writer (in-memory write_store,
     streamed write_chunks_to_store, cluster parallel partition writers)
     goes through it, so format_version / field skew cannot happen.
@@ -91,7 +95,14 @@ def build_meta(schema: Dict[str, Any], counts: List[int],
     items 1 and 4) can size jobs without opening a single partition
     file.  The static cost analyzer seeds its intervals from the
     manifest's ``counts`` + ``schema`` riding store_spec
-    (runtime/sources.py -> analysis/cost._source_state)."""
+    (runtime/sources.py -> analysis/cost._source_state).
+
+    ``generation`` / ``part_generations`` make the manifest append-
+    aware for continuous queries (dryad_tpu/inc): a fresh write is
+    generation 0, every :func:`append_store` commit bumps it, and
+    ``part_generations[p]`` records the generation that added partition
+    p — so a standing-query refresh holding watermark W scopes its scan
+    to ``parts_since(meta, W)`` without touching old partition files."""
     rb = schema_row_bytes(schema)
     return {
         "format_version": _FORMAT_VERSION,
@@ -106,7 +117,26 @@ def build_meta(schema: Dict[str, Any], counts: List[int],
         "checksum_algo": "fnv64",
         "checksums": checksums,
         "native_io": native.available(),
+        "generation": int(generation),
+        "part_generations": (list(part_generations)
+                             if part_generations is not None
+                             else [0] * len(counts)),
     }
+
+
+def store_generation(meta: Dict[str, Any]) -> int:
+    """Monotonic append watermark of a manifest (0 for stores written
+    before the field existed — they have never been appended to)."""
+    return int(meta.get("generation", 0))
+
+
+def parts_since(meta: Dict[str, Any], watermark: int) -> List[int]:
+    """Store partition ids committed AFTER ``watermark`` — the delta a
+    standing-query refresh must scan.  ``watermark=-1`` (no state yet)
+    returns every partition; ``watermark=store_generation(meta)``
+    returns none."""
+    gens = meta.get("part_generations") or [0] * int(meta["npartitions"])
+    return [p for p, g in enumerate(gens) if int(g) > watermark]
 
 
 def _col_order(schema: Dict[str, Any]) -> List[str]:
@@ -231,6 +261,71 @@ def write_store(path: str, pd: PData,
         import shutil
         shutil.rmtree(path)
     os.rename(tmp, path)
+
+
+def append_store(path: str, pd: PData) -> int:
+    """Append a PData to an EXISTING local store as a new generation;
+    returns the committed generation number.
+
+    The growing-store primitive of the continuous-query subsystem
+    (dryad_tpu/inc): new partition files land at indices >= the current
+    ``npartitions`` under their final names, then ONE atomic
+    ``os.replace`` of ``meta.json`` publishes the extended manifest with
+    ``generation+1`` (same rename-commit discipline as write_store — a
+    crash before the replace leaves orphan part files the old manifest
+    never references, so readers and watermarks never see a torn
+    append; a retry simply overwrites them).
+
+    The appended columns must match the store schema exactly (same
+    string max_len) — appends never migrate schemas.  A non-trivial
+    partitioning claim is downgraded to ``none``: appended rows were
+    not placed, so the persisted hash/range layout no longer holds."""
+    if is_remote_store(path):
+        raise NotImplementedError(
+            "append_store supports local stores only (remote adapters "
+            "commit whole stores; re-write via write_store)")
+    meta = store_meta(path)
+    schema = pdata_schema(pd)
+    if schema != meta["schema"]:
+        raise ValueError(
+            f"append schema mismatch for {path}: store has "
+            f"{meta['schema']}, appended data has {schema}")
+    compression = meta.get("compression")
+    counts = np.asarray(pd.counts)
+    base = int(meta["npartitions"])
+    paths, segments, new_counts = [], [], []
+    for p in range(pd.nparts):
+        n = int(counts[p])
+        if n == 0:  # empty shards would bloat the manifest forever
+            continue
+        paths.append(_part_path(path, base + len(new_counts)))
+        segments.append(_part_segments_for_write(pd.batch, schema, p, n))
+        new_counts.append(n)
+    if not new_counts:
+        return store_generation(meta)
+    native.write_files(paths, segments,
+                       compress=(compression == "gzip"))
+    checksums = ["%016x" % native.checksum_segments(segs)
+                 for segs in segments]
+    gen = store_generation(meta) + 1
+    gens = list(meta.get("part_generations") or [0] * base)
+    part = meta.get("partitioning") or {"kind": "none"}
+    new_meta = build_meta(
+        meta["schema"], list(meta["counts"]) + new_counts,
+        list(meta.get("checksums") or []) + checksums,
+        partitioning=part if part.get("kind") == "none"
+        else {"kind": "none"},
+        compression=compression,
+        capacity=max(int(meta.get("capacity", 1)), max(new_counts)),
+        generation=gen,
+        part_generations=gens + [gen] * len(new_counts))
+    tmp = os.path.join(path, "meta.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(new_meta, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, "meta.json"))
+    return gen
 
 
 def store_meta(path: str) -> Dict[str, Any]:

@@ -116,6 +116,10 @@ class AnalyzeReport:
     ooc_cache_writes: int = 0     # cold cache writes
     prefetch_stalls: int = 0      # host-IO-bound waits in the pipeline
     prefetch_stall_s: float = 0.0
+    # continuous queries (dryad_tpu/inc): standing-query refreshes seen
+    # in the stream, and how many fell back to a full re-run
+    inc_refreshes: int = 0        # == dryad_inc_refreshes_total
+    inc_fallbacks: int = 0        # == dryad_inc_fallbacks_total
 
     def __post_init__(self):
         self._events: List[dict] = []   # source stream (not serialized)
@@ -139,6 +143,8 @@ class AnalyzeReport:
                 "ooc_cache_writes": self.ooc_cache_writes,
                 "prefetch_stalls": self.prefetch_stalls,
                 "prefetch_stall_s": round(self.prefetch_stall_s, 6),
+                "inc_refreshes": self.inc_refreshes,
+                "inc_fallbacks": self.inc_fallbacks,
                 "stages": [s.to_payload() for s in self.stages]}
 
     @staticmethod
@@ -150,7 +156,8 @@ class AnalyzeReport:
             d.get("stage_runs", 0), d.get("predicted", False),
             d.get("misses", 0), d.get("rewrites", 0),
             d.get("ooc_cache_hits", 0), d.get("ooc_cache_writes", 0),
-            d.get("prefetch_stalls", 0), d.get("prefetch_stall_s", 0.0))
+            d.get("prefetch_stalls", 0), d.get("prefetch_stall_s", 0.0),
+            d.get("inc_refreshes", 0), d.get("inc_fallbacks", 0))
 
     def render(self) -> str:
         """The ANALYZE table: one row per executed stage, measured
@@ -213,6 +220,11 @@ class AnalyzeReport:
                 f"hit(s), {self.ooc_cache_writes} cold write(s); "
                 f"{self.prefetch_stalls} prefetch stall(s) "
                 f"({self.prefetch_stall_s:.3f}s waiting on host IO)")
+        if self.inc_refreshes or self.inc_fallbacks:
+            lines.append(
+                f"continuous: {self.inc_refreshes} standing-query "
+                f"refresh(es), {self.inc_fallbacks} full-rescan "
+                f"fallback(s)")
         return "\n".join(lines)
 
 
@@ -323,6 +335,10 @@ def analyze_events(events, job: Optional[str] = None) -> AnalyzeReport:
             # stage rows, only the report totals)
             rep.prefetch_stalls += int(e.get("stalls") or 1)
             rep.prefetch_stall_s += float(e.get("stall_s") or 0.0)
+        elif k == "inc_refresh":
+            rep.inc_refreshes += 1
+        elif k == "inc_fallback_rescan":
+            rep.inc_fallbacks += 1
         elif k == "graph_rewrite":
             # a rewrite usually reshapes a stage that has NOT run yet —
             # buffer by id and attach after the walk, when the

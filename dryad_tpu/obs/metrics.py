@@ -73,6 +73,11 @@ FAMILIES = {
     "prefetch_stalls": ("dryad_ooc_prefetch_stalls_total",
                         "chunk-prefetch stalls (host IO was the "
                         "bottleneck)"),
+    "inc_refreshes": ("dryad_inc_refreshes_total",
+                      "standing-query refreshes committed"),
+    "inc_fallbacks": ("dryad_inc_fallbacks_total",
+                      "standing-query refreshes that fell back to a "
+                      "full re-run"),
     "jobs": ("dryad_jobs_total", "completed jobs"),
     "jobs_failed": ("dryad_jobs_failed_total", "failed jobs"),
     "job_progress": ("dryad_job_progress_ratio",
@@ -386,6 +391,10 @@ def metrics_from_events(events, registry: Optional[Registry] = None,
         elif k == "prefetch_stall":
             family_counter(r, "prefetch_stalls").inc(
                 int(e.get("stalls", 1)))
+        elif k == "inc_refresh":
+            family_counter(r, "inc_refreshes").inc()
+        elif k == "inc_fallback_rescan":
+            family_counter(r, "inc_fallbacks").inc()
         elif k == "job_done":
             C("jobs", e).inc()
         elif k == "job_failed":

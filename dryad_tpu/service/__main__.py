@@ -14,6 +14,9 @@
 * ``list``     all jobs the daemon knows
 * ``tenants``  fair-share snapshot (slot-seconds, running, failures)
 * ``slo``      per-tenant SLO attainment + error-budget burn rate
+* ``standing`` all registered standing queries (``SELECT ... EMIT
+               EVERY n`` submissions; cancel one with ``cancel <id>``,
+               follow its refresh deltas with ``events <id>``)
 * ``events``   follow one job's live event stream (SSE; ``--after N``
                resumes at a cursor) until the job is terminal
 
@@ -135,6 +138,12 @@ def _cmd_slo(args) -> int:
     return 0
 
 
+def _cmd_standing(args) -> int:
+    for row in _client(args).standing():
+        print(json.dumps(row, default=str))
+    return 0
+
+
 def _cmd_events(args) -> int:
     try:
         for e in _client(args).stream_events(args.job,
@@ -215,6 +224,11 @@ def main(argv=None) -> int:
     s = sub.add_parser("slo", help="per-tenant SLO attainment + burn")
     _url(s)
     s.set_defaults(fn=_cmd_slo)
+
+    s = sub.add_parser("standing",
+                       help="all registered standing queries")
+    _url(s)
+    s.set_defaults(fn=_cmd_standing)
 
     s = sub.add_parser("events",
                        help="follow one job's live event stream (SSE)")

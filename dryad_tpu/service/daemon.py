@@ -52,6 +52,9 @@ __all__ = ["JobService"]
 # paths, so no separators or dot-prefixes (path traversal)
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
+# cheap standing-query gate for submit_sql (the parse is authoritative)
+_EMIT_RE = re.compile(r"\bEMIT\s+EVERY\b", re.IGNORECASE)
+
 
 def _now() -> float:
     return time.time()
@@ -127,6 +130,17 @@ class JobService:
                             else "in-process"),
                   "slots": self.slots, "dir": root})
         self._fleet.start()
+        # continuous queries (dryad_tpu/inc): the standing-query
+        # registry + refresh scheduler rides the in-process fleet only
+        # (each refresh is a normal fair-share job on the shared warm
+        # executor).  Constructed AFTER the fleet starts: restart-
+        # resumed registrations begin refreshing immediately.
+        if cluster is None:
+            from dryad_tpu.inc.standing import StandingManager
+            self.standing = StandingManager(self)
+            self.standing.start()
+        else:
+            self.standing = None
 
     @property
     def slots(self) -> int:
@@ -322,6 +336,21 @@ class JobService:
             raise ServiceStoppedError()
         self.admission.precheck(tenant)
         norm = _sql.normalize_query(query)
+        # continuous queries: an EMIT EVERY clause registers a standing
+        # query instead of running once.  The regex is only a cheap
+        # gate — the compile (parse -> bind, DTA3xx typed rejections
+        # included) is authoritative, so a false positive (the phrase
+        # inside a literal) just falls through to the one-shot path
+        if _EMIT_RE.search(query):
+            _mode, bound = _sql.compile_query(self.catalog, query)
+            if getattr(bound, "emit_every", None) is not None:
+                if self.standing is None:
+                    raise MalformedJobError("sql", ValueError(
+                        "standing queries (EMIT EVERY) need the "
+                        "in-process fleet"))
+                return self.standing.register(query, norm, bound,
+                                              tenant=tenant,
+                                              priority=priority)
         # one fingerprint per submission (it content-hashes inline
         # tables): the cache key and both event records share it
         fp = self.catalog.fingerprint()
@@ -573,11 +602,18 @@ class JobService:
     # -- job control -------------------------------------------------------
 
     def job(self, job_id: str) -> ServiceJob:
+        """Resolve a job OR standing-query id: standing entries are
+        job-shaped (inc/standing.py), so every read surface — status,
+        long-poll events, the SSE stream — serves both through here."""
         with self._jobs_lock:
-            try:
-                return self.jobs[job_id]
-            except KeyError:
-                raise KeyError(f"unknown job {job_id!r}")
+            j = self.jobs.get(job_id)
+        if j is not None:
+            return j
+        if self.standing is not None:
+            sq = self.standing.get(job_id)
+            if sq is not None:
+                return sq
+        raise KeyError(f"unknown job {job_id!r}")
 
     def status(self, job_id: str, with_result: bool = False) -> dict:
         return self.job(job_id).to_row(with_result=with_result)
@@ -591,6 +627,11 @@ class JobService:
         return job.to_row(with_result=True)
 
     def cancel(self, job_id: str) -> bool:
+        # a standing id unregisters the continuous query (its persisted
+        # registration goes away too — restart will not resume it)
+        if self.standing is not None \
+                and self.standing.get(job_id) is not None:
+            return self.standing.cancel(job_id)
         job = self.job(job_id)
         ok = job.cancel()
         if ok:
@@ -603,6 +644,11 @@ class JobService:
     def list_jobs(self) -> List[dict]:
         with self._jobs_lock:
             return [j.to_row() for j in self.jobs.values()]
+
+    def standing_rows(self) -> List[dict]:
+        """Status rows of every registered standing query
+        (``GET /standing``); empty on the cluster fleet."""
+        return self.standing.rows() if self.standing is not None else []
 
     # -- per-tenant SLOs (obs/slo.py) --------------------------------------
 
@@ -696,10 +742,28 @@ class JobService:
             srows.append(
                 f"<tr><td>{_html.escape(t)}</td><td>{v[0]:.3f}</td>"
                 f"<td>{v[1]}</td><td>{v[2]}</td>{scol}</tr>")
+        qrows = []
+        for r in self.standing_rows():
+            qrows.append(
+                f"<tr><td>{_html.escape(r['job'])}</td>"
+                f"<td>{_html.escape(r['tenant'])}</td>"
+                f"<td>{_html.escape(r['state'])}</td>"
+                f"<td>{r['emit_every']:g}s</td>"
+                f"<td>{r['refreshes']}</td>"
+                f"<td>{_html.escape(r['mode'] or '—')}</td>"
+                f"<td>{r['rows']}</td>"
+                f"<td><code>{_html.escape(r['query'])}</code></td></tr>")
+        standing_tbl = (
+            "<h2>standing queries</h2><table><tr><th>id</th>"
+            "<th>tenant</th><th>state</th><th>every</th>"
+            "<th>refreshes</th><th>last&nbsp;mode</th><th>rows</th>"
+            "<th>query</th></tr>" + "".join(qrows) + "</table>"
+            if qrows else "")
         extra = (
             "<h2>jobs</h2><table><tr><th>job</th><th>tenant</th>"
             "<th>app</th><th>state</th><th>progress</th><th>tasks</th>"
             "<th>wall&nbsp;s</th></tr>" + "".join(rows) + "</table>"
+            + standing_tbl +
             "<h2>tenants</h2><table><tr><th>tenant</th>"
             "<th>slot&nbsp;s</th><th>running</th><th>failures</th>"
             "<th>SLO</th><th>attainment</th><th>burn</th></tr>"
@@ -715,6 +779,11 @@ class JobService:
         if self._stopping:
             return
         self._stopping = True
+        # wind the standing scheduler down FIRST so no new refresh jobs
+        # race the closing fleet (registrations stay on disk — the next
+        # daemon resumes them from their committed watermarks)
+        if self.standing is not None:
+            self.standing.stop()
         if cancel_pending:
             for job in self.list_jobs():
                 j = self.jobs.get(job["job"])

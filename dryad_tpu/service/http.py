@@ -76,7 +76,8 @@ __all__ = ["serve", "REJECTION_STATUS", "Client"]
 REJECTION_STATUS = {"DTA910": 400, "DTA911": 429, "DTA912": 403,
                     "DTA913": 503,
                     "DTA301": 400, "DTA302": 400, "DTA303": 400,
-                    "DTA304": 400, "DTA305": 400, "DTA306": 400}
+                    "DTA304": 400, "DTA305": 400, "DTA306": 400,
+                    "DTA307": 400}
 
 
 def _compile_rejection(e: Exception):
@@ -167,6 +168,8 @@ def serve(service, port: int = 0, host: str = "127.0.0.1"):
                     self._json(200, service.admission.shares())
                 elif path == "/slo":
                     self._json(200, service.slo_snapshot())
+                elif path == "/standing":
+                    self._json(200, service.standing_rows())
                 elif path.startswith("/events/"):
                     rest = path[len("/events/"):]
                     sse = rest.endswith("/stream")
@@ -225,7 +228,15 @@ def serve(service, port: int = 0, host: str = "127.0.0.1"):
                         str(body.get("query", "")),
                         tenant=str(body.get("tenant", "default")),
                         priority=int(body.get("priority", 0)))
-                    self._json(200, {"job": jid})
+                    out = {"job": jid}
+                    standing = getattr(service, "standing", None)
+                    if (standing is not None
+                            and standing.get(jid) is not None):
+                        # EMIT EVERY registered a standing query: the
+                        # id follows the SAME status/events/stream/
+                        # cancel routes as a job id
+                        out["standing"] = True
+                    self._json(200, out)
                 elif path.startswith("/cancel/"):
                     jid = path[len("/cancel/"):]
                     try:
@@ -320,6 +331,11 @@ class Client:
     def slo(self) -> Dict[str, Any]:
         """Per-tenant SLO attainment/burn snapshot (``GET /slo``)."""
         return self._req("/slo")
+
+    def standing(self) -> List[Dict[str, Any]]:
+        """Status rows of every registered standing query
+        (``GET /standing``)."""
+        return self._req("/standing")
 
     def events(self, job: str, after: int = 0,
                timeout_s: float = 10.0) -> Dict[str, Any]:

@@ -110,8 +110,16 @@ def explain(ctx, catalog: Catalog, text: str, origin: str = "<sql>",
     ds, _ = lower(ctx, catalog, bound)
     _emit(ctx, event, text, catalog, bound)
     cost = mode == "explain_cost"
-    return ds.explain(verify=cost, cost=cost,
-                      analyze=mode == "explain_analyze")
+    out = ds.explain(verify=cost, cost=cost,
+                     analyze=mode == "explain_analyze")
+    if bound.emit_every is not None:
+        # continuous queries: the static refresh verdict (DTA401/402 —
+        # incremental merge vs full re-run) so a user knows BEFORE
+        # registering whether each refresh pays O(delta) or O(store)
+        from dryad_tpu.inc.delta_plan import plan_delta, render_verdict
+        out += "\n" + render_verdict(catalog, bound,
+                                     plan_delta(catalog, bound))
+    return out
 
 
 def offline_explain(catalog: Catalog, text: str, nparts: int = 8,
@@ -122,8 +130,13 @@ def offline_explain(catalog: Catalog, text: str, nparts: int = 8,
     _mode, bound = compile_query(catalog, text, origin=origin)
     ctx = SchemaContext(nparts=nparts)
     ds, _ = lower(ctx, catalog, bound)
-    return plan_query(ds.node, nparts, hosts=1,
-                      config=ctx.config).explain()
+    out = plan_query(ds.node, nparts, hosts=1,
+                     config=ctx.config).explain()
+    if bound.emit_every is not None:
+        from dryad_tpu.inc.delta_plan import plan_delta, render_verdict
+        out += "\n" + render_verdict(catalog, bound,
+                                     plan_delta(catalog, bound))
+    return out
 
 
 def offline_plan_json(catalog: Catalog, text: str, nparts: int = 8,

@@ -72,6 +72,11 @@ class BoundSelect:
     span: Optional[Span] = None           # the SELECT keyword
     where_span: Optional[Span] = None
     having_span: Optional[Span] = None
+    # standing query (EMIT EVERY <n>): refresh cadence in seconds; the
+    # lowered batch plan is IDENTICAL — the cadence only drives the
+    # service's standing-query scheduler and the inc/ refresh planner
+    emit_every: Optional[float] = None
+    emit_span: Optional[Span] = None
 
 
 class _Scope:
@@ -482,6 +487,23 @@ class _Binder:
                     name = f"col{len(outputs)}"
                 add_output(name, prog, typ, it.span)
 
+        if stmt.emit_every is not None:
+            # standing-query shape checks (DTA307): the interval must
+            # be positive, and the base table must be able to GROW —
+            # inline registrations are immutable host columns
+            espan = stmt.emit_span or stmt.span
+            if not stmt.emit_every > 0:
+                self.diag("DTA307",
+                          f"EMIT EVERY needs a positive interval, got "
+                          f"{stmt.emit_every:g}", espan)
+            base = self.catalog.get(stmt.table.name)
+            if base is not None and base.kind == "inline":
+                self.diag("DTA307",
+                          f"EMIT EVERY over inline table "
+                          f"{stmt.table.name!r}: inline registrations "
+                          f"cannot grow — a standing query needs a "
+                          f"store-backed base table", espan)
+
         order_by: List[Tuple[str, bool]] = []
         for o in stmt.order_by:
             if o.name not in outputs:
@@ -505,7 +527,8 @@ class _Binder:
             tables=[stmt.table.name] + [j.table for j in joins],
             span=stmt.span,
             where_span=getattr(stmt.where, "span", None),
-            having_span=getattr(stmt.having, "span", None))
+            having_span=getattr(stmt.having, "span", None),
+            emit_every=stmt.emit_every, emit_span=stmt.emit_span)
 
 
 def bind(catalog: Catalog, stmt: N.Select) -> BoundSelect:

@@ -157,6 +157,35 @@ class Catalog:
     def get(self, name: str) -> Optional[CatalogTable]:
         return self.tables.get(name)
 
+    def watermark(self, name: str) -> int:
+        """Current append generation of a store-backed table (re-read
+        from the live manifest — the standing-query scheduler polls
+        this to decide whether a refresh has anything to scan)."""
+        from dryad_tpu.io.store import store_generation, store_meta
+        t = self.tables[name]
+        if t.kind != "store":
+            raise ValueError(f"table {name!r} is {t.kind}-backed — only "
+                             f"store tables carry an append watermark")
+        return store_generation(store_meta(t.path))
+
+    def parts_since(self, name: str, watermark: int) -> List[int]:
+        """Store partition ids of ``name`` appended after ``watermark``
+        — the chunk delta an incremental refresh scopes its scan to."""
+        from dryad_tpu.io.store import parts_since, store_meta
+        t = self.tables[name]
+        if t.kind != "store":
+            raise ValueError(f"table {name!r} is {t.kind}-backed — only "
+                             f"store tables carry an append watermark")
+        return parts_since(store_meta(t.path), watermark)
+
+    def refresh_store(self, name: str) -> "Catalog":
+        """Re-read a store table's manifest statistics (row counts grow
+        as generations land; cost forecasts should see them)."""
+        t = self.tables[name]
+        if t.kind == "store":
+            self.register_store(name, t.path)
+        return self
+
     def fingerprint(self) -> str:
         """Hashes the full registration INCLUDING inline column
         CONTENT (the service's plan cache stores inline source data

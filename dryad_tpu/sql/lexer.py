@@ -21,7 +21,7 @@ KEYWORDS = frozenset({
     "ORDER", "LIMIT", "AS", "AND", "OR", "NOT", "JOIN", "INNER", "LEFT",
     "RIGHT", "FULL", "OUTER", "CROSS", "NATURAL", "ON", "ASC", "DESC",
     "UNION", "INTERSECT", "EXCEPT", "OFFSET", "EXPLAIN", "COST", "NULL",
-    "IN", "LIKE", "BETWEEN", "CASE", "IS",
+    "IN", "LIKE", "BETWEEN", "CASE", "IS", "EMIT", "EVERY", "SECONDS",
 })
 
 _PUNCT = ("<=", ">=", "!=", "<>", "=", "<", ">", "(", ")", ",", ".",

@@ -101,3 +101,7 @@ class Select:
     order_by: Tuple[OrderItem, ...]
     limit: Optional[int]
     span: Span
+    # standing query: refresh cadence in seconds (EMIT EVERY <n>
+    # [SECONDS]); None for plain batch queries
+    emit_every: Optional[float] = None
+    emit_span: Optional[Span] = None

@@ -5,7 +5,8 @@ SELECT / WHERE / GROUP BY + aggregates / JOIN / ORDER BY / LIMIT)::
 
     query     := SELECT [DISTINCT] items FROM table_ref join* [WHERE expr]
                  [GROUP BY col ("," col)*] [HAVING expr]
-                 [ORDER BY ord ("," ord)*] [LIMIT int] [";"]
+                 [ORDER BY ord ("," ord)*] [LIMIT int]
+                 [EMIT EVERY num [SECONDS]] [";"]
     items     := "*" | item ("," item)*
     item      := expr [[AS] ident]
     table_ref := ident [[AS] ident]
@@ -139,6 +140,19 @@ class _Parser:
             if t.kind != "int":
                 raise self.err("LIMIT needs an integer literal", t)
             limit = int(t.text)
+        emit_every = None
+        emit_span = None
+        if self.at_kw("EMIT"):
+            e0 = self.take()
+            self.expect_kw("EVERY")
+            t = self.take()
+            if t.kind not in ("int", "float"):
+                raise self.err("EMIT EVERY needs a numeric interval "
+                               "(seconds)", t)
+            emit_every = float(t.text)
+            if self.at_kw("SECONDS"):
+                self.take()
+            emit_span = self._span(e0)
         if self.at_punct(";"):
             self.take()
         self._check_unsupported()
@@ -148,7 +162,8 @@ class _Parser:
                         joins=tuple(joins), where=where,
                         group_by=tuple(group_by), having=having,
                         order_by=tuple(order_by), limit=limit,
-                        span=self._span(head))
+                        span=self._span(head), emit_every=emit_every,
+                        emit_span=emit_span)
 
     def select_items(self) -> List[N.SelectItem]:
         if self.at_punct("*"):

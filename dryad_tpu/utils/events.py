@@ -58,6 +58,13 @@ _LEVELS = {
     # an SLO error-budget breach, and a cross-run perf-regression
     # suspicion are job-lifecycle-grade findings
     "analyze_report": 1, "slo_breach": 1, "regression_suspect": 1,
+    # continuous queries (dryad_tpu/inc): a standing-query registration,
+    # each refresh's summary (delta chunks scanned + result delta — the
+    # record SSE followers of the standing id consume), the atomic
+    # state+watermark commit, and a refresh that fell back to a full
+    # re-run are all job-lifecycle grade
+    "standing_query_registered": 1, "standing_query_cancelled": 1,
+    "inc_refresh": 1, "inc_state_write": 1, "inc_fallback_rescan": 1,
     # SQL front end (dryad_tpu/sql): every lowering emits sql_query
     # (normalized query text + catalog fingerprint — history/forensics
     # bundles identify SQL jobs by it); sql_lowered carries the lowered

@@ -1,0 +1,627 @@
+"""Continuous queries (dryad_tpu/inc + store generations + EMIT EVERY).
+
+The correctness spine is the ORACLE SWEEP: after every append round, an
+incremental refresh's full result must be bit-identical to a fresh full
+rescan of the same statement — for every decomposable shape (group
+sums/counts/min/max/avg over int values, string-keyed wordcount, global
+aggregates).  Around it: the append-aware store manifests, the static
+DTA4xx verdict, the crash-safety of the atomic state+watermark commit,
+and the service-resident standing-query lifecycle (registration,
+fair-share refreshes, SSE delta streams, restart resume, cancel).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dryad_tpu import sql
+from dryad_tpu.api.dataset import Context
+from dryad_tpu.inc import state as inc_state
+from dryad_tpu.inc.delta_plan import plan_delta, render_verdict
+from dryad_tpu.inc.refresh import run_refresh, table_payload
+from dryad_tpu.io.store import (append_store, parts_since, read_store,
+                                store_generation, store_meta)
+from dryad_tpu.utils.events import EventLog
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return Context(install_trace=False)
+
+
+def _cols(n, seed, n_keys=5, width=100):
+    r = np.random.RandomState(seed)
+    return {"k": r.randint(0, n_keys, n).astype(np.int32),
+            "v": r.randint(0, width, n).astype(np.int32)}
+
+
+def _oracle(query, name, path):
+    """Fresh full rescan of ``query`` over the store as it is NOW."""
+    cat = sql.Catalog().register_store(name, path)
+    bound = sql.compile_query(cat, query)[1]
+    c = Context(install_trace=False)
+    return sql.lower(c, cat, bound)[0].collect()
+
+
+def _rows(payload):
+    t = payload["table"]
+    names = sorted(t)
+    return sorted(zip(*[t[c] for c in names])) if names else []
+
+
+# -- tentpole (a): append-aware store manifests ------------------------------
+
+
+def test_store_generations_and_append(ctx, tmp_path):
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(16, 1)).to_store(p)
+    m = store_meta(p)
+    assert store_generation(m) == 0
+    assert m["part_generations"] == [0] * m["npartitions"]
+    n0 = m["npartitions"]
+
+    gen = append_store(p, ctx.from_columns(_cols(6, 2)).node.data)
+    assert gen == 1
+    m = store_meta(p)
+    assert store_generation(m) == 1
+    assert m["npartitions"] > n0
+    # old parts keep generation 0; exactly the new parts are past the
+    # old watermark
+    assert m["part_generations"][:n0] == [0] * n0
+    assert set(m["part_generations"][n0:]) == {1}
+    assert parts_since(m, 0) == list(range(n0, m["npartitions"]))
+    assert parts_since(m, 1) == []
+    assert parts_since(m, -1) == list(range(m["npartitions"]))
+
+    # appended rows are readable (checksums verified) alongside the old
+    from dryad_tpu.exec.data import pdata_to_host
+    host = pdata_to_host(read_store(p, ctx.mesh))
+    assert len(host["v"]) == 22
+
+    # schema mismatch is a typed refusal, store untouched
+    with pytest.raises(ValueError):
+        append_store(p, ctx.from_columns(
+            {"other": np.arange(3, dtype=np.int32)}).node.data)
+    assert store_generation(store_meta(p)) == 1
+
+    # appending nothing commits nothing
+    assert append_store(p, ctx.from_columns(
+        _cols(0, 3)).node.data) == 1
+
+
+def test_append_store_remote_unsupported(ctx):
+    with pytest.raises(NotImplementedError):
+        append_store("s3://bucket/store",
+                     ctx.from_columns(_cols(4, 1)).node.data)
+
+
+def test_catalog_watermark_surface(ctx, tmp_path):
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(8, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    cat.register_columns("inline_t", {"k": np.arange(4, dtype=np.int32)})
+    assert cat.watermark("t") == 0
+    append_store(p, ctx.from_columns(_cols(4, 2)).node.data)
+    assert cat.watermark("t") == 1
+    assert cat.parts_since("t", 0) != []
+    assert cat.parts_since("t", 1) == []
+    with pytest.raises(ValueError):
+        cat.watermark("inline_t")
+    # refresh_store picks up the grown row stats
+    rows0 = cat.tables["t"].rows
+    cat.refresh_store("t")
+    assert cat.tables["t"].rows == rows0 + 4
+
+
+# -- tentpole (c) front half: EMIT EVERY through the SQL compiler ------------
+
+
+def test_parser_emit_every(tmp_path):
+    stmt = sql.parse("SELECT k FROM t EMIT EVERY 5")
+    assert stmt.emit_every == 5.0 and stmt.emit_span is not None
+    stmt = sql.parse("SELECT k FROM t EMIT EVERY 0.5 SECONDS")
+    assert stmt.emit_every == 0.5
+    assert sql.parse("SELECT k FROM t").emit_every is None
+    with pytest.raises(sql.SqlError):
+        sql.parse("SELECT k FROM t EMIT EVERY banana")
+
+
+def test_binder_dta307(ctx, tmp_path):
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(8, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    cat.register_columns("mem", {"k": np.arange(4, dtype=np.int32)})
+    with pytest.raises(sql.SqlError) as ei:
+        sql.compile_query(cat, "SELECT k FROM t EMIT EVERY 0")
+    assert "DTA307" in str(ei.value)
+    with pytest.raises(sql.SqlError) as ei:
+        sql.compile_query(cat, "SELECT k FROM mem EMIT EVERY 1")
+    assert "DTA307" in str(ei.value)
+    # a valid registration binds cleanly and changes nothing else
+    bound = sql.compile_query(cat, "SELECT k FROM t EMIT EVERY 2")[1]
+    assert bound.emit_every == 2.0
+
+
+def test_explain_verdict(ctx, tmp_path):
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(8, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    good = sql.offline_explain(
+        cat, "SELECT k, SUM(v) AS s FROM t GROUP BY k EMIT EVERY 3")
+    assert "standing query: refresh every 3s -> incremental" in good
+    assert "DTA401" in good
+    bad = sql.offline_explain(
+        cat, "SELECT k, SUM(v) AS s FROM t GROUP BY k "
+             "ORDER BY s DESC LIMIT 2 EMIT EVERY 3")
+    assert "-> rescan" in bad and "DTA402" in bad
+    # manifest-seeded scan arithmetic rides the verdict
+    assert "base store 't'" in good and "byte(s) total" in good
+    # a non-EMIT explain is unchanged (no standing section)
+    plain = sql.offline_explain(cat, "SELECT k FROM t")
+    assert "standing query" not in plain
+
+
+# -- tentpole (b): the oracle sweep ------------------------------------------
+
+
+SHAPES = [
+    ("group-aggs",
+     "SELECT k, SUM(v) AS s, COUNT(*) AS c, AVG(v) AS a, "
+     "MIN(v) AS lo, MAX(v) AS hi FROM {t} GROUP BY k"),
+    ("group-sum",
+     "SELECT k, SUM(v) AS s FROM {t} GROUP BY k"),
+    ("global-aggs",
+     "SELECT SUM(v) AS s, COUNT(*) AS c, AVG(v) AS a FROM {t}"),
+]
+
+
+@pytest.mark.parametrize("label,shape", SHAPES,
+                         ids=[s[0] for s in SHAPES])
+def test_oracle_sweep_decomposable(ctx, tmp_path, label, shape):
+    """N append rounds: the incremental result is bit-identical to a
+    full rescan at every watermark."""
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(48, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    plain = shape.format(t="t")
+    q = plain + " EMIT EVERY 1"
+    bound = sql.compile_query(cat, q)[1]
+    sd = str(tmp_path / "state")
+    log = EventLog(level=2)
+    for rnd in range(4):
+        res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd,
+                          event=log)
+        assert res.mode in ("incremental", "noop")
+        got = _rows(table_payload(res.table))
+        want = _rows(table_payload(_oracle(plain, "t", p)))
+        assert got == want, f"{label} round {rnd}: {got} != {want}"
+        append_store(p, ctx.from_columns(_cols(12, 10 + rnd)).node.data)
+    # every refresh committed its state atomically and said so
+    assert len(log.of_type("inc_state_write")) == 4
+    assert len(log.of_type("inc_refresh")) == 4
+    assert not log.of_type("inc_fallback_rescan")
+
+
+def test_oracle_sweep_wordcount(ctx, tmp_path):
+    """String group keys (the wordcount shape) merge bit-identically."""
+    p = str(tmp_path / "w")
+    words = ["the", "quick", "brown", "fox", "dog"]
+
+    def batch(n, seed):
+        r = np.random.RandomState(seed)
+        return {"word": [words[i] for i in r.randint(0, len(words), n)]}
+
+    ctx.from_columns(batch(40, 1)).to_store(p)
+    cat = sql.Catalog().register_store("w", p)
+    plain = "SELECT word, COUNT(*) AS n FROM w GROUP BY word"
+    q = plain + " EMIT EVERY 1"
+    bound = sql.compile_query(cat, q)[1]
+    sd = str(tmp_path / "state")
+    for rnd in range(3):
+        res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd)
+        got = _rows(table_payload(res.table))
+        want = _rows(table_payload(_oracle(plain, "w", p)))
+        assert got == want, f"round {rnd}"
+        append_store(p, ctx.from_columns(batch(10, 5 + rnd)).node.data)
+
+
+def test_append_shape_accumulates(ctx, tmp_path):
+    """A non-aggregating standing query emits exactly its delta's rows
+    each refresh; the concatenation equals the full rescan."""
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(24, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    plain = "SELECT k, v FROM t WHERE v >= 50"
+    q = plain + " EMIT EVERY 1"
+    bound = sql.compile_query(cat, q)[1]
+    plan = plan_delta(cat, bound)
+    assert plan.shape == "append" and plan.code == "DTA401"
+    sd = str(tmp_path / "state")
+    seen = []
+    for rnd in range(3):
+        res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd)
+        pay = table_payload(res.table)
+        seen.extend(zip(pay["table"].get("k", []),
+                        pay["table"].get("v", [])))
+        append_store(p, ctx.from_columns(_cols(8, 20 + rnd)).node.data)
+    # one final refresh folds the last append in
+    res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd)
+    pay = table_payload(res.table)
+    seen.extend(zip(pay["table"].get("k", []), pay["table"].get("v", [])))
+    want = _rows(table_payload(_oracle(plain, "t", p)))
+    assert sorted(seen) == want
+    # and an idle refresh emits nothing new
+    res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd)
+    assert res.mode == "noop" and res.changed_rows == 0
+
+
+def test_fallback_rescan(ctx, tmp_path):
+    """ORDER BY + LIMIT falls back to a full re-run each refresh —
+    verdict DTA402, the fallback event fires, rows stay correct."""
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(32, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    plain = ("SELECT k, SUM(v) AS s FROM t GROUP BY k "
+             "ORDER BY s DESC LIMIT 3")
+    q = plain + " EMIT EVERY 1"
+    bound = sql.compile_query(cat, q)[1]
+    plan = plan_delta(cat, bound)
+    assert not plan.decomposable and plan.code == "DTA402"
+    assert any("ORDER BY" in r for r in plan.reasons)
+    assert any("LIMIT" in r for r in plan.reasons)
+    sd = str(tmp_path / "state")
+    log = EventLog(level=2)
+    for rnd in range(2):
+        res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd,
+                          event=log)
+        assert res.mode == "rescan" and res.code == "DTA402"
+        got = _rows(table_payload(res.table))
+        want = _rows(table_payload(_oracle(plain, "t", p)))
+        assert got == want
+        append_store(p, ctx.from_columns(_cols(8, 30 + rnd)).node.data)
+    falls = log.of_type("inc_fallback_rescan")
+    assert len(falls) == 2 and falls[0]["code"] == "DTA402"
+
+
+def test_rebuild_cost_rule(ctx, tmp_path):
+    """An append bigger than half the store triggers the refresh-time
+    rebuild (DTA403): state is rebuilt from a full scan, result still
+    oracle-identical."""
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(16, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    plain = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+    q = plain + " EMIT EVERY 1"
+    bound = sql.compile_query(cat, q)[1]
+    sd = str(tmp_path / "state")
+    log = EventLog(level=2)
+    run_refresh(ctx, cat, bound, sql.normalize_query(q), sd, event=log)
+    # delta ~3x the original store
+    append_store(p, ctx.from_columns(_cols(48, 2)).node.data)
+    res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd,
+                      event=log)
+    assert res.mode == "rebuild" and res.code == "DTA403"
+    falls = log.of_type("inc_fallback_rescan")
+    assert falls and falls[-1]["code"] == "DTA403"
+    got = _rows(table_payload(res.table))
+    assert got == _rows(table_payload(_oracle(plain, "t", p)))
+    # the rebuilt state keeps merging incrementally afterwards
+    append_store(p, ctx.from_columns(_cols(4, 3)).node.data)
+    res = run_refresh(ctx, cat, bound, sql.normalize_query(q), sd)
+    assert res.mode == "incremental"
+    got = _rows(table_payload(res.table))
+    assert got == _rows(table_payload(_oracle(plain, "t", p)))
+
+
+def test_crash_mid_refresh_no_double_count(ctx, tmp_path, monkeypatch):
+    """A crash before the atomic state+watermark commit changes
+    NOTHING: the next refresh re-scans exactly the uncommitted delta —
+    chunks are never double-counted and never skipped."""
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(24, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    plain = "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t GROUP BY k"
+    q = plain + " EMIT EVERY 1"
+    norm = sql.normalize_query(q)
+    bound = sql.compile_query(cat, q)[1]
+    sd = str(tmp_path / "state")
+    run_refresh(ctx, cat, bound, norm, sd)
+    sp = inc_state.state_path(
+        sd, inc_state.state_key(norm, "t", p, store_meta(p)["schema"]))
+    before = open(sp, "rb").read()
+
+    append_store(p, ctx.from_columns(_cols(8, 2)).node.data)
+    real = inc_state.commit_state
+
+    def crash(*a, **kw):
+        raise OSError("simulated crash before the atomic commit")
+
+    monkeypatch.setattr(inc_state, "commit_state", crash)
+    with pytest.raises(OSError):
+        run_refresh(ctx, cat, bound, norm, sd)
+    # the committed (state, watermark) pair is byte-identical: the
+    # crashed refresh left no trace
+    assert open(sp, "rb").read() == before
+    monkeypatch.setattr(inc_state, "commit_state", real)
+
+    res = run_refresh(ctx, cat, bound, norm, sd)
+    assert res.mode == "incremental"
+    got = _rows(table_payload(res.table))
+    assert got == _rows(table_payload(_oracle(plain, "t", p)))
+
+
+def test_state_commit_atomic_roundtrip(tmp_path):
+    sp = str(tmp_path / "state.npz")
+    cols = {"k": np.asarray([b"a", b"b"]),
+            "s": np.asarray([3, 4], dtype=np.int32)}
+    inc_state.commit_state(sp, 7, cols)
+    assert not os.path.exists(sp + ".tmp")
+    wm, loaded = inc_state.load_state(sp)
+    assert wm == 7
+    assert loaded["s"].dtype == np.int32
+    np.testing.assert_array_equal(loaded["s"], [3, 4])
+    assert [bytes(x) for x in loaded["k"]] == [b"a", b"b"]
+    # the fingerprint ignores row counts (stable across appends) but
+    # pins query + table + path + schema
+    k1 = inc_state.state_key("q", "t", "/p", {"v": {"kind": "int32"}})
+    assert k1 == inc_state.state_key("q", "t", "/p",
+                                     {"v": {"kind": "int32"}})
+    assert k1 != inc_state.state_key("q2", "t", "/p",
+                                     {"v": {"kind": "int32"}})
+    assert k1 != inc_state.state_key("q", "t", "/other",
+                                     {"v": {"kind": "int32"}})
+
+
+# -- satellite: events + metrics + analyze fold ------------------------------
+
+
+def test_inc_events_fold_into_metrics_and_analyze(ctx, tmp_path):
+    from dryad_tpu.obs.analyze import analyze_events
+    from dryad_tpu.obs.metrics import Registry, metrics_from_events
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(16, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    q = ("SELECT k, SUM(v) AS s FROM t GROUP BY k "
+         "ORDER BY s LIMIT 2 EMIT EVERY 1")
+    bound = sql.compile_query(cat, q)[1]
+    log = EventLog(level=2)
+    run_refresh(ctx, cat, bound, sql.normalize_query(q),
+                str(tmp_path / "st"), event=log)
+    reg = metrics_from_events(log.events, Registry())
+    text = reg.render()
+    assert "dryad_inc_refreshes_total" in text
+    assert "dryad_inc_fallbacks_total" in text
+    rep = analyze_events(log.events)
+    assert rep.inc_refreshes == 1
+    assert rep.inc_fallbacks == 1
+    assert "continuous:" in rep.render()
+
+
+# -- tentpole (c): the service-resident standing-query lifecycle -------------
+
+
+def _grow(ctx, path, n, seed):
+    append_store(path, ctx.from_columns(_cols(n, seed)).node.data)
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.time()
+    while not pred():
+        if time.time() - t0 > timeout:
+            return False
+        time.sleep(0.02)
+    return True
+
+
+@pytest.mark.slow
+def test_service_standing_lifecycle(ctx, tmp_path):
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(32, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    svc = JobService(ServiceConfig(service_dir=str(tmp_path / "svc"),
+                                   slots=2), catalog=cat)
+    try:
+        sid = svc.submit_sql("SELECT k, SUM(v) AS s FROM t GROUP BY k "
+                             "EMIT EVERY 0.1", tenant="alice")
+        sq = svc.standing.get(sid)
+        assert sq is not None and sid.startswith("alice-standing-")
+        row = svc.status(sid)
+        assert row["standing"] is True and row["state"] == "running"
+        assert _wait(lambda: sq.refreshes >= 1)
+        # idle store -> the generation check skips refresh jobs
+        r = sq.refreshes
+        time.sleep(0.4)
+        assert sq.refreshes == r
+        # growth -> exactly one more refresh, incremental
+        _grow(ctx, p, 8, 2)
+        assert _wait(lambda: sq.refreshes >= r + 1)
+        assert sq.last_mode == "incremental"
+        # its refreshes ran as normal fair-share jobs under the tenant
+        jobs = svc.list_jobs()
+        assert jobs and all(j["app"] == "inc-refresh" for j in jobs)
+        assert all(j["tenant"] == "alice" for j in jobs)
+        # the standing stream carries the delta records
+        evs, _ = sq.events_since(0)
+        inc = [e for e in evs if e.get("event") == "inc_refresh"]
+        assert inc and "delta" in inc[-1]
+        assert all(e.get("job") == sid for e in evs)
+        assert svc.standing_rows()[0]["job"] == sid
+        # registration file exists until cancel unregisters
+        reg = os.path.join(svc.standing.dir, sid + ".json")
+        assert os.path.exists(reg)
+        assert svc.cancel(sid) is True
+        assert sq.state == "cancelled" and sq.log.closed
+        assert not os.path.exists(reg)
+        assert svc.cancel(sid) is False
+    finally:
+        svc.close()
+
+
+@pytest.mark.slow
+def test_service_restart_resumes_watermark(ctx, tmp_path):
+    """Daemon stops (or dies) and restarts: the persisted registration
+    + committed state resume the standing query from the last
+    watermark — the first post-restart growth scans ONLY its delta and
+    no chunk is ever double-counted."""
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(32, 1)).to_store(p)
+    sdir = str(tmp_path / "svc")
+    q = "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t GROUP BY k " \
+        "EMIT EVERY 0.1"
+    svc = JobService(ServiceConfig(service_dir=sdir, slots=2),
+                     catalog=sql.Catalog().register_store("t", p))
+    sid = svc.submit_sql(q, tenant="bob")
+    sq = svc.standing.get(sid)
+    assert _wait(lambda: sq.refreshes >= 1)
+    svc.close()
+    assert sq.state == "stopped"
+
+    # rows appended while the daemon is DOWN are exactly the next delta
+    _grow(ctx, p, 12, 7)
+    svc2 = JobService(ServiceConfig(service_dir=sdir, slots=2),
+                      catalog=sql.Catalog().register_store("t", p))
+    try:
+        sq2 = svc2.standing.get(sid)
+        assert sq2 is not None, "registration did not survive restart"
+        assert _wait(lambda: sq2.refreshes >= 1)
+        evs, _ = sq2.events_since(0)
+        inc = [e for e in evs if e.get("event") == "inc_refresh"]
+        assert inc and inc[0]["mode"] == "incremental"
+        # only the while-down append was scanned, not the whole store
+        assert inc[0]["delta_parts"] >= 1
+        assert inc[0]["delta_rows"] == 12
+        # and nothing was double-counted across the restart: the merged
+        # result has as many groups as a full rescan sees
+        want = _rows(table_payload(_oracle(
+            "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t GROUP BY k",
+            "t", p)))
+        assert sq2.last_rows == len(want)
+    finally:
+        svc2.close()
+
+
+@pytest.mark.slow
+def test_sse_two_standing_queries_no_leakage(ctx, tmp_path):
+    """Two concurrent standing queries under different tenants: each
+    SSE stream carries only its OWN records (job-tagged end to end),
+    and cancel delivers each stream's terminal frame."""
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.http import Client, serve
+    from dryad_tpu.service.tenancy import ServiceConfig
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(32, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    svc = JobService(ServiceConfig(service_dir=str(tmp_path / "svc"),
+                                   slots=2), catalog=cat)
+    srv, port = serve(svc)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    c = Client(f"http://127.0.0.1:{port}")
+    try:
+        a = c.submit_sql("SELECT k, SUM(v) AS s FROM t GROUP BY k "
+                         "EMIT EVERY 0.1", tenant="alice")
+        b = c.submit_sql("SELECT COUNT(*) AS n FROM t EMIT EVERY 0.1",
+                         tenant="bob")
+        assert a != b
+        rows = c.standing()
+        assert {r["job"] for r in rows} == {a, b}
+        assert c.status(a)["standing"] is True
+
+        got = {a: [], b: []}
+
+        def follow(sid):
+            for e in c.stream_events(sid):
+                got[sid].append(e)
+
+        ta = threading.Thread(target=follow, args=(a,), daemon=True)
+        tb = threading.Thread(target=follow, args=(b,), daemon=True)
+        ta.start()
+        tb.start()
+        sqa, sqb = svc.standing.get(a), svc.standing.get(b)
+        assert _wait(lambda: sqa.refreshes >= 1 and sqb.refreshes >= 1)
+        _grow(ctx, p, 8, 9)
+        assert _wait(lambda: sqa.refreshes >= 2 and sqb.refreshes >= 2)
+        assert c.cancel(a) is True and c.cancel(b) is True
+        ta.join(timeout=30)
+        tb.join(timeout=30)
+        assert not ta.is_alive() and not tb.is_alive()
+        for sid in (a, b):
+            evs = got[sid]
+            assert any(e.get("event") == "inc_refresh" for e in evs)
+            # ZERO cross-job leakage: every record is tagged with the
+            # stream's own standing id
+            assert evs and all(e.get("job") == sid for e in evs)
+        # bob's global count saw the appended rows
+        ns = [e["delta"]["table"]["n"][0]
+              for e in got[b] if e.get("event") == "inc_refresh"
+              and e["delta"]["rows"]]
+        assert ns and ns[-1] == 40
+    finally:
+        srv.shutdown()
+        svc.close()
+
+
+def test_standing_rejected_on_cluster_shape(ctx, tmp_path):
+    """EMIT EVERY on a cluster-fleet daemon is the typed DTA910
+    malformed-job rejection, not a hang or a 500."""
+    from dryad_tpu.inc.standing import StandingManager
+    from dryad_tpu.service.tenancy import MalformedJobError
+
+    p = str(tmp_path / "s")
+    ctx.from_columns(_cols(8, 1)).to_store(p)
+    cat = sql.Catalog().register_store("t", p)
+    bound = sql.compile_query(cat, "SELECT k FROM t EMIT EVERY 1")[1]
+
+    class _Svc:
+        cluster = object()
+        catalog = cat
+
+    mgr = StandingManager.__new__(StandingManager)
+    mgr.service = _Svc()
+    with pytest.raises(MalformedJobError):
+        mgr.register("q", "q", bound, "alice")
+
+
+# -- satellite: bench --smoke-inc runs as a fast pytest ----------------------
+
+
+@pytest.mark.slow
+def test_bench_smoke_inc(tmp_path, monkeypatch):
+    """bench.py --smoke-inc end-to-end at toy size: incremental beats
+    the full rescan with identical rows, and the trend record lands.
+    The COMMITTED full-size number is guarded separately below."""
+    sys.path.insert(0, _REPO)
+    import bench
+    monkeypatch.setenv("BENCH_INC_ROWS", "4000")
+    monkeypatch.setenv("BENCH_INC_ROUNDS", "2")
+    monkeypatch.setenv("BENCH_TREND_PATH", str(tmp_path / "trend.jsonl"))
+    out = bench.smoke_inc(out_path=str(tmp_path / "BENCH_inc.json"),
+                          reps=3, quiet=True)
+    assert out["rows_identical"] is True
+    assert out["wall_s_incremental"] > 0 and out["wall_s_full"] > 0
+    assert all(r["mode"] == "incremental" for r in out["per_round"])
+    assert all(r["delta_rows"] == 200 for r in out["per_round"])
+    data = json.loads((tmp_path / "BENCH_inc.json").read_text())
+    assert data["metric"].startswith("inc smoke")
+    trend = (tmp_path / "trend.jsonl").read_text().strip().splitlines()
+    assert json.loads(trend[-1])["app"] == "bench-inc"
+
+
+def test_committed_inc_smoke_bar():
+    """The committed full-size BENCH_inc.json must hold the ISSUE-16
+    acceptance bar: warm incremental refresh at 5% growth >= 2x faster
+    than the full re-run, with identical rows."""
+    doc = json.load(open(os.path.join(_REPO, "BENCH_inc.json")))
+    assert doc["rows_identical"] is True
+    assert doc["growth_pct"] == 5.0
+    assert doc["speedup_x"] >= 2.0, doc["speedup_x"]

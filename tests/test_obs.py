@@ -71,6 +71,15 @@ def test_every_emitted_event_kind_is_registered():
     assert _LEVELS["analyze_report"] == 1
     assert _LEVELS["slo_breach"] == 1
     assert _LEVELS["regression_suspect"] == 1
+    # continuous queries (dryad_tpu/inc): registrations, per-refresh
+    # summaries (the record SSE followers of a standing id consume),
+    # state commits, and full-rescan fallbacks are all job-lifecycle
+    # grade — a level-1 standing stream must carry its deltas
+    assert _LEVELS["standing_query_registered"] == 1
+    assert _LEVELS["standing_query_cancelled"] == 1
+    assert _LEVELS["inc_refresh"] == 1
+    assert _LEVELS["inc_state_write"] == 1
+    assert _LEVELS["inc_fallback_rescan"] == 1
 
 
 # -- satellite: EventLog lifecycle -------------------------------------------
