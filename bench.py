@@ -564,6 +564,113 @@ def smoke_inc(out_path="BENCH_inc.json", n_rows=None, rounds=None,
     return out
 
 
+def smoke_reuse(out_path="BENCH_reuse.json", n_rows=None, reps=None,
+                quiet=False):
+    """Semantic cross-job reuse smoke (``python bench.py --smoke`` /
+    ``--smoke-reuse``): tenant A submits a SQL aggregate cold
+    (parse -> bind -> lower -> plan -> compile), then tenant B submits
+    a SYNTACTICALLY DIFFERENT but semantically equal query — different
+    alias, reordered predicates and SELECT list, flipped comparison.
+    The daemon's plan cache keys on the canonical semantic fingerprint
+    (analysis/canon.py), so B must hit (DTA501 reuse_verdict), spend
+    ~zero compile, and return bit-identical rows; the headline is B's
+    submit->result wall vs the cold one.  Each rep builds a FRESH
+    daemon (own FileCache dir), so every rep pays its own cold start.
+    Written to ``BENCH_reuse.json`` + appended to ``BENCH_trend.jsonl``
+    (app ``bench-reuse``)."""
+    import statistics
+    import tempfile
+
+    from dryad_tpu import sql
+    from dryad_tpu.parallel.mesh import make_mesh
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+    from dryad_tpu.utils.config import JobConfig
+
+    n_rows = n_rows or int(os.environ.get("BENCH_REUSE_ROWS", "20000"))
+    reps = max(3, reps or int(os.environ.get("BENCH_REUSE_REPS", "3")))
+    rng = np.random.RandomState(0)
+    cat = sql.Catalog()
+    cat.register_columns("lineitem", {
+        "okey": rng.randint(0, 50, n_rows).astype(np.int32),
+        "price": rng.randint(1, 100, n_rows).astype(np.int32),
+        "qty": rng.randint(1, 10, n_rows).astype(np.int32)})
+    q_cold = ("SELECT l.okey AS okey, SUM(l.price * l.qty) AS revenue "
+              "FROM lineitem AS l WHERE l.qty > 2 AND l.price < 90 "
+              "GROUP BY l.okey ORDER BY revenue DESC LIMIT 8")
+    q_warm = ("SELECT x.okey AS okey, SUM(x.qty * x.price) AS revenue "
+              "FROM lineitem AS x WHERE 90 > x.price AND 2 < x.qty "
+              "GROUP BY x.okey ORDER BY revenue DESC LIMIT 8")
+    mesh = make_mesh()
+    cold_walls, warm_walls, warm_compiles = [], [], []
+    identical = True
+    hits = 0
+    for _ in range(reps):
+        with tempfile.TemporaryDirectory(prefix="bench-reuse-") as d:
+            # pin the exchange strategy so the warm job's stage
+            # programs key identically to the cold job's (the probe
+            # would otherwise re-decide — and recompile — per run)
+            svc = JobService(
+                ServiceConfig(service_dir=d, slots=2,
+                              job_config=JobConfig(
+                                  exchange_probe_min_mb=-1.0)),
+                mesh=mesh, catalog=cat)
+            try:
+                t0 = time.time()
+                j1 = svc.submit_sql(q_cold, tenant="alice")
+                r1 = svc.wait(j1, timeout=600)
+                cold_walls.append(time.time() - t0)
+                t0 = time.time()
+                j2 = svc.submit_sql(q_warm, tenant="bob")
+                r2 = svc.wait(j2, timeout=600)
+                warm_walls.append(time.time() - t0)
+                assert r1["state"] == "done", r1
+                assert r2["state"] == "done", r2
+                identical &= (r1["result"] == r2["result"])
+                hits += sum(1 for e in svc.log.events
+                            if e.get("event") == "reuse_verdict"
+                            and e.get("code") == "DTA501")
+                warm_compiles.append(sum(
+                    e.get("compile_s", 0)
+                    for e in svc.jobs[j2].log.events
+                    if e.get("event") == "stage_done"))
+            finally:
+                svc.close()
+    cold_s = statistics.median(cold_walls)
+    warm_s = statistics.median(warm_walls)
+    out = {
+        "metric": "semantic reuse smoke (2nd tenant's reordered query "
+                  "submit->result vs cold, fingerprint-keyed cache)",
+        "rows": n_rows,
+        "reps": reps,
+        "wall_s_cold": round(cold_s, 4),
+        "wall_s_warm": round(warm_s, 4),
+        "wall_s_cold_all": [round(w, 4) for w in cold_walls],
+        "wall_s_warm_all": [round(w, 4) for w in warm_walls],
+        "speedup_pct": (round(100.0 * (cold_s - warm_s) / cold_s, 1)
+                        if cold_s > 0 else None),
+        "warm_compile_s": round(statistics.median(warm_compiles), 4),
+        "semantic_hits": hits,
+        "rows_identical": identical,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-reuse",
+            "wall_s": round(warm_s, 4),
+            "cold_wall_s": round(cold_s, 4),
+            "speedup_pct": out["speedup_pct"],
+            "warm_compile_s": out["warm_compile_s"],
+            "semantic_hits": hits, "rows": n_rows,
+            "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def smoke_analyze(out_path="BENCH_analyze.json", n_lines=None,
                   reps=None, quiet=False):
     """EXPLAIN ANALYZE smoke (``python bench.py --smoke-analyze``, also
@@ -1955,6 +2062,9 @@ if __name__ == "__main__":
     elif "--smoke-inc" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-inc"]
         smoke_inc(out_path=args[0] if args else "BENCH_inc.json")
+    elif "--smoke-reuse" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-reuse"]
+        smoke_reuse(out_path=args[0] if args else "BENCH_reuse.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
@@ -1978,5 +2088,7 @@ if __name__ == "__main__":
                   quiet=True)
         smoke_inc(out_path=os.path.join(base, "BENCH_inc.json"),
                   quiet=True)
+        smoke_reuse(out_path=os.path.join(base, "BENCH_reuse.json"),
+                    quiet=True)
     else:
         main()

@@ -97,7 +97,11 @@ def collect(repo_dir: Optional[str] = None) -> Dict[str, Dict[str, float]]:
         os.path.dirname(os.path.abspath(__file__)))
     rounds: Dict[str, Dict[str, float]] = {}
     for path in sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))):
-        tag = re.search(r"BENCH_(r\d+)\.json", path).group(1)
+        # the round glob also matches named smokes (BENCH_reuse.json)
+        m = re.search(r"BENCH_(r\d+)\.json", path)
+        if m is None:
+            continue
+        tag = m.group(1)
         try:
             cap = json.load(open(path))
             text = cap.get("tail", "") if isinstance(cap, dict) else ""

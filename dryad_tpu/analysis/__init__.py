@@ -13,11 +13,17 @@ equivalent lives here.  Entry points:
   offline (CI over committed plan artifacts)
 """
 
+from dryad_tpu.analysis.canon import (  # noqa: F401
+    canon_prog, canonical_form_json, canonical_select, conjuncts_of,
+    dag_fingerprints, node_fingerprint, scan_prefix,
+    semantic_fingerprint)
 from dryad_tpu.analysis.diagnostics import (  # noqa: F401
     CODES, RUNTIME_ONLY_CODES, Diagnostic, DiagnosticError,
     DiagnosticReport, LintError, Span)
 from dryad_tpu.analysis.plan_rules import (  # noqa: F401
     RULES, STATIC_RULE_CODES, PlanCheck, check_plan)
+from dryad_tpu.analysis.subsume import (  # noqa: F401
+    Verdict, compare, dataset_share_verdict, implies)
 from dryad_tpu.analysis.udf_lint import (  # noqa: F401
     fn_def_site, lint_udf, shippability_of)
 
@@ -26,6 +32,10 @@ __all__ = [
     "DiagnosticReport", "LintError", "Span",
     "RULES", "STATIC_RULE_CODES", "PlanCheck", "check_plan",
     "fn_def_site", "lint_udf", "shippability_of", "check_plan_json",
+    "canon_prog", "canonical_form_json", "canonical_select",
+    "conjuncts_of", "dag_fingerprints", "node_fingerprint",
+    "scan_prefix", "semantic_fingerprint",
+    "Verdict", "compare", "dataset_share_verdict", "implies",
 ]
 
 

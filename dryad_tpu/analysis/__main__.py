@@ -67,7 +67,20 @@ def _selfcheck() -> int:
     else:
         print("docs/diagnostics.md: in sync with diagnostics.CODES")
 
+    # every diagnostic code must belong to a documented family — a new
+    # code series (e.g. DTA5xx) that skips _CODE_FAMILIES would render
+    # into docs/diagnostics.md without a family heading
+    from dryad_tpu.analysis.diagnostics import _CODE_FAMILIES, CODES
+    orphans = [c for c in CODES
+               if not any(c.startswith(p) for p, _ in _CODE_FAMILIES)]
+    if orphans:
+        failures.append(f"diagnostics codes with no _CODE_FAMILIES "
+                        f"entry: {', '.join(sorted(orphans))}")
+    else:
+        print("diagnostics families: every code covered")
+
     failures.extend(_sql_golden_check())
+    failures.extend(_canon_golden_check())
     failures.extend(_obs_docs_check())
 
     import json as _json
@@ -182,6 +195,60 @@ def _sql_golden_check() -> list:
     return failures
 
 
+def _canon_golden_check() -> list:
+    """Canonical-form drift gate: every committed ``docs/plans/*.sql``
+    re-canonicalizes (analysis/canon.canonical_form_json, schema-only
+    catalog) to EXACTLY its committed ``<name>.canon.json``.  A change
+    to the canonicalization pass silently reshuffles semantic
+    fingerprints — every cached plan orphans at once — so it must be
+    deliberate: regenerate with ``--selfcheck --write-docs``."""
+    failures = []
+    plans_dir = _REPO / "docs" / "plans"
+    sqls = sorted(plans_dir.glob("*.sql"))
+    cat_path = plans_dir / "sql_catalog.json"
+    if not sqls or not cat_path.exists():
+        return []     # _sql_golden_check already reports the gap
+    from dryad_tpu.analysis.canon import canonical_form_json
+    from dryad_tpu.sql import Catalog, compile_query
+    catalog = Catalog.load(str(cat_path))
+    for sp in sqls:
+        golden = sp.with_suffix(".canon.json")
+        if not golden.exists():
+            failures.append(f"{sp.name}: no committed canonical form "
+                            f"{golden.name} (regenerate with "
+                            f"--selfcheck --write-docs)")
+            continue
+        _mode, bound = compile_query(catalog, sp.read_text(),
+                                     origin=sp.name)
+        form = canonical_form_json(catalog, bound)
+        if form != golden.read_text():
+            failures.append(
+                f"{golden.name}: stale vs the canonicalization of "
+                f"{sp.name} — semantic fingerprints have moved; if "
+                f"intended, regenerate with --selfcheck --write-docs")
+    if not failures:
+        print(f"canon goldens: {len(sqls)} committed .sql quer"
+              f"{'ies' if len(sqls) != 1 else 'y'} canonicalize to "
+              f"their committed forms")
+    return failures
+
+
+def _write_canon_goldens() -> None:
+    plans_dir = _REPO / "docs" / "plans"
+    cat_path = plans_dir / "sql_catalog.json"
+    if not cat_path.exists():
+        return
+    from dryad_tpu.analysis.canon import canonical_form_json
+    from dryad_tpu.sql import Catalog, compile_query
+    catalog = Catalog.load(str(cat_path))
+    for sp in sorted(plans_dir.glob("*.sql")):
+        _mode, bound = compile_query(catalog, sp.read_text(),
+                                     origin=sp.name)
+        out = sp.with_suffix(".canon.json")
+        out.write_text(canonical_form_json(catalog, bound))
+        print(f"wrote {out}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dryad_tpu.analysis",
@@ -213,6 +280,7 @@ def main(argv=None) -> int:
             out.parent.mkdir(parents=True, exist_ok=True)
             out.write_text(render_code_table())
             print(f"wrote {out}")
+            _write_canon_goldens()
         return _selfcheck()
     if args.plan is None:
         ap.error("a plan path is required (or --selfcheck)")

@@ -20,6 +20,9 @@ Code space:
 * DTA4xx — incremental execution (dryad_tpu/inc: info-grade verdicts on
   how a standing query's refresh runs — incremental merge into persisted
   state vs full re-run — shown by EXPLAIN and carried on refresh events)
+* DTA5xx — plan equivalence & cross-job reuse (analysis/canon.py +
+  analysis/subsume.py: info-grade verdicts on whether two plans may
+  share compiled artifacts / cached scans, and WHY sharing is refused)
 * DTA9xx — runtime-only conditions (data-dependent overflows, internal
   invariants, worker-side deploy errors) that no static rule can predict
 """
@@ -96,6 +99,22 @@ CODES = {
     "DTA403": "cost model chose a full re-run for this refresh (the "
               "chunk delta is most of the store — state is rebuilt, "
               "not merged)",
+    # -- plan equivalence & cross-job reuse (DTA5xx) -----------------------
+    # info-grade verdicts of the semantic plan-equivalence analyzer
+    # (analysis/canon.py canonical fingerprints + analysis/subsume.py
+    # containment): surfaced by EXPLAIN and carried on service
+    # admission events when a submission reuses cached work
+    "DTA501": "semantically equivalent plan (canonical fingerprints "
+              "match — cached plan / compiled stages / results are "
+              "shareable verbatim)",
+    "DTA502": "subsumed scan+filter prefix (this query's scan reads a "
+              "subset of an equivalent cached prefix: predicate "
+              "implied over Interval bounds, projection a subset, "
+              "same source content)",
+    "DTA503": "unsound to share (plans overlap textually or "
+              "structurally but sharing is refused, with the reason — "
+              "e.g. a nondeterministic UDF in the shared prefix, or "
+              "differing source content)",
     # -- runtime-only (DTA9xx) ---------------------------------------------
     "DTA901": "internal: op kind cannot ride a wave program",
     "DTA902": "internal: unknown exchange kind in streamed plan",
@@ -262,6 +281,9 @@ _CODE_FAMILIES = (
              "line:column spans into the query text)"),
     ("DTA4", "incremental execution (standing-query refresh verdicts: "
              "incremental merge vs full re-run)"),
+    ("DTA5", "plan equivalence & cross-job reuse (canonical-fingerprint "
+             "and subsumption verdicts: what may share cached work, "
+             "and why sharing is refused)"),
     ("DTA9", "runtime-only (no static rule can predict these)"),
 )
 

@@ -7,7 +7,10 @@ its inputs, DrVertex replay) carries the same silent assumption.  Nothing
 enforced it until now — this module walks the UDF's AST and flags the
 constructs that break replay:
 
-* wall-clock / RNG / uuid / os.urandom calls without a fixed seed (DTA101)
+* wall-clock / RNG / uuid / os.urandom calls without a fixed seed
+  (DTA101) — import aliases resolve before matching, so
+  ``import time as t; t.time()`` and ``from datetime import datetime;
+  datetime.now()`` are caught under their real dotted names
 * ``id()`` and builtin ``hash()`` — interpreter/object-identity dependent
   (``hash`` of str/bytes is salted per process) (DTA102)
 * iteration over sets — order varies across processes (DTA103)
@@ -105,6 +108,22 @@ def shippability_of(fn: Callable) -> Optional[str]:
             f"(runtime/shiplan.py) / Context(fn_table=...)")
 
 
+def _alias_ref(v) -> Optional[str]:
+    """Real dotted name behind a bound value: module objects resolve to
+    ``module.__name__`` (``import time as t`` -> ``time``),
+    from-imported classes/functions to ``module.qualname``
+    (``from datetime import datetime`` -> ``datetime.datetime``)."""
+    import types
+    if isinstance(v, types.ModuleType):
+        return v.__name__
+    mod = getattr(v, "__module__", None)
+    qual = getattr(v, "__qualname__", None)
+    if isinstance(mod, str) and isinstance(qual, str) \
+            and "." not in qual:
+        return f"{mod}.{qual}"
+    return None
+
+
 def _dotted(node: ast.AST) -> Optional[str]:
     """a.b.c attribute chain as a dotted string (None for anything else)."""
     parts: List[str] = []
@@ -160,6 +179,21 @@ class _UdfVisitor(ast.NodeVisitor):
                 except ValueError:   # not yet filled (recursive def)
                     pass
         self._payload_flagged: set = set()
+        # import-alias resolution: real dotted name behind each bound
+        # name, so `import time as t; t.time()` matches "time." and
+        # `from datetime import datetime; datetime.now()` matches
+        # "datetime.datetime.now".  Seeded from captured values +
+        # globals; inline import statements add entries during the walk.
+        self.alias_map: dict = {}
+        for name, v in list(self._globals.items()) \
+                + list(self.captured_values.items()):
+            ref = _alias_ref(v)
+            if ref is not None:
+                self.alias_map[name] = ref
+        # names bound by import statements INSIDE the function body —
+        # they are locals too, but the import tells us exactly what
+        # they are, so they resolve despite the local-shadow rule
+        self._inline_imports: set = set()
 
     # -- heavyweight captures (DTA105) ------------------------------------
 
@@ -183,11 +217,43 @@ class _UdfVisitor(ast.NodeVisitor):
     def _flag(self, code: str, msg: str, node: ast.AST) -> None:
         self.findings.append((code, msg, getattr(node, "lineno", 1)))
 
+    # -- import-alias resolution ------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.asname:
+                self.alias_map[a.asname] = a.name
+                self._inline_imports.add(a.asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and not node.level:
+            for a in node.names:
+                self.alias_map[a.asname or a.name] = \
+                    f"{node.module}.{a.name}"
+                self._inline_imports.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def _canon_dotted(self, dotted: str) -> str:
+        """Resolve the head of a dotted call through import aliases.
+        Plain locals shadow the surrounding module's aliases, but a
+        name bound by an import statement in the function body (also
+        a local) resolves — the import says exactly what it is."""
+        head, dot, rest = dotted.partition(".")
+        if head in self.local_names \
+                and head not in self._inline_imports:
+            return dotted
+        ref = self.alias_map.get(head)
+        if ref is None:
+            return dotted
+        return f"{ref}{dot}{rest}" if rest else ref
+
     # -- nondeterministic calls -------------------------------------------
 
     def visit_Call(self, node: ast.Call) -> None:
         dotted = _dotted(node.func)
         if dotted is not None:
+            dotted = self._canon_dotted(dotted)
             if dotted == "id":
                 self._flag("DTA102",
                            "id() depends on interpreter object placement "

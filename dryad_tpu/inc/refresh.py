@@ -85,7 +85,10 @@ class _DeltaCatalog(Catalog):
         self._table = table
         self._partitions = list(partitions)
 
-    def dataset(self, ctx, name: str):
+    def dataset(self, ctx, name: str, loader=None):
+        # ``loader`` (the service's scan-share hook) is ignored on
+        # purpose: a delta scan reads an explicit partition subset, so
+        # a shared full-table PData would be the WRONG rows
         if name != self._table:
             return super().dataset(ctx, name)
         from dryad_tpu.api.dataset import Dataset

@@ -93,6 +93,15 @@ FAMILIES = {
                     "IO provider operations"),
     "io_bytes": ("dryad_io_bytes_total", "IO provider bytes moved"),
     "io_seconds": ("dryad_io_seconds_total", "IO provider wall"),
+    # semantic cross-job reuse (analysis/canon.py + service/daemon.py):
+    # DTA501 plan-cache hits keyed on the semantic fingerprint, and
+    # cold scans avoided by the shared scan registry
+    "plan_reuse": ("dryad_semantic_plan_reuse_total",
+                   "semantic plan-cache hits (DTA501: equivalent "
+                   "query served from the fingerprint-keyed cache)"),
+    "scan_shared": ("dryad_scan_shares_total",
+                    "cold scans avoided by the shared scan registry "
+                    "(concurrent/queued jobs over one table)"),
 }
 
 

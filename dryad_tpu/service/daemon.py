@@ -52,10 +52,6 @@ __all__ = ["JobService"]
 # paths, so no separators or dot-prefixes (path traversal)
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
-# cheap standing-query gate for submit_sql (the parse is authoritative)
-_EMIT_RE = re.compile(r"\bEMIT\s+EVERY\b", re.IGNORECASE)
-
-
 def _now() -> float:
     return time.time()
 
@@ -91,6 +87,16 @@ class JobService:
         self.log = EventLog(os.path.join(root, "service.jsonl"))
         from dryad_tpu.utils.compile_cache import FileCache
         self.plan_cache = FileCache(os.path.join(root, "cache"))
+        # cross-job scan sharing (in-process fleet): loaded table PData
+        # keyed by (name, content fingerprint) — queued/concurrent jobs
+        # whose canonical scan prefixes read the same source content
+        # pay ONE cold scan (analysis/canon.py gives the key identity;
+        # a re-registration with different content changes the
+        # fingerprint and misses, never serving stale rows)
+        from collections import OrderedDict
+        self._scan_cache: "OrderedDict" = OrderedDict()
+        self._scan_lock = threading.Lock()
+        self._scan_cap = 16
         self.admission = AdmissionQueue(config.quota)
         # per-tenant SLO tracking (obs/slo.py): every terminal job folds
         # into the tenant's rolling window; attainment/burn served at
@@ -325,42 +331,48 @@ class JobService:
         a typed :class:`~dryad_tpu.sql.SqlError` rejection (DTA3xx,
         line:column spans, HTTP 400) with ZERO work started and zero
         failure-budget charge — exactly like the app surfaces.  The
-        lowered plan rides the shared FileCache keyed on (normalized
-        query, catalog fingerprint, nparts, config, version): a
-        repeated query skips parse/bind/lower/plan entirely, and the
-        persistent executors' compiled-stage caches make it a
-        zero-compile warm run."""
+        lowered plan rides the shared FileCache keyed on the SEMANTIC
+        fingerprint of the bound statement (analysis/canon.py — plus
+        catalog fingerprint, nparts, config, version): any query that
+        canonicalizes to the same plan — reordered predicates,
+        different aliases, shuffled SELECT list, from ANY tenant —
+        skips lower/plan/serialize entirely (only parse + bind +
+        canonicalization run), and the persistent executors'
+        compiled-stage caches make it a zero-compile warm run.  A hit
+        is surfaced as a DTA501 ``reuse_verdict`` event and the
+        ``plan_reuse`` counter."""
         from dryad_tpu import sql as _sql
         self._check_names("sql", tenant)
         if self._stopping:
             raise ServiceStoppedError()
         self.admission.precheck(tenant)
         norm = _sql.normalize_query(query)
-        # continuous queries: an EMIT EVERY clause registers a standing
-        # query instead of running once.  The regex is only a cheap
-        # gate — the compile (parse -> bind, DTA3xx typed rejections
-        # included) is authoritative, so a false positive (the phrase
-        # inside a literal) just falls through to the one-shot path
-        if _EMIT_RE.search(query):
-            _mode, bound = _sql.compile_query(self.catalog, query)
-            if getattr(bound, "emit_every", None) is not None:
-                if self.standing is None:
-                    raise MalformedJobError("sql", ValueError(
-                        "standing queries (EMIT EVERY) need the "
-                        "in-process fleet"))
-                return self.standing.register(query, norm, bound,
-                                              tenant=tenant,
-                                              priority=priority)
-        # one fingerprint per submission (it content-hashes inline
-        # tables): the cache key and both event records share it
+        # ONE compile (parse -> bind, DTA3xx typed rejections included)
+        # per submission: the standing-query gate, the semantic
+        # fingerprint, and the cold-path lowering all reuse it
+        _mode, bound = _sql.compile_query(self.catalog, query)
+        if getattr(bound, "emit_every", None) is not None:
+            # continuous queries: an EMIT EVERY clause registers a
+            # standing query instead of running once
+            if self.standing is None:
+                raise MalformedJobError("sql", ValueError(
+                    "standing queries (EMIT EVERY) need the "
+                    "in-process fleet"))
+            return self.standing.register(query, norm, bound,
+                                          tenant=tenant,
+                                          priority=priority)
+        # one fingerprint pair per submission (they content-hash inline
+        # tables): the cache key and both event records share them
         fp = self.catalog.fingerprint()
+        from dryad_tpu.analysis.canon import semantic_fingerprint
+        semfp = semantic_fingerprint(self.catalog, bound)
         try:
             if self.cluster is not None:
                 payload, limit, cached = \
-                    self._build_sql_farm_payload(query, norm, fp)
+                    self._build_sql_farm_payload(bound, semfp, fp)
             else:
                 run_local, cached = \
-                    self._build_sql_local_runner(query, norm, fp)
+                    self._build_sql_local_runner(bound, semfp, fp)
         except _sql.SchemaOnlyTableError as e:
             # querying a schema-only (EXPLAIN-only) table is a client
             # mistake — the documented DTA910 / HTTP 400, never a 500
@@ -375,20 +387,89 @@ class JobService:
                                 params={"sql": norm},
                                 run_local=run_local)
         job.event({"event": "sql_query", "query": norm, "catalog": fp,
-                   "cached_plan": cached})
+                   "semantic": semfp, "cached_plan": cached})
         self.log({"event": "sql_query", "job": job.id, "tenant": tenant,
-                  "query": norm, "catalog": fp, "cached_plan": cached})
+                  "query": norm, "catalog": fp, "semantic": semfp,
+                  "cached_plan": cached})
+        if cached:
+            verdict = (f"DTA501: equivalent to cached plan {semfp}, "
+                       f"zero compile")
+            job.event({"event": "reuse_verdict", "code": "DTA501",
+                       "fingerprint": semfp, "message": verdict})
+            self.log({"event": "reuse_verdict", "job": job.id,
+                      "tenant": tenant, "code": "DTA501",
+                      "fingerprint": semfp})
+            family_counter(REGISTRY, "plan_reuse", tenant=tenant).inc()
         return self._admit(job)
 
-    def _sql_cache_key(self, norm: str, fp: str) -> str:
+    def explain_sql(self, query: str) -> str:
+        """EXPLAIN a query against the service catalog WITHOUT running
+        it: the offline plan text plus the semantic-reuse verdict —
+        whether this query would hit the fingerprint-keyed plan cache
+        (``DTA501 ... zero compile``) on submission."""
+        from dryad_tpu import sql as _sql
+        from dryad_tpu.analysis.canon import semantic_fingerprint
+        _mode, bound = _sql.compile_query(self.catalog, query)
+        out = _sql.offline_explain(self.catalog, query,
+                                   nparts=self.nparts)
+        semfp = semantic_fingerprint(self.catalog, bound)
+        key = self._sql_cache_key(semfp, self.catalog.fingerprint())
+        if self.plan_cache.get(key) is not None:
+            out += (f"\nreuse: DTA501 equivalent to cached plan "
+                    f"{semfp}, zero compile\n")
+        else:
+            out += (f"\nreuse: no cached equivalent (semantic "
+                    f"fingerprint {semfp})\n")
+        return out
+
+    def _sql_cache_key(self, semfp: str, fp: str) -> str:
         import dryad_tpu
         return json.dumps(
-            {"sql": norm, "catalog": fp,
+            {"semantic": semfp, "catalog": fp,
              "nparts": self.nparts, "config": repr(self.job_config),
              "ver": getattr(dryad_tpu, "__version__", "dev")},
             sort_keys=True)
 
-    def _build_sql_farm_payload(self, query: str, norm: str, fp: str):
+    def _load_table(self, name: str):
+        """PData for one catalog table, shared across jobs: the scan
+        registry keys on (table, content fingerprint), so queued or
+        concurrent jobs whose canonical scan prefixes read the same
+        source content pay exactly ONE cold scan — the first loader
+        emits an io span into the service log, every subsequent user
+        records ``scan_shared`` and bumps the counter."""
+        from dryad_tpu.obs import trace
+        from dryad_tpu.sql.catalog import table_fingerprint
+        t = self.catalog.get(name)
+        key = (name, table_fingerprint(t) if t is not None else "?")
+        with self._scan_lock:
+            ent = self._scan_cache.get(key)
+            if ent is None:
+                ent = {"lock": threading.Lock(), "pdata": None}
+                # content-addressed: a re-registration of ``name`` with
+                # different content gets a new key — drop the stale one
+                for k in [k for k in self._scan_cache
+                          if k[0] == name and k != key]:
+                    del self._scan_cache[k]
+                self._scan_cache[key] = ent
+                while len(self._scan_cache) > self._scan_cap:
+                    self._scan_cache.popitem(last=False)
+            else:
+                self._scan_cache.move_to_end(key)
+        with ent["lock"]:
+            if ent["pdata"] is None:
+                sp = trace.start(f"scan {name}", "io", sink=self.log,
+                                 table=name)
+                ent["pdata"] = self.catalog.load_pdata(
+                    self.mesh, name, self.job_config)
+                trace.finish(sp)
+            else:
+                self.log({"event": "scan_shared", "table": name,
+                          "fingerprint": key[1]})
+                family_counter(REGISTRY, "scan_shared",
+                               table=name).inc()
+        return ent["pdata"]
+
+    def _build_sql_farm_payload(self, bound, semfp: str, fp: str):
         """(payload, limit, cache_hit) for the cluster fleet.  The
         FileCache entry holds the SERIALIZED plan plus its DeferredSource
         specs verbatim — a warm submission does zero compile work of any
@@ -396,7 +477,7 @@ class JobService:
         import pickle
 
         from dryad_tpu import sql as _sql
-        key = self._sql_cache_key(norm, fp)
+        key = self._sql_cache_key(semfp, fp)
         cached = self.plan_cache.get(key)
         if cached is not None:
             # pickled, not JSON: inline-table source specs carry numpy
@@ -416,7 +497,6 @@ class JobService:
         # sources/plan to devices_per_process, not the whole gang
         # (exactly what _build_farm_payload's columns_spec does)
         ctx.nparts, ctx.hosts, ctx.levels = self.nparts, 1, ()
-        _mode, bound = _sql.compile_query(self.catalog, query)
         ds, _handles = _sql.lower(ctx, self.catalog, bound)
         graph = plan_query(ds.node, self.nparts, hosts=1,
                            config=self.job_config)
@@ -431,15 +511,17 @@ class JobService:
         return ({"plan": plan_json, "sources": [specs]}, bound.limit,
                 False)
 
-    def _build_sql_local_runner(self, query: str, norm: str, fp: str):
+    def _build_sql_local_runner(self, bound, semfp: str, fp: str):
         """(run_local, cache_hit) for the in-process fleet.  A cache
         hit rebuilds the StageGraph from the stored plan JSON
         (row-expression callables self-decode via the shippable-value
-        protocol) and re-binds only the source slots from the catalog —
-        zero parse/bind/lower/plan work; the shared executor's
-        compiled-stage cache then makes the run itself compile-free."""
+        protocol) and re-binds only the source slots — through the
+        shared scan registry (:meth:`_load_table`), so concurrent hits
+        over one table pay one scan — with zero lower/plan work; the
+        shared executor's compiled-stage cache then makes the run
+        itself compile-free."""
         from dryad_tpu import sql as _sql
-        key = self._sql_cache_key(norm, fp)
+        key = self._sql_cache_key(semfp, fp)
         cached = self.plan_cache.get(key)
         graph = cost_rep = None
         limit = None
@@ -449,8 +531,7 @@ class JobService:
             from dryad_tpu.runtime.shiplan import resolve_fn_table
             meta = json.loads(cached.decode())
             try:
-                src = {slot: self.catalog.load_pdata(
-                           self.mesh, tname, self.job_config)
+                src = {slot: self._load_table(tname)
                        for slot, tname in meta["tables"].items()}
                 graph = graph_from_json(
                     meta["plan"], fn_table=resolve_fn_table(meta["plan"]),
@@ -464,8 +545,8 @@ class JobService:
             from dryad_tpu.plan.planner import plan_query
             ctx = Context(mesh=self.mesh, config=self.job_config,
                           install_trace=False)
-            _mode, bound = _sql.compile_query(self.catalog, query)
-            ds, handles = _sql.lower(ctx, self.catalog, bound)
+            ds, handles = _sql.lower(ctx, self.catalog, bound,
+                                     loader=self._load_table)
             graph = plan_query(ds.node, ctx.nparts, hosts=ctx.hosts,
                                levels=ctx.levels, config=self.job_config)
             cost_rep = ctx._pre_submit_lint(ds.node, cluster=False,

@@ -25,7 +25,8 @@ import json
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["Catalog", "CatalogTable", "SchemaContext",
-           "SchemaOnlyTableError"]
+           "SchemaOnlyTableError", "normalize_schema",
+           "table_fingerprint"]
 
 
 class SchemaOnlyTableError(ValueError):
@@ -50,6 +51,55 @@ def _norm_schema(schema: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
             out[col] = {"kind": "num",
                         "dtype": str(spec.get("dtype", "int32"))}
     return out
+
+
+def normalize_schema(schema: Dict[str, Any]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """COLUMN-ORDER-INSENSITIVE normalized schema: ``_norm_schema``
+    sorted by column name.  The ONE normalization both
+    ``Catalog.fingerprint()`` and the semantic plan fingerprint
+    (analysis/canon.py) hash, so a schema re-registered with its
+    columns in a different order cannot produce a different
+    fingerprint and orphan warm cache entries."""
+    n = _norm_schema(schema)
+    return {col: n[col] for col in sorted(n)}
+
+
+def _inline_content_hash(t: "CatalogTable") -> str:
+    """Content hash of an inline table's columns (column-order
+    insensitive: iterates sorted names)."""
+    h = hashlib.sha256()
+    for col in sorted(t.columns):
+        v = t.columns[col]
+        h.update(col.encode())
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                h.update(x if isinstance(x, bytes)
+                         else str(x).encode())
+                h.update(b"\x00")
+        else:
+            import numpy as np
+            h.update(np.ascontiguousarray(v).tobytes())
+    return h.hexdigest()
+
+
+def table_fingerprint(t: "CatalogTable") -> str:
+    """Per-table CONTENT fingerprint (16 hex chars): normalized schema
+    + row stats + store path / inline column bytes.  Two catalog
+    registrations with the same fingerprint serve the same rows, so a
+    scan of one can be shared by queries over the other — the identity
+    the service's scan-share cache and analysis/subsume.py key on.
+    Shares its normalization with :meth:`Catalog.fingerprint` (the
+    satellite contract: the two can never disagree on column order)."""
+    d: Dict[str, Any] = {"kind": t.kind,
+                         "schema": normalize_schema(t.schema),
+                         "rows": t.rows}
+    if t.path is not None:
+        d["path"] = t.path
+    if t.kind == "inline":
+        d["content"] = _inline_content_hash(t)
+    blob = json.dumps(d, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 def sql_type_of(spec: Dict[str, Any]) -> str:
@@ -190,35 +240,36 @@ class Catalog:
         """Hashes the full registration INCLUDING inline column
         CONTENT (the service's plan cache stores inline source data
         keyed on this — two catalogs with equal schemas but different
-        values must not collide)."""
+        values must not collide).  Schemas hash through
+        :func:`normalize_schema` (shared with the per-table
+        :func:`table_fingerprint` and the semantic plan fingerprint),
+        so re-registering a table with its columns reordered yields
+        the SAME fingerprint — warm cache entries survive."""
         meta = {}
         for n, t in self.tables.items():
             d = t.meta()
+            d["schema"] = normalize_schema(t.schema)
             if t.kind == "inline":
-                h = hashlib.sha256()
-                for col in sorted(t.columns):
-                    v = t.columns[col]
-                    h.update(col.encode())
-                    if isinstance(v, (list, tuple)):
-                        for x in v:
-                            h.update(x if isinstance(x, bytes)
-                                     else str(x).encode())
-                            h.update(b"\x00")
-                    else:
-                        import numpy as np
-                        h.update(np.ascontiguousarray(v).tobytes())
-                d["content"] = h.hexdigest()
+                d["content"] = _inline_content_hash(t)
             meta[n] = d
         blob = json.dumps(meta, sort_keys=True)
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     # -- dataset construction ----------------------------------------------
 
-    def dataset(self, ctx, name: str):
+    def dataset(self, ctx, name: str, loader=None):
         """Root Dataset for ``name`` under ``ctx`` (a real api.Context
         or a :class:`SchemaContext`).  Returns ``(dataset, source
         data-handle)`` — the handle identity lets the service map plan
-        source slots back to table names for warm-cache rebinding."""
+        source slots back to table names for warm-cache rebinding.
+
+        ``loader`` (optional, ``name -> PData``) supplies the source
+        data instead of a fresh store/columns read — the service's
+        scan-share hook: queued/concurrent jobs over the same table
+        bind ONE loaded PData (one cold scan) instead of re-reading.
+        Only honored on an in-process Context (a real mesh) for tables
+        below the auto-stream threshold; streamed and cluster paths
+        keep their own source construction."""
         from dryad_tpu.api.dataset import Dataset
         t = self.tables[name]
         if isinstance(ctx, SchemaContext):
@@ -227,11 +278,30 @@ class Catalog:
             node = E.Source(parents=(), data=_SchemaData(cap),
                             _npartitions=ctx.nparts)
             return Dataset(ctx, node), node.data
+        use_loader = (loader is not None
+                      and getattr(ctx, "mesh", None) is not None
+                      and getattr(ctx, "cluster", None) is None)
         if t.kind == "store":
-            ds = ctx.from_store(t.path)
+            auto = getattr(ctx.config, "ooc_auto_stream_rows", 0)
+            if use_loader and not (auto and t.rows >= auto):
+                from dryad_tpu.io.store import store_meta
+                from dryad_tpu.plan import expr as E
+                meta = store_meta(t.path)
+                pmeta = meta.get("partitioning", {"kind": "none"})
+                part = E.Partitioning(pmeta.get("kind", "none"),
+                                      tuple(pmeta.get("keys", ())))
+                if meta["npartitions"] != ctx.nparts:
+                    part = E.Partitioning.none()
+                ds = ctx.from_pdata(loader(name), partitioning=part)
+            else:
+                ds = ctx.from_store(t.path)
         elif t.kind == "inline":
-            ds = ctx.from_columns(dict(t.columns),
-                                  str_max_len=t.str_max_len)
+            if use_loader:
+                ds = ctx.from_pdata(loader(name),
+                                    host=dict(t.columns))
+            else:
+                ds = ctx.from_columns(dict(t.columns),
+                                      str_max_len=t.str_max_len)
         else:
             raise SchemaOnlyTableError(
                 f"table {name!r} is schema-only (no store path or "

@@ -54,16 +54,19 @@ def _stamp(ds, span):
     return ds
 
 
-def lower(ctx, catalog: Catalog, bound: BoundSelect
+def lower(ctx, catalog: Catalog, bound: BoundSelect, loader=None
           ) -> Tuple[Any, Dict[int, str]]:
     """(dataset, source-handle map) for a bound statement under ``ctx``
     (api.Context or sql.catalog.SchemaContext).  The handle map
     (``id(Source.data) -> table name``) lets the service re-bind plan
-    source slots on a warm plan-cache hit."""
+    source slots on a warm plan-cache hit.  ``loader`` (optional,
+    ``name -> PData``) is forwarded to :meth:`Catalog.dataset` — the
+    service's scan-share hook (one cold scan for concurrent jobs over
+    the same table)."""
     handles: Dict[int, str] = {}
 
     def root(table: str, alias: str, renames: Dict[str, str], span):
-        ds, data = catalog.dataset(ctx, table)
+        ds, data = catalog.dataset(ctx, table, loader=loader)
         handles[id(data)] = table
         _stamp(ds, span)
         return _stamp(ds.select(_rename_projector(renames),

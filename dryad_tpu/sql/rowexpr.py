@@ -30,7 +30,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-__all__ = ["Predicate", "Projector", "render_prog"]
+__all__ = ["Predicate", "Projector", "render_prog", "prog_columns",
+           "fold_prog"]
 
 
 def _is_strcol(v: Any) -> bool:
@@ -167,6 +168,60 @@ def render_prog(prog: List) -> str:
         return f"(-{render_prog(prog[1])})"
     op = prog[1].upper() if prog[1] in ("and", "or") else prog[1]
     return f"({render_prog(prog[2])} {op} {render_prog(prog[3])})"
+
+
+def prog_columns(prog: List) -> set:
+    """Set of physical column names a program reads (dead-column
+    pruning + scan-prefix analysis, analysis/canon.py)."""
+    head = prog[0]
+    if head == "col":
+        return {prog[1]}
+    if head in ("lit", "const"):
+        return set()
+    if head in ("not", "neg"):
+        return prog_columns(prog[1])
+    if head == "bin":
+        return prog_columns(prog[2]) | prog_columns(prog[3])
+    raise ValueError(f"bad row-expression program node {prog!r}")
+
+
+def fold_prog(prog: List) -> List:
+    """Constant-fold column-free subtrees to ``["lit", v, typ]`` —
+    pure data-to-data, mirroring :func:`_ev`'s scalar semantics, so
+    the folded program computes the SAME function.  Division by zero
+    (and any other eval-time surprise) leaves the subtree unfolded;
+    the runtime keeps its behavior."""
+    head = prog[0]
+    if head in ("col", "lit", "const"):
+        return list(prog)
+    if head in ("not", "neg"):
+        x = fold_prog(prog[1])
+        if x[0] == "lit":
+            if head == "not":
+                return ["lit", not x[1], "bool"]
+            return ["lit", -x[1], x[2]]
+        return [head, x]
+    # head == "bin"
+    op = prog[1]
+    a, b = fold_prog(prog[2]), fold_prog(prog[3])
+    if a[0] == "lit" and b[0] == "lit":
+        va, vb = a[1], b[1]
+        try:
+            v = {"+": lambda: va + vb, "-": lambda: va - vb,
+                 "*": lambda: va * vb, "/": lambda: va / vb,
+                 "=": lambda: va == vb, "!=": lambda: va != vb,
+                 "<": lambda: va < vb, "<=": lambda: va <= vb,
+                 ">": lambda: va > vb, ">=": lambda: va >= vb,
+                 "and": lambda: bool(va) and bool(vb),
+                 "or": lambda: bool(va) or bool(vb)}[op]()
+        except (ZeroDivisionError, TypeError):
+            return ["bin", op, a, b]
+        if op in ("=", "!=", "<", "<=", ">", ">=", "and", "or"):
+            return ["lit", bool(v), "bool"]
+        typ = ("float" if op == "/" or "float" in (a[2], b[2])
+               else a[2])
+        return ["lit", v, typ]
+    return ["bin", op, a, b]
 
 
 class _Shippable:

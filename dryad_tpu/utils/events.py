@@ -70,6 +70,11 @@ _LEVELS = {
     # bundles identify SQL jobs by it); sql_lowered carries the lowered
     # shape (outputs/joins/grouping) and is chatter-grade
     "sql_query": 1, "sql_lowered": 2,
+    # semantic plan reuse (analysis/canon + subsume via the daemon): the
+    # DTA501 verdict on a fingerprint-keyed plan-cache hit and a table
+    # load served from another job's cold scan are amortization
+    # evidence — job-lifecycle grade
+    "reuse_verdict": 1, "scan_shared": 1,
     # chatter: progress ticks, losing duplicates, locality notes, spans,
     # periodic resource samples (obs/profile.py), per-stage adapt stats
     # and declined rewrites (dryad_tpu/adapt)

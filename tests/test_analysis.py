@@ -717,3 +717,70 @@ def test_apps_pipelines_check_clean(ctx):
         crep = q.check(cost=True)
         assert crep.clean, f"{name} cost findings:\n{crep.render()}"
         assert "DTA205" in crep.codes(), f"{name}: cost pass did not run"
+
+
+# ---------------------------------------------------------------------------
+# DTA101 alias resolution (aliased imports must not dodge the linter)
+
+
+import time as _aliased_time  # noqa: E402
+from datetime import datetime as _aliased_dt  # noqa: E402
+
+import numpy.random as _aliased_npr  # noqa: E402
+import math as _aliased_math  # noqa: E402
+
+
+def aliased_time_udf(c):
+    return {"k": c["k"], "v": c["v"] + _aliased_time.time()}
+
+
+def aliased_datetime_udf(c):
+    return {"k": c["k"], "v": c["v"] + _aliased_dt.now().second}
+
+
+def aliased_nprandom_udf(c):
+    return {"k": c["k"], "v": c["v"] + _aliased_npr.rand()}
+
+
+def aliased_math_udf(c):
+    return {"k": c["k"], "v": _aliased_math.sqrt(c["v"])}
+
+
+def inline_import_alias_udf(c):
+    import random as r
+    return {"k": c["k"], "v": c["v"] + r.random()}
+
+
+def aliased_seeded_udf(c):
+    rng = _aliased_npr.RandomState(0)
+    return {"k": c["k"], "v": c["v"] + rng.randn()}
+
+
+def test_dta101_sees_through_module_aliases(ctx):
+    # `import time as t; t.time()` and friends: the alias map built
+    # from __globals__ canonicalizes the dotted call before matching
+    for udf in (aliased_time_udf, aliased_datetime_udf,
+                aliased_nprandom_udf):
+        rep = _kv(ctx).select(udf).check()
+        assert "DTA101" in rep.codes(), udf.__name__
+        # spans survive canonicalization: the finding points at the
+        # call inside the UDF, not at some synthetic location
+        d = rep.by_code("DTA101")[0]
+        assert "test_analysis.py" in d.span.file
+        src, first = inspect.getsourcelines(udf)
+        assert first <= d.span.line < first + len(src), udf.__name__
+
+
+def test_dta101_alias_resolution_no_false_positives(ctx):
+    # a deterministic module behind an alias stays clean, and a seeded
+    # ctor reached through an alias keeps its seeded-literal exemption
+    for udf in (aliased_math_udf, aliased_seeded_udf):
+        assert "DTA101" not in _kv(ctx).select(udf).check().codes(), \
+            udf.__name__
+
+
+def test_dta101_inline_import_alias(ctx):
+    # `import random as r` INSIDE the udf: `r` is a local name, but
+    # the inline-import record overrides the local-shadow rule
+    rep = _kv(ctx).select(inline_import_alias_udf).check()
+    assert "DTA101" in rep.codes()

@@ -1,0 +1,491 @@
+"""Semantic plan equivalence tests (analysis/canon.py + subsume.py).
+
+Covers: rowexpr canonicalization (commutation, NNF push-down, AND/OR
+flatten+sort+dedup, constant folding), the semantic fingerprint over
+bound SQL plans, the oracle sweep (syntactically different but
+semantically equal query pairs fingerprint equal AND return
+bit-identical rows), the false-positive guards (different constants,
+extra predicates, LEFT vs INNER must NOT unify), Interval-domain
+subsumption verdicts (DTA501/502/503), Dataset-DAG fingerprints with
+the nondeterministic-UDF refusal, the shared column-order
+normalization between Catalog.fingerprint and the semantic
+fingerprint, and the service integration: a second tenant's reordered
+query is a semantic plan-cache hit (zero compile, identical results)
+and concurrent jobs over one table pay exactly one cold scan.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from dryad_tpu import sql  # noqa: E402
+from dryad_tpu.analysis.canon import (  # noqa: E402
+    canon_prog, canonical_form_json, node_fingerprint, scan_prefix,
+    semantic_fingerprint)
+from dryad_tpu.analysis.subsume import (  # noqa: E402
+    bounds_of, compare, dataset_share_verdict, implies)
+from dryad_tpu.api.dataset import Context  # noqa: E402
+from dryad_tpu.sql.rowexpr import (Predicate, Projector,  # noqa: E402
+                                   fold_prog, prog_columns)
+from dryad_tpu.utils.config import JobConfig  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bind(cat, q):
+    from dryad_tpu.sql.binder import bind
+    from dryad_tpu.sql.parser import parse
+    return bind(cat, parse(q))
+
+
+def _cat(n_rows=400, seed=0):
+    rng = np.random.RandomState(seed)
+    cat = sql.Catalog()
+    cat.register_columns("lineitem", {
+        "okey": rng.randint(0, 30, n_rows).astype(np.int32),
+        "price": rng.randint(1, 50, n_rows).astype(np.int32),
+        "qty": rng.randint(1, 5, n_rows).astype(np.int32)})
+    cat.register_columns("orders", {
+        "okey": np.arange(30, dtype=np.int32),
+        "flag": (np.arange(30) % 2).astype(np.int32)})
+    return cat
+
+
+# -- rowexpr canonicalization ------------------------------------------------
+
+def test_canon_commutes_and_or_and_comparisons():
+    x_gt_3 = ["bin", ">", ["col", "x"], ["lit", 3, "int"]]
+    y_eq_1 = ["bin", "=", ["col", "y"], ["lit", 1, "int"]]
+    a = canon_prog(["bin", "and", x_gt_3, y_eq_1])
+    b = canon_prog(["bin", "and", y_eq_1,
+                    ["bin", "<", ["lit", 3, "int"], ["col", "x"]]])
+    assert a == b
+    # idempotent dedup: x AND x == x
+    assert canon_prog(["bin", "and", x_gt_3, x_gt_3]) == \
+        canon_prog(x_gt_3)
+
+
+def test_canon_not_pushes_to_nnf():
+    x_gt_3 = ["bin", ">", ["col", "x"], ["lit", 3, "int"]]
+    assert canon_prog(["not", x_gt_3]) == \
+        ["bin", "<=", ["col", "x"], ["lit", 3, "int"]]
+    # De Morgan: NOT(a AND b) == NOT a OR NOT b
+    y_eq_1 = ["bin", "=", ["col", "y"], ["lit", 1, "int"]]
+    assert canon_prog(["not", ["bin", "and", x_gt_3, y_eq_1]]) == \
+        canon_prog(["bin", "or", ["not", x_gt_3], ["not", y_eq_1]])
+    # double negation vanishes
+    assert canon_prog(["not", ["not", x_gt_3]]) == canon_prog(x_gt_3)
+
+
+def test_fold_prog_constant_subtrees():
+    assert fold_prog(["bin", "+", ["lit", 2, "int"],
+                      ["lit", 3, "int"]]) == ["lit", 5, "int"]
+    assert fold_prog(["bin", "=", ["lit", 1, "int"],
+                      ["lit", 1, "int"]]) == ["lit", True, "bool"]
+    # division by zero stays unfolded (runtime keeps its behavior)
+    z = ["bin", "/", ["lit", 1, "int"], ["lit", 0, "int"]]
+    assert fold_prog(z) == z
+    # a column blocks folding above it, constants below still fold
+    p = ["bin", "+", ["col", "x"],
+         ["bin", "*", ["lit", 2, "int"], ["lit", 3, "int"]]]
+    assert fold_prog(p) == ["bin", "+", ["col", "x"],
+                            ["lit", 6, "int"]]
+    assert prog_columns(p) == {"x"}
+
+
+def test_canon_no_float_reassociation():
+    # (a + b) + c must NOT flatten/re-sort: float addition is not
+    # associative bitwise, and fingerprint-equal queries must produce
+    # bit-identical results
+    a = ["bin", "+", ["bin", "+", ["col", "a"], ["col", "b"]],
+         ["col", "c"]]
+    b = ["bin", "+", ["col", "a"],
+         ["bin", "+", ["col", "b"], ["col", "c"]]]
+    assert canon_prog(a) != canon_prog(b)
+
+
+# -- semantic fingerprints over bound SQL ------------------------------------
+
+# pairs of syntactically different but semantically equal queries —
+# the oracle sweep: canonical fingerprints must match AND results must
+# be bit-identical
+_EQUIV_PAIRS = [
+    # alias + predicate order + flipped comparison
+    ("SELECT l.okey AS okey, l.price AS price FROM lineitem AS l "
+     "WHERE l.price > 10 AND l.qty = 2",
+     "SELECT z.okey AS okey, z.price AS price FROM lineitem AS z "
+     "WHERE z.qty = 2 AND 10 < z.price"),
+    # SELECT-list order (outputs key by name, not position)
+    ("SELECT l.okey AS a, l.qty AS b FROM lineitem AS l "
+     "WHERE l.price <= 7",
+     "SELECT l.qty AS b, l.okey AS a FROM lineitem AS l "
+     "WHERE l.price <= 7"),
+    # commuted arithmetic + constant folding
+    ("SELECT l.okey AS okey, l.price * l.qty AS v FROM lineitem AS l "
+     "WHERE l.price < 2 + 3",
+     "SELECT l.okey AS okey, l.qty * l.price AS v FROM lineitem AS l "
+     "WHERE l.price < 5"),
+    # NOT pushed through a comparison
+    ("SELECT l.okey AS okey FROM lineitem AS l "
+     "WHERE NOT (l.price > 20)",
+     "SELECT l.okey AS okey FROM lineitem AS l WHERE l.price <= 20"),
+    # aggregate: agg-input expression commuted, predicate reordered
+    ("SELECT l.okey AS okey, SUM(l.price * l.qty) AS rev "
+     "FROM lineitem AS l WHERE l.qty > 1 AND l.price > 5 "
+     "GROUP BY l.okey",
+     "SELECT q.okey AS okey, SUM(q.qty * q.price) AS rev "
+     "FROM lineitem AS q WHERE q.price > 5 AND q.qty > 1 "
+     "GROUP BY q.okey"),
+    # join with reordered ON conjunct aliases
+    ("SELECT l.okey AS okey, o.flag AS flag FROM lineitem AS l "
+     "JOIN orders AS o ON l.okey = o.okey WHERE o.flag = 1",
+     "SELECT a.okey AS okey, b.flag AS flag FROM lineitem AS a "
+     "JOIN orders AS b ON a.okey = b.okey WHERE 1 = b.flag"),
+]
+
+
+def test_oracle_sweep_equivalent_pairs_fingerprint_and_results():
+    cat = _cat()
+    for qa, qb in _EQUIV_PAIRS:
+        fa = semantic_fingerprint(cat, _bind(cat, qa))
+        fb = semantic_fingerprint(cat, _bind(cat, qb))
+        assert fa == fb, f"fingerprints differ:\n{qa}\n{qb}"
+        ra = sql.query(Context(local_debug=True), cat, qa).collect()
+        rb = sql.query(Context(local_debug=True), cat, qb).collect()
+        assert set(ra) == set(rb)
+        for col in ra:
+            va = np.asarray(ra[col])
+            vb = np.asarray(rb[col])
+            # bit-identical, not approximately equal
+            assert va.tobytes() == vb.tobytes(), \
+                f"column {col!r} differs for:\n{qa}\n{qb}"
+
+
+# queries that look related but must NOT unify
+_DISTINCT_FROM_FIRST = [
+    # different constant
+    "SELECT l.okey AS okey, l.price AS price FROM lineitem AS l "
+    "WHERE l.price > 11 AND l.qty = 2",
+    # extra predicate
+    "SELECT l.okey AS okey, l.price AS price FROM lineitem AS l "
+    "WHERE l.price > 10 AND l.qty = 2 AND l.okey > 0",
+    # different output column
+    "SELECT l.okey AS okey, l.qty AS price FROM lineitem AS l "
+    "WHERE l.price > 10 AND l.qty = 2",
+    # strict vs non-strict comparison
+    "SELECT l.okey AS okey, l.price AS price FROM lineitem AS l "
+    "WHERE l.price >= 10 AND l.qty = 2",
+]
+
+
+def test_false_positive_guard_sweep():
+    cat = _cat()
+    base = semantic_fingerprint(cat, _bind(cat, _EQUIV_PAIRS[0][0]))
+    for q in _DISTINCT_FROM_FIRST:
+        assert semantic_fingerprint(cat, _bind(cat, q)) != base, q
+
+
+def test_left_vs_inner_join_do_not_unify():
+    cat = _cat()
+    inner = ("SELECT l.okey AS okey FROM lineitem AS l "
+             "JOIN orders AS o ON l.okey = o.okey")
+    left = ("SELECT l.okey AS okey FROM lineitem AS l "
+            "LEFT JOIN orders AS o ON l.okey = o.okey")
+    assert semantic_fingerprint(cat, _bind(cat, inner)) != \
+        semantic_fingerprint(cat, _bind(cat, left))
+
+
+def test_limit_distinct_order_by_are_significant():
+    cat = _cat()
+    q = "SELECT l.okey AS okey FROM lineitem AS l"
+    fps = {semantic_fingerprint(cat, _bind(cat, v)) for v in
+           (q, q + " LIMIT 5", "SELECT DISTINCT l.okey AS okey "
+            "FROM lineitem AS l", q + " ORDER BY okey")}
+    assert len(fps) == 4
+
+
+def test_same_query_different_content_differs():
+    a = _cat(seed=0)
+    b = _cat(seed=1)
+    q = "SELECT l.okey AS okey FROM lineitem AS l WHERE l.price > 3"
+    assert semantic_fingerprint(a, _bind(a, q)) != \
+        semantic_fingerprint(b, _bind(b, q))
+
+
+def test_golden_canonical_form_stable():
+    # the committed golden form: drift here orphans every cached plan
+    # at once (python -m dryad_tpu.analysis --selfcheck gates this for
+    # docs/plans; this is the same byte-stability contract inline)
+    cat = _cat()
+    b1 = _bind(cat, _EQUIV_PAIRS[0][0])
+    form = canonical_form_json(cat, b1)
+    assert form == canonical_form_json(cat, b1)
+    parsed = json.loads(form)
+    assert parsed["tables"][0]["alias"] == "t0"
+
+
+# -- subsumption (Interval domain) -------------------------------------------
+
+def test_implies_interval_bounds():
+    def conj(op, col, v):
+        return canon_prog(["bin", op, ["col", col], ["lit", v, "int"]])
+    # x > 5 implies x > 3; not vice versa
+    assert implies([conj(">", "x", 5)], [conj(">", "x", 3)])
+    assert not implies([conj(">", "x", 3)], [conj(">", "x", 5)])
+    # strictness at the boundary: x >= 3 does NOT imply x > 3
+    assert not implies([conj(">=", "x", 3)], [conj(">", "x", 3)])
+    assert implies([conj(">", "x", 3)], [conj(">=", "x", 3)])
+    # equality pins the interval
+    assert implies([conj("=", "x", 4)], [conj(">", "x", 3)])
+    # anything implies TRUE; TRUE implies nothing non-trivial
+    assert implies([conj(">", "x", 5)], [])
+    assert not implies([], [conj(">", "x", 5)])
+    # residual conjuncts must match verbatim
+    neq = canon_prog(["bin", "!=", ["col", "y"], ["lit", 7, "int"]])
+    assert implies([conj(">", "x", 5), neq], [neq])
+    assert not implies([conj(">", "x", 5)], [neq])
+
+
+def test_bounds_of_intersects_per_column():
+    c1 = canon_prog(["bin", ">", ["col", "x"], ["lit", 3, "int"]])
+    c2 = canon_prog(["bin", "<=", ["col", "x"], ["lit", 9, "int"]])
+    bounds, residual = bounds_of([c1, c2])
+    assert residual == []
+    b = bounds["x"]
+    assert b.iv.lo == 3.0 and b.lo_strict
+    assert b.iv.hi == 9.0 and not b.hi_strict
+
+
+def test_compare_dta501_and_502_and_unrelated():
+    cat = _cat()
+    cached = _bind(cat, "SELECT l.okey AS okey, l.price AS price "
+                        "FROM lineitem AS l WHERE l.price > 3")
+    same = _bind(cat, "SELECT z.price AS price, z.okey AS okey "
+                      "FROM lineitem AS z WHERE 3 < z.price")
+    v = compare(cat, cached, same)
+    assert v is not None and v.code == "DTA501"
+    # narrower predicate over a column subset the cached scan already
+    # loads: the Tee'd cached scan can serve it
+    narrower = _bind(cat, "SELECT l.okey AS okey FROM lineitem AS l "
+                          "WHERE l.price > 5")
+    v = compare(cat, cached, narrower)
+    assert v is not None and v.code == "DTA502"
+    assert v.detail["direction"] == "cached-covers-new"
+    # a query reading a column outside the cached scan is unrelated
+    extra_col = _bind(cat, "SELECT l.okey AS okey FROM lineitem AS l "
+                           "WHERE l.price > 5 AND l.qty = 2")
+    assert compare(cat, cached, extra_col) is None
+    unrelated = _bind(cat, "SELECT o.okey AS okey FROM orders AS o")
+    assert compare(cat, cached, unrelated) is None
+
+
+def test_compare_dta503_on_content_mismatch():
+    a = _cat(seed=0)
+    b = _cat(seed=1)
+    qa = _bind(a, "SELECT l.okey AS okey FROM lineitem AS l "
+                  "WHERE l.price > 3")
+    qb = _bind(b, "SELECT l.okey AS okey FROM lineitem AS l "
+                  "WHERE l.price > 5")
+    # evaluate qb's prefix against catalog b, qa's against a: simulate
+    # by comparing under a catalog where 'lineitem' changed content —
+    # scan_prefix takes content from the catalog it is given
+    pa = scan_prefix(a, qa)
+    pb = scan_prefix(b, qb)
+    assert pa["content"] != pb["content"]
+    # compare() under one catalog sees consistent content; the DTA503
+    # stale-content arm triggers when prefixes disagree — exercise it
+    # directly via the verdict path with a patched prefix
+    from dryad_tpu.analysis import subsume as S
+    orig = S.scan_prefix
+    try:
+        S.scan_prefix = lambda c, bnd: pa if bnd is qa else pb
+        v = S.compare(a, qa, qb)
+    finally:
+        S.scan_prefix = orig
+    assert v is not None and v.code == "DTA503"
+    assert "content" in v.message
+
+
+def test_standing_query_refused_for_sharing():
+    cat = _cat()
+    import dataclasses
+    one_shot = _bind(cat, "SELECT l.okey AS okey, COUNT(*) AS n "
+                          "FROM lineitem AS l GROUP BY l.okey")
+    # a standing registration of the same statement (EMIT EVERY binds
+    # only over store-backed tables, so stamp the bound directly)
+    standing = dataclasses.replace(one_shot, emit_every=5.0)
+    v = compare(cat, one_shot, standing)
+    assert v is None or v.code != "DTA501"
+
+
+# -- Dataset-DAG fingerprints + nondet refusal -------------------------------
+
+def _stamp_udf(cols):
+    # deliberately nondeterministic: wall clock in the scan prefix
+    return {"x": cols["x"], "t": time.time()}
+
+
+def test_dag_fingerprints_unify_canonical_predicates(devices8):
+    ctx = Context(local_debug=True)
+    base = ctx.from_columns({"x": np.arange(16, dtype=np.int32),
+                             "y": np.arange(16, dtype=np.int32)})
+    p1 = Predicate(["bin", "and",
+                    ["bin", ">", ["col", "x"], ["lit", 3, "int"]],
+                    ["bin", "=", ["col", "y"], ["lit", 1, "int"]]])
+    p2 = Predicate(["bin", "and",
+                    ["bin", "=", ["col", "y"], ["lit", 1, "int"]],
+                    ["bin", "<", ["lit", 3, "int"], ["col", "x"]]])
+    a = base.where(p1).select(Projector({"x": ["col", "x"]}))
+    b = base.where(p2).select(Projector({"x": ["col", "x"]}))
+    assert node_fingerprint(a.node) == node_fingerprint(b.node)
+    v = dataset_share_verdict(a.node, b.node)
+    assert v is not None and v.code == "DTA501"
+    # different constant must not unify
+    p3 = Predicate(["bin", ">", ["col", "x"], ["lit", 4, "int"]])
+    c = base.where(p3).select(Projector({"x": ["col", "x"]}))
+    assert node_fingerprint(a.node) != node_fingerprint(c.node)
+
+
+def test_dag_nondet_udf_refuses_sharing(devices8):
+    ctx = Context(local_debug=True)
+    base = ctx.from_columns({"x": np.arange(16, dtype=np.int32)})
+    bad = base.select(_stamp_udf)
+    v = dataset_share_verdict(bad.node, bad.node)
+    assert v is not None and v.code == "DTA503"
+    assert "nondeterministic" in v.message
+    assert "DTA101" in v.detail["findings"]
+
+
+# -- shared column-order normalization (Catalog <-> semantic fp) -------------
+
+def test_reordered_schema_keeps_catalog_and_semantic_fingerprints():
+    rng = np.random.RandomState(0)
+    cols = {"okey": rng.randint(0, 9, 50).astype(np.int32),
+            "price": rng.randint(1, 50, 50).astype(np.int32),
+            "qty": rng.randint(1, 5, 50).astype(np.int32)}
+    fwd = sql.Catalog()
+    fwd.register_columns("t", dict(cols))
+    rev = sql.Catalog()
+    rev.register_columns("t", dict(reversed(list(cols.items()))))
+    # the shared normalization (sql.catalog.normalize_schema): a
+    # re-registration with reordered columns cannot orphan warm cache
+    # entries keyed on either fingerprint
+    assert fwd.fingerprint() == rev.fingerprint()
+    from dryad_tpu.sql.catalog import normalize_schema, \
+        table_fingerprint
+    assert table_fingerprint(fwd.get("t")) == \
+        table_fingerprint(rev.get("t"))
+    assert list(normalize_schema(fwd.get("t").schema)) == \
+        sorted(cols)
+    q = "SELECT a.okey AS okey FROM t AS a WHERE a.price > 3"
+    assert semantic_fingerprint(fwd, _bind(fwd, q)) == \
+        semantic_fingerprint(rev, _bind(rev, q))
+
+
+# -- service integration -----------------------------------------------------
+
+def _svc(tmp_path, **cfg_kw):
+    from dryad_tpu.service import JobService, ServiceConfig
+    return JobService(
+        ServiceConfig(service_dir=str(tmp_path / "svc"), slots=2,
+                      **cfg_kw),
+        catalog=_cat())
+
+
+def test_service_semantic_cache_hit_across_tenants(devices8, tmp_path):
+    # the acceptance bar: two semantically equivalent but textually
+    # different queries from DIFFERENT tenants — the second is a
+    # fingerprint-keyed plan-cache hit with ~zero compile and
+    # bit-identical results, surfaced as a DTA501 reuse_verdict
+    svc = _svc(tmp_path,
+               job_config=JobConfig(exchange_probe_min_mb=-1.0))
+    try:
+        qa = ("SELECT l.okey AS okey, SUM(l.price * l.qty) AS rev "
+              "FROM lineitem AS l WHERE l.qty > 1 AND l.price > 5 "
+              "GROUP BY l.okey ORDER BY rev DESC LIMIT 6")
+        qb = ("SELECT z.okey AS okey, SUM(z.qty * z.price) AS rev "
+              "FROM lineitem AS z WHERE 5 < z.price AND z.qty > 1 "
+              "GROUP BY z.okey ORDER BY rev DESC LIMIT 6")
+        j1 = svc.submit_sql(qa, tenant="alice")
+        r1 = svc.wait(j1)
+        assert r1["state"] == "done"
+        j2 = svc.submit_sql(qb, tenant="bob")
+        r2 = svc.wait(j2)
+        assert r2["state"] == "done"
+        assert r2["result"] == r1["result"]   # bit-identical tables
+        flags = [e["cached_plan"] for e in svc.log.events
+                 if e.get("event") == "sql_query"]
+        assert flags == [False, True]
+        verdicts = [e for e in svc.log.events
+                    if e.get("event") == "reuse_verdict"]
+        assert len(verdicts) == 1
+        assert verdicts[0]["code"] == "DTA501"
+        assert verdicts[0]["tenant"] == "bob"
+        # zero lower/plan beyond canonicalization, zero compile
+        stages2 = [e for e in svc.job(j2).log.events
+                   if e.get("event") == "stage_done"]
+        assert stages2 and all(e["cache_hit"] for e in stages2)
+        assert sum(e["compile_s"] for e in stages2) < 0.05
+        # EXPLAIN surfaces the verdict without running anything
+        njobs = len(svc.jobs)
+        text = svc.explain_sql(qb)
+        assert f"DTA501 equivalent to cached plan" in text
+        assert len(svc.jobs) == njobs
+    finally:
+        svc.close()
+
+
+def test_service_concurrent_jobs_share_one_cold_scan(devices8,
+                                                     tmp_path):
+    svc = _svc(tmp_path,
+               job_config=JobConfig(exchange_probe_min_mb=-1.0))
+    try:
+        # different (non-equivalent) queries over ONE table: the plan
+        # cache cannot help, but the scan registry must — exactly one
+        # io span per table, every later job records scan_shared
+        q1 = ("SELECT l.okey AS okey, SUM(l.price) AS s "
+              "FROM lineitem AS l GROUP BY l.okey")
+        q2 = ("SELECT l.okey AS okey, SUM(l.qty) AS s "
+              "FROM lineitem AS l WHERE l.price > 2 GROUP BY l.okey")
+        jids = [svc.submit_sql(q1, tenant="alice"),
+                svc.submit_sql(q2, tenant="bob")]
+        rows = [svc.wait(j) for j in jids]
+        assert all(r["state"] == "done" for r in rows)
+        scans = [e for e in svc.log.events
+                 if e.get("event") == "span" and e.get("kind") == "io"
+                 and str(e.get("name", "")).startswith("scan ")]
+        assert len(scans) == 1, scans       # ONE cold scan of lineitem
+        assert scans[0]["name"] == "scan lineitem"
+        shared = [e for e in svc.log.events
+                  if e.get("event") == "scan_shared"]
+        assert len(shared) == 1
+        assert shared[0]["table"] == "lineitem"
+    finally:
+        svc.close()
+
+
+# -- bench satellite ---------------------------------------------------------
+
+def test_bench_smoke_reuse(devices8, tmp_path):
+    sys.path.insert(0, _REPO)
+    import bench
+    os.environ["BENCH_TREND_PATH"] = str(tmp_path / "trend.jsonl")
+    try:
+        out = bench.smoke_reuse(
+            out_path=str(tmp_path / "BENCH_reuse.json"),
+            n_rows=4_000, reps=3)
+    finally:
+        os.environ.pop("BENCH_TREND_PATH", None)
+    assert out["rows_identical"] is True
+    assert out["semantic_hits"] == 3        # one DTA501 per rep
+    assert out["warm_compile_s"] < 0.05
+    data = json.loads((tmp_path / "BENCH_reuse.json").read_text())
+    assert data["metric"].startswith("semantic reuse smoke")
+    trend = (tmp_path / "trend.jsonl").read_text().strip().splitlines()
+    assert any(json.loads(ln)["app"] == "bench-reuse" for ln in trend)
