@@ -1478,6 +1478,140 @@ def smoke_service(out_path="BENCH_service.json", n_lines=None,
     return out
 
 
+def smoke_latency(out_path="BENCH_latency.json", n_lines=None,
+                  k_tenants=None, jobs_per_tenant=None, reps=None,
+                  quiet=False):
+    """Tail-latency percentile smoke (``python bench.py
+    --smoke-latency``, the ROADMAP item-4 deliverable): K concurrent
+    tenants submit wordcount jobs through ONE persistent daemon whose
+    fleet was WARMED first (a throwaway submission pays the cold
+    compile), and every request's settled phase waterfall
+    (obs/latency.py) supplies its submit→result wall.  ``reps``
+    repetitions run interleaved and each percentile reports the MEDIAN
+    across reps (the PR-4 protocol: one anomalous rep cannot own the
+    headline); the committed number is p50/p95/p99 over the per-request
+    walls plus the dominant-phase attribution and the p99 exemplar —
+    whose trace id must resolve to a real recorded trace
+    (``python -m dryad_tpu.obs trace --job ...``)."""
+    import statistics
+    import tempfile
+
+    from dryad_tpu.parallel.mesh import make_mesh
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+
+    n_lines = n_lines or int(os.environ.get("BENCH_LATENCY_LINES",
+                                            "2000"))
+    k_tenants = k_tenants or int(os.environ.get("BENCH_LATENCY_TENANTS",
+                                                "3"))
+    jobs_per_tenant = jobs_per_tenant or int(
+        os.environ.get("BENCH_LATENCY_JOBS", "2"))
+    reps = max(1, reps or int(os.environ.get("BENCH_LATENCY_REPS", "3")))
+    mesh = make_mesh()
+
+    def pctl(vals, q):
+        """Exact percentile over the measured walls (sorted oracle —
+        the sketch's error bound is tested against this in
+        tests/test_latency.py)."""
+        s = sorted(vals)
+        if not s:
+            return 0.0
+        i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[i]
+
+    per_rep = {"p50": [], "p95": [], "p99": []}
+    all_walls = []
+    snap = None
+    exemplar = None
+    exemplar_resolves = False
+    with tempfile.TemporaryDirectory(prefix="bench-lat-") as d:
+        svc = JobService(ServiceConfig(service_dir=d,
+                                       slots=max(2, k_tenants)),
+                         mesh=mesh)
+        try:
+            # warm the fleet: the cold XLA compile is the amortized
+            # story (BENCH_service.json); this smoke measures the
+            # INTERACTIVE tail on a warm service
+            jw = svc.submit("wordcount", {"n_lines": n_lines, "seed": 0},
+                            tenant="warmup")
+            assert svc.wait(jw, timeout=600)["state"] == "done"
+            for _ in range(reps):
+                jids = [svc.submit("wordcount",
+                                   {"n_lines": n_lines, "seed": 0},
+                                   tenant=f"tenant{i % k_tenants}")
+                        for i in range(k_tenants * jobs_per_tenant)]
+                rows = [svc.wait(j, timeout=600) for j in jids]
+                assert all(r["state"] == "done" for r in rows), rows
+                walls = [svc.jobs[j].waterfall["wall_s"] for j in jids]
+                all_walls.extend(walls)
+                for q, key in ((0.50, "p50"), (0.95, "p95"),
+                               (0.99, "p99")):
+                    per_rep[key].append(pctl(walls, q))
+            snap = svc.latency_snapshot()
+            # the slowest request across tenants: its job id + trace id
+            # is the one-click p99 attribution — verify the trace id
+            # resolves to a real recorded span in that job's archive
+            exes = [r["exemplar"] for t, r in snap.items()
+                    if r.get("exemplar") and t != "warmup"]
+            if exes:
+                exemplar = max(exes, key=lambda e: e["wall_s"])
+                ej = svc.jobs.get(exemplar["job"])
+                exemplar_resolves = bool(
+                    exemplar.get("trace") and ej is not None
+                    and any(e.get("trace") == exemplar["trace"]
+                            for e in ej.log.events
+                            if e.get("event") == "span"))
+        finally:
+            svc.close()
+    dom_us = {}
+    for r in snap.values():
+        if r["tenant"] == "warmup":
+            continue
+        for ph in r["phases"]:
+            dom_us[ph["phase"]] = (dom_us.get(ph["phase"], 0.0)
+                                   + ph["total_s"])
+    out = {
+        "metric": "tail latency: K concurrent tenants on a warm fleet "
+                  "(submit->result walls from per-request phase "
+                  "waterfalls)",
+        "k_tenants": k_tenants,
+        "jobs_per_tenant": jobs_per_tenant,
+        "lines_per_job": n_lines,
+        "reps": reps,
+        "requests": len(all_walls),
+        "p50_s": round(statistics.median(per_rep["p50"]), 4),
+        "p95_s": round(statistics.median(per_rep["p95"]), 4),
+        "p99_s": round(statistics.median(per_rep["p99"]), 4),
+        "p50_s_all": [round(w, 4) for w in per_rep["p50"]],
+        "p99_s_all": [round(w, 4) for w in per_rep["p99"]],
+        "dominant_phase": (max(dom_us, key=dom_us.get)
+                           if dom_us else None),
+        "phase_totals_s": {k: round(v, 4)
+                           for k, v in sorted(dom_us.items())},
+        "per_tenant": {t: {"count": r["count"], "p50_s": r["p50_s"],
+                           "p95_s": r["p95_s"], "p99_s": r["p99_s"],
+                           "dominant": r["dominant"]}
+                       for t, r in snap.items() if t != "warmup"},
+        "exemplar": exemplar,
+        "exemplar_trace_resolves": exemplar_resolves,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-smoke-latency",
+            "wall_s": out["p99_s"], "p50_s": out["p50_s"],
+            "p95_s": out["p95_s"], "p99_s": out["p99_s"],
+            "dominant_phase": out["dominant_phase"],
+            "k_tenants": k_tenants, "lines": n_lines,
+            "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def main():
     import jax
 
@@ -2065,6 +2199,9 @@ if __name__ == "__main__":
     elif "--smoke-reuse" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-reuse"]
         smoke_reuse(out_path=args[0] if args else "BENCH_reuse.json")
+    elif "--smoke-latency" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-latency"]
+        smoke_latency(out_path=args[0] if args else "BENCH_latency.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
@@ -2090,5 +2227,7 @@ if __name__ == "__main__":
                   quiet=True)
         smoke_reuse(out_path=os.path.join(base, "BENCH_reuse.json"),
                     quiet=True)
+        smoke_latency(out_path=os.path.join(base, "BENCH_latency.json"),
+                      quiet=True)
     else:
         main()

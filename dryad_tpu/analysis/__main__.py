@@ -124,9 +124,9 @@ def _obs_docs_check() -> list:
     missing = [f"obs subcommand {c!r}" for c in OBS_COMMANDS
                if c not in text]
     missing += [f"surface {s!r}" for s in
-                ("/events/", "/slo", "EXPLAIN ANALYZE",
+                ("/events/", "/slo", "/latency", "EXPLAIN ANALYZE",
                  "regression_suspect", "slo_breach",
-                 "DRYAD_LOGGING_LEVEL")
+                 "latency_waterfall", "DRYAD_LOGGING_LEVEL")
                 if s not in text]
     if missing:
         return [f"{doc}: stale — not mentioned: {', '.join(missing)}"]

@@ -146,8 +146,12 @@ class Run:
             # the job span: every stage/io span of this run parents into
             # it (on a worker the envelope's trace_ctx makes it a child
             # of the driver's job span — obs/trace.py propagation)
+            # the span stays bound past the with-block so job_done can
+            # carry the trace id (the null span below level 2 has
+            # none): the service's latency waterfall links its p99
+            # exemplar to this run's recorded trace through it
             with trace.span("run", "job", sink=self._event,
-                            stages=len(self.graph.stages)):
+                            stages=len(self.graph.stages)) as jsp:
                 # re-read out_stage after the walk: an adaptive rewrite
                 # (agg-tree expansion) may have redirected it to an
                 # appended finalizing stage mid-run
@@ -192,11 +196,15 @@ class Run:
         # (runtime/worker.py sets _emit_job_done=False) — a 16-task farm
         # is one job, not 16.
         if getattr(self.ex, "_emit_job_done", True):
-            self._event({"event": "job_done",
-                            "wall_s": round(_time.time() - t0, 4),
-                            "stages": len(self.graph.stages),
-                            "replays": self.failures,
-                            "metrics": REGISTRY.snapshot()})
+            done_e = {"event": "job_done",
+                      "wall_s": round(_time.time() - t0, 4),
+                      "stages": len(self.graph.stages),
+                      "replays": self.failures,
+                      "metrics": REGISTRY.snapshot()}
+            trace_id = getattr(jsp, "trace_id", None)
+            if trace_id:
+                done_e["trace"] = trace_id
+            self._event(done_e)
         return out
 
     def _settle(self) -> PData:

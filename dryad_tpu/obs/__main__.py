@@ -9,11 +9,17 @@ The jobctl-style post-hoc tools over recorded telemetry:
 * ``analyze``        EXPLAIN ANALYZE over a recorded stream: per-stage
                      measured actuals vs the static cost model
                      (obs/analyze.py)
+* ``latency``        per-tenant tail-latency percentiles + dominant-
+                     phase attribution from recorded
+                     ``latency_waterfall`` events (obs/latency.py);
+                     with ``--job`` also renders that job's phase
+                     waterfall bar
 * ``replay``         re-execute a task-failure forensics bundle
                      in-process, reproducing the remote exception
 * ``history``        list a job-history directory with cross-run deltas
 
-``trace`` / ``critical-path`` / ``metrics`` / ``analyze`` accept
+``trace`` / ``critical-path`` / ``metrics`` / ``analyze`` /
+``latency`` accept
 ``--job <id>``: a multi-job service JSONL (every record job-tagged by
 the daemon) is filtered to that one job's records first — no manual
 grep.
@@ -34,7 +40,7 @@ import sys
 # the post-hoc tool surface (docs/observability.md is drift-checked
 # against this by ``python -m dryad_tpu.analysis --selfcheck``)
 OBS_COMMANDS = ("trace", "critical-path", "metrics", "analyze",
-                "replay", "history")
+                "latency", "replay", "history")
 
 
 def _fail(msg: str) -> int:
@@ -165,6 +171,14 @@ def main(argv=None) -> int:
     a.add_argument("--json", action="store_true",
                    help="machine-readable report payload")
 
+    la = sub.add_parser("latency",
+                        help="tail-latency percentiles + phase "
+                             "attribution from latency_waterfall "
+                             "events (obs/latency.py)")
+    _events_args(la)
+    la.add_argument("--json", action="store_true",
+                    help="machine-readable snapshot payload")
+
     r = sub.add_parser("replay",
                        help="re-execute a forensics bundle in-process "
                             "(obs/flight.py), reproducing the failure")
@@ -224,6 +238,28 @@ def main(argv=None) -> int:
     if args.cmd == "metrics":
         from dryad_tpu.obs.metrics import metrics_from_events
         sys.stdout.write(metrics_from_events(events).render())
+        return 0
+    if args.cmd == "latency":
+        from dryad_tpu.obs.latency import (latency_from_events,
+                                           render_text,
+                                           render_waterfall)
+        wfs = [e for e in events
+               if e.get("event") == "latency_waterfall"]
+        if not wfs:
+            return _fail(f"no latency_waterfall records in "
+                         f"{args.events!r}"
+                         + (f" for job={args.job!r}" if args.job
+                            else ""))
+        tr = latency_from_events(events)
+        if args.json:
+            json.dump(tr.snapshot(), sys.stdout)
+            print()
+        else:
+            if args.job:
+                for wf in wfs:
+                    print(render_waterfall(wf))
+                print()
+            print(render_text(tr))
         return 0
     return 2
 

@@ -102,6 +102,16 @@ FAMILIES = {
     "scan_shared": ("dryad_scan_shares_total",
                     "cold scans avoided by the shared scan registry "
                     "(concurrent/queued jobs over one table)"),
+    # tail-latency observability (obs/latency.py): submit->result wall
+    # per tenant (and per tenant+phase when the phase label is set),
+    # and the measured admission-queue wait (enqueue stamp -> first
+    # dispatch stamp) — the autoscaling signal
+    "request_seconds": ("dryad_request_seconds",
+                        "service request submit->result wall "
+                        "(per tenant; phase label = one waterfall "
+                        "segment's share)"),
+    "queue_wait": ("dryad_queue_wait_seconds",
+                   "admission queue wait, enqueue to first dispatch"),
 }
 
 
@@ -404,6 +414,24 @@ def metrics_from_events(events, registry: Optional[Registry] = None,
             family_counter(r, "inc_refreshes").inc()
         elif k == "inc_fallback_rescan":
             family_counter(r, "inc_fallbacks").inc()
+        elif k == "latency_waterfall":
+            # derived mirror of the daemon's live LatencyTracker feed
+            # (+ the queue-wait histogram admission measures live; here
+            # it re-derives from the waterfall's queue segment)
+            tenant = str(e.get("tenant") or "?")
+            if e.get("wall_us") is not None:
+                family_histogram(r, "request_seconds", tenant=tenant
+                                 ).observe(int(e["wall_us"]) / 1e6)
+            agg: Dict[str, int] = {}
+            for p in e.get("phases") or []:
+                name = str(p.get("phase", "?"))
+                agg[name] = agg.get(name, 0) + int(p.get("us") or 0)
+            for name, us in agg.items():
+                family_histogram(r, "request_seconds", tenant=tenant,
+                                 phase=name).observe(us / 1e6)
+            if "queue" in agg:
+                family_histogram(r, "queue_wait", tenant=tenant
+                                 ).observe(agg["queue"] / 1e6)
         elif k == "job_done":
             C("jobs", e).inc()
         elif k == "job_failed":

@@ -23,6 +23,7 @@ while submissions arrive from API/HTTP threads.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
 from dryad_tpu.service.tenancy import (FailureBudgetError, QueueFullError,
@@ -110,6 +111,14 @@ class AdmissionQueue:
                 if active:
                     st.used_slot_s = max(st.used_slot_s,
                                          min(active) * q.share)
+            # measured queue wait (obs/latency.py): stamp the enqueue
+            # instant; _pick stamps first dispatch and the pair feeds
+            # the dryad_queue_wait_seconds histogram — the autoscaling
+            # signal — without inferring from wall-clock event ts
+            try:
+                job.enqueued_ns = time.monotonic_ns()
+            except AttributeError:
+                pass              # slotted test stubs: no stamp, no wait
             st.jobs.append(job)
             st.jobs.sort(key=lambda j: (-j.priority, j.seq))
             self._ready.notify_all()
@@ -172,6 +181,25 @@ class AdmissionQueue:
             # fleet's dispatch guard drops the unit instead of running
             # a job its waiters were already told is cancelled
             job.state = "running"
+            # first dispatch: settle the measured queue wait (enqueue
+            # stamp from submit()) into the histogram and close the
+            # waterfall's queue segment.  The metrics registry and the
+            # PhaseClock are leaf locks — safe under the queue lock.
+            now = time.monotonic_ns()
+            try:
+                job.dispatched_ns = now
+            except AttributeError:
+                pass
+            enq = getattr(job, "enqueued_ns", None)
+            if enq is not None:
+                from dryad_tpu.obs.metrics import (REGISTRY,
+                                                   family_histogram)
+                family_histogram(REGISTRY, "queue_wait",
+                                 tenant=best.name).observe(
+                                     (now - enq) / 1e9)
+            ph = getattr(job, "phases", None)
+            if ph is not None:
+                ph.mark_once("queue")
         best.running_tasks += 1
         if not job.pending:
             # fully dispatched; completion is the job's own accounting.

@@ -104,6 +104,13 @@ class JobService:
         # the transition past burn rate 1.0
         from dryad_tpu.obs.slo import SloTracker
         self.slo = SloTracker(config.slo_objective)
+        # tail-latency tracking (obs/latency.py): every terminal job's
+        # settled phase waterfall folds into per-tenant/per-phase
+        # percentile sketches + the slowest-request exemplar window;
+        # served at GET /latency + the dashboard tenant table, and the
+        # live dryad_request_seconds histograms
+        from dryad_tpu.obs.latency import LatencyTracker
+        self.latency = LatencyTracker(registry=REGISTRY)
         self._slo_breaching: set = set()
         # record + transition-check must be atomic per tenant, or two
         # fleet threads retiring the same tenant's jobs concurrently
@@ -248,6 +255,8 @@ class JobService:
         started."""
         from dryad_tpu.analysis.diagnostics import (DiagnosticError,
                                                     LintError)
+        from dryad_tpu.obs.latency import PhaseClock
+        clock = PhaseClock()             # submit-entry instant
         service_app = get_app(app)       # DTA910 before any state
         self._check_names(app, tenant)   # ... so is a bad tenant name
         if self._stopping:               # DTA913 before any state too
@@ -255,6 +264,7 @@ class JobService:
         # advisory quota precheck BEFORE paying for payload/plan
         # building (submit()'s atomic check stays authoritative)
         self.admission.precheck(tenant)
+        clock.mark("precheck")
         params = dict(params or {})
         try:
             if self.cluster is not None:
@@ -275,14 +285,16 @@ class JobService:
             # planner bug) propagates untyped: blaming the client's
             # params for an operator-side failure would hide it
             raise MalformedJobError(app, e)
+        clock.mark("bind")               # plan/payload build + lint
         if self.cluster is not None:
             job = self._new_job(app, tenant, priority,
                                 len(payload["sources"]),
                                 params=params, payload=payload,
-                                combine=service_app.combine)
+                                combine=service_app.combine,
+                                clock=clock)
         else:
             job = self._new_job(app, tenant, priority, 1, params=params,
-                                run_local=run_local)
+                                run_local=run_local, clock=clock)
         return self._admit(job)
 
     def submit_tasks(self, plan_json: str, per_task_sources: List[dict],
@@ -342,10 +354,13 @@ class JobService:
         is surfaced as a DTA501 ``reuse_verdict`` event and the
         ``plan_reuse`` counter."""
         from dryad_tpu import sql as _sql
+        from dryad_tpu.obs.latency import PhaseClock
+        clock = PhaseClock()             # submit-entry instant
         self._check_names("sql", tenant)
         if self._stopping:
             raise ServiceStoppedError()
         self.admission.precheck(tenant)
+        clock.mark("precheck")
         norm = _sql.normalize_query(query)
         # ONE compile (parse -> bind, DTA3xx typed rejections included)
         # per submission: the standing-query gate, the semantic
@@ -366,6 +381,7 @@ class JobService:
         fp = self.catalog.fingerprint()
         from dryad_tpu.analysis.canon import semantic_fingerprint
         semfp = semantic_fingerprint(self.catalog, bound)
+        clock.mark("bind")               # parse + bind + fingerprints
         try:
             if self.cluster is not None:
                 payload, limit, cached = \
@@ -377,15 +393,20 @@ class JobService:
             # querying a schema-only (EXPLAIN-only) table is a client
             # mistake — the documented DTA910 / HTTP 400, never a 500
             raise MalformedJobError("sql", e)
+        # a DTA501 hit spent the builder on cache probe + plan rebuild;
+        # a miss spent it on lower/plan/serialize — attribute the whole
+        # builder wall to whichever actually dominated it
+        clock.mark("cache_lookup" if cached else "bind")
         if self.cluster is not None:
             job = self._new_job("sql", tenant, priority, 1,
                                 params={"sql": norm},
                                 payload=payload,
-                                combine=_sql_combine(limit))
+                                combine=_sql_combine(limit),
+                                clock=clock)
         else:
             job = self._new_job("sql", tenant, priority, 1,
                                 params={"sql": norm},
-                                run_local=run_local)
+                                run_local=run_local, clock=clock)
         job.event({"event": "sql_query", "query": norm, "catalog": fp,
                    "semantic": semfp, "cached_plan": cached})
         self.log({"event": "sql_query", "job": job.id, "tenant": tenant,
@@ -742,6 +763,11 @@ class JobService:
         events unless a breach actually transitions."""
         if job.state == "cancelled":
             return
+        # fold the settled phase waterfall (job.finish() built it before
+        # closing the log) into the live tail-latency tracker — SLO-less
+        # tenants still get percentiles + p99 attribution
+        if job.waterfall is not None:
+            self.latency.record(job.waterfall)
         wall = ((job.finished_ts - (job.started_ts or job.submitted_ts))
                 if job.finished_ts else None)
         with self._slo_lock:
@@ -770,6 +796,11 @@ class JobService:
         """{tenant: attainment/burn row} for every SLO-declaring tenant
         that has recorded terminal jobs (``GET /slo``)."""
         return self.slo.snapshot()
+
+    def latency_snapshot(self) -> Dict[str, dict]:
+        """{tenant: p50/p95/p99 + dominant-phase breakdown + slowest-
+        request exemplar} from the live tracker (``GET /latency``)."""
+        return self.latency.snapshot()
 
     # -- dashboard / metrics -----------------------------------------------
 
@@ -804,8 +835,23 @@ class JobService:
                 f"</td></tr>")
         shares = self.admission.shares()
         slo = self.slo_snapshot()
+        lat = self.latency_snapshot()
         srows = []
         for t, v in sorted(shares.items()):
+            lt = lat.get(t)
+            if lt is None:
+                lcol = "<td>—</td><td>—</td><td>—</td>"
+            else:
+                ex = lt.get("exemplar") or {}
+                dom = lt.get("dominant") or "—"
+                if ex.get("job"):
+                    dom = (f'<a href="/events/{_html.escape(str(ex["job"]))}"'
+                           f' title="slowest: {_html.escape(str(ex["job"]))}'
+                           f' ({ex.get("wall_s")}s)">'
+                           f"{_html.escape(dom)}</a>")
+                lcol = (f"<td>{lt['p50_s']:.3f}</td>"
+                        f"<td>{lt['p99_s']:.3f}</td>"
+                        f"<td>{dom}</td>")
             s = slo.get(t)
             if s is None:
                 scol = "<td>—</td><td>—</td><td>—</td>"
@@ -822,7 +868,7 @@ class JobService:
                     + "</td>")
             srows.append(
                 f"<tr><td>{_html.escape(t)}</td><td>{v[0]:.3f}</td>"
-                f"<td>{v[1]}</td><td>{v[2]}</td>{scol}</tr>")
+                f"<td>{v[1]}</td><td>{v[2]}</td>{scol}{lcol}</tr>")
         qrows = []
         for r in self.standing_rows():
             qrows.append(
@@ -847,7 +893,9 @@ class JobService:
             + standing_tbl +
             "<h2>tenants</h2><table><tr><th>tenant</th>"
             "<th>slot&nbsp;s</th><th>running</th><th>failures</th>"
-            "<th>SLO</th><th>attainment</th><th>burn</th></tr>"
+            "<th>SLO</th><th>attainment</th><th>burn</th>"
+            "<th>p50&nbsp;s</th><th>p99&nbsp;s</th><th>p99&nbsp;phase</th>"
+            "</tr>"
             + "".join(srows) + "</table><h2>history</h2>")
         return index_html(history_index(self.history_dir),
                           title="dryad job service", extra_html=extra)
@@ -964,6 +1012,7 @@ class _LocalFleet:
             job.mark_started()
             family_gauge(REGISTRY, "queue_depth",
                          job=job.id).set(len(job.pending))
+            job.mark_phase("dispatch")   # pick -> this thread's hands
             t0 = _now()
             ok, err = True, None
             try:
@@ -971,6 +1020,7 @@ class _LocalFleet:
             except Exception:
                 ok, err = False, traceback.format_exc()
             wall = _now() - t0
+            job.mark_phase("run")
             svc.admission.on_done(job, idx, wall, ok=ok)
             svc.admission.retire(job)
             family_histogram(REGISTRY, "task_seconds",
@@ -1080,6 +1130,8 @@ class _ClusterFleet:
             return False
         self._inflight[pid] = (job, idx, _now())
         self._idle.discard(pid)
+        job.mark_phase("dispatch")   # first send only (mark_once):
+        # later tasks' sends land inside the run segment, not carved out
         family_gauge(REGISTRY, "queue_depth",
                      job=job.id).set(len(job.pending))
         return True
@@ -1113,6 +1165,7 @@ class _ClusterFleet:
                     f"python -m dryad_tpu.obs replay {bpath}")
         self.service.admission.on_done(job, idx, wall, ok=False)
         job.pending.clear()
+        job.mark_phase("run")
         job.finish(False, error=f"task {idx} failed on worker {pid}:\n"
                                 + err)
         self.service.admission.retire(job)
@@ -1170,7 +1223,8 @@ class _ClusterFleet:
         if done:
             trace.finish(getattr(job, "_span", None),
                          done=job.n_tasks)
-            job.finish(True)
+            job.mark_phase("run")    # last reply landed; finish() owns
+            job.finish(True)         # the fetch (combine) segment
             self.service.admission.retire(job)
             family_counter(REGISTRY, "jobs", job=job.id).inc()
             family_gauge(REGISTRY, "queue_depth", job=job.id).set(0)

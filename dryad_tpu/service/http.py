@@ -15,6 +15,10 @@ One small surface over the stdlib HTTP plumbing the repo already uses
 ``GET /slo``                per-tenant SLO attainment + error-budget
                             burn rate (obs/slo.py; tenants declare
                             objectives on their TenantQuota)
+``GET /latency``            per-tenant tail latency: p50/p95/p99
+                            submit->result, dominant-phase breakdown,
+                            and the slowest-request exemplar (job +
+                            trace id) per window (obs/latency.py)
 ``GET /events/<job>``       LONG-POLL the job's live event stream:
                             ``?after=N`` resumes at cursor N,
                             ``?timeout_s=S`` bounds the wait; returns
@@ -168,6 +172,8 @@ def serve(service, port: int = 0, host: str = "127.0.0.1"):
                     self._json(200, service.admission.shares())
                 elif path == "/slo":
                     self._json(200, service.slo_snapshot())
+                elif path == "/latency":
+                    self._json(200, service.latency_snapshot())
                 elif path == "/standing":
                     self._json(200, service.standing_rows())
                 elif path.startswith("/events/"):
@@ -331,6 +337,11 @@ class Client:
     def slo(self) -> Dict[str, Any]:
         """Per-tenant SLO attainment/burn snapshot (``GET /slo``)."""
         return self._req("/slo")
+
+    def latency(self) -> Dict[str, Any]:
+        """Per-tenant tail-latency snapshot: percentiles + dominant
+        phase + slowest-request exemplar (``GET /latency``)."""
+        return self._req("/latency")
 
     def standing(self) -> List[Dict[str, Any]]:
         """Status rows of every registered standing query

@@ -63,7 +63,8 @@ class ServiceJob:
                  params: Optional[Dict[str, Any]] = None,
                  combine: Optional[Callable[[List], Any]] = None,
                  payload: Optional[Dict[str, Any]] = None,
-                 run_local: Optional[Callable] = None):
+                 run_local: Optional[Callable] = None,
+                 clock=None):
         self.id = job_id
         self.tenant = tenant
         self.app = app
@@ -87,6 +88,15 @@ class ServiceJob:
         self.payload = payload
         self.combine = combine
         self.run_local = run_local
+        # per-request phase waterfall (obs/latency.py): the daemon
+        # hands in the clock it started at submit ENTRY so the
+        # precheck/bind/cache segments measured before this object
+        # existed are part of the partition; standalone construction
+        # (tests, submit_tasks) starts one here.  ``waterfall`` is the
+        # settled record the daemon's LatencyTracker folds on terminal.
+        from dryad_tpu.obs.latency import PhaseClock
+        self.phases = clock if clock is not None else PhaseClock()
+        self.waterfall: Optional[Dict[str, Any]] = None
         # per-job driver state: own JSONL + forensics dir + history
         # archive on close (EventLog(app=...) names the dashboard row)
         self.dir = job_dir
@@ -162,6 +172,43 @@ class ServiceJob:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def mark_phase(self, phase: str) -> None:
+        """End request phase ``phase`` now (``mark_once`` semantics —
+        the fleets' repeated per-task dispatches must not carve the run
+        wall).  At level >= 2 each mark also lands in the log as a
+        ``latency_phase`` record for live followers; the construction
+        is gated so a level-0/1 job builds nothing extra."""
+        self.phases.mark_once(phase)
+        if self.log.admits("latency_phase"):
+            self.event({"event": "latency_phase", "phase": phase})
+
+    def _settle_waterfall(self, ok: bool) -> None:
+        """Settle the phase clock into the job's ``latency_waterfall``
+        (called under ``_lock`` on the terminal transition, BEFORE the
+        log closes so the record reaches the archive).  The final
+        "fetch" mark closes the partition at the submit→result instant;
+        the compile share of the run segment comes from the
+        ``stage_done`` records ``exec/recovery.py`` settled into this
+        log, the trace exemplar from the Run's job span / ``job_done``
+        trace stamp."""
+        if self.waterfall is not None:
+            return
+        self.phases.mark("fetch")
+        compile_s = 0.0
+        trace_id = None
+        for e in self.log.events:
+            k = e.get("event")
+            if k == "stage_done":
+                compile_s += float(e.get("compile_s") or 0.0)
+            if trace_id is None and k in ("span", "job_done") \
+                    and e.get("trace"):
+                trace_id = e.get("trace")
+        self.waterfall = self.phases.waterfall(
+            job=self.id, tenant=self.tenant, app=self.app, ok=ok,
+            compile_s=compile_s, trace=trace_id)
+        if self.log.admits("latency_waterfall"):
+            self.event(self.waterfall)
+
     def mark_started(self) -> None:
         with self._lock:
             if self.started_ts is None:
@@ -217,6 +264,7 @@ class ServiceJob:
                 self.event({"event": "job_failed", "tenant": self.tenant,
                             "error": (error or self.error
                                       or "unknown")[:2000]})
+            self._settle_waterfall(self.state == "done")
             self._release_inputs()
         self.log.close()
         self._done.set()

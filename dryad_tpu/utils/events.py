@@ -70,6 +70,11 @@ _LEVELS = {
     # bundles identify SQL jobs by it); sql_lowered carries the lowered
     # shape (outputs/joins/grouping) and is chatter-grade
     "sql_query": 1, "sql_lowered": 2,
+    # tail-latency observability (obs/latency.py + service wiring): the
+    # settled per-request phase waterfall is job-lifecycle grade — the
+    # record latency_from_events/metrics_from_events re-derive from;
+    # the per-mark internals are chatter
+    "latency_waterfall": 1, "latency_phase": 2,
     # semantic plan reuse (analysis/canon + subsume via the daemon): the
     # DTA501 verdict on a fingerprint-keyed plan-cache hit and a table
     # load served from another job's cold scan are amortization

@@ -616,6 +616,61 @@ def _adaptive_html(events) -> str:
     return out
 
 
+_PHASE_COLORS = {"precheck": "#8da0cb", "bind": "#66c2a5",
+                 "cache_lookup": "#a6d854", "queue": "#fc8d62",
+                 "dispatch": "#ffd92f", "compile": "#e78ac3",
+                 "run": "#4c78a8", "fetch": "#b3b3b3"}
+
+
+def _latency_html(events) -> str:
+    """"Latency waterfall" section: one stacked bar per recorded
+    ``latency_waterfall`` (obs/latency.py) — the request's
+    submit→result wall partitioned into phases — plus the per-tenant
+    percentile/attribution table re-derived from the same records."""
+    wfs = [e for e in events if e.get("event") == "latency_waterfall"]
+    if not wfs:
+        return ""
+    from dryad_tpu.obs.latency import latency_from_events
+    bars = []
+    for wf in wfs[:20]:
+        wall_us = max(1, int(wf.get("wall_us") or 0))
+        segs = "".join(
+            f'<div title="{html.escape(str(p.get("phase", "?")))}: '
+            f'{int(p.get("us") or 0) / 1e6:.4f}s" style="background: '
+            f'{_PHASE_COLORS.get(p.get("phase"), "#999")}; '
+            f'width: {100.0 * int(p.get("us") or 0) / wall_us:.2f}%; '
+            f'height: 14px"></div>'
+            for p in wf.get("phases") or [])
+        bars.append(
+            f'<div style="margin: 4px 0">'
+            f'<span style="color: var(--ink2); font-size: 12px">'
+            f'{html.escape(str(wf.get("job", "?")))} '
+            f'({html.escape(str(wf.get("tenant", "?")))}) '
+            f'{wf.get("wall_s")}s</span>'
+            f'<div style="display: flex; width: 480px; border: 1px '
+            f'solid var(--grid); border-radius: 4px; overflow: hidden">'
+            f'{segs}</div></div>')
+    legend = " ".join(
+        f'<span style="white-space: nowrap"><span style="display: '
+        f'inline-block; width: 10px; height: 10px; background: {c}">'
+        f'</span> {p}</span>' for p, c in _PHASE_COLORS.items())
+    rows = []
+    for tenant, r in latency_from_events(events).snapshot().items():
+        ex = r.get("exemplar") or {}
+        rows.append(
+            f"<tr><td>{html.escape(tenant)}</td><td>{r['count']}</td>"
+            f"<td>{r['p50_s']:.3f}</td><td>{r['p95_s']:.3f}</td>"
+            f"<td>{r['p99_s']:.3f}</td>"
+            f"<td>{html.escape(str(r['dominant'] or '—'))}</td>"
+            f"<td>{html.escape(str(ex.get('job') or '—'))}</td></tr>")
+    return ("<h2>Latency waterfall</h2>"
+            f'<div style="color: var(--ink2); font-size: 12px">'
+            f"{legend}</div>" + "".join(bars)
+            + "<table><tr><th>tenant</th><th>n</th><th>p50&nbsp;s</th>"
+              "<th>p95&nbsp;s</th><th>p99&nbsp;s</th><th>dominant</th>"
+              "<th>slowest</th></tr>" + "".join(rows) + "</table>")
+
+
 def job_report_html(events, plan_json: Optional[str] = None,
                     path: Optional[str] = None, title: str = "dryad job",
                     live_refresh_s: Optional[float] = None) -> str:
@@ -696,6 +751,7 @@ def job_report_html(events, plan_json: Optional[str] = None,
 {_analyze_html(events)}
 {_adaptive_html(events)}
 {_critical_path_html(events)}
+{_latency_html(events)}
 <h2>Stage DAG</h2>{_svg_dag(stages, deps, order)}
 <h2>Gantt (time from job start)</h2>{_svg_gantt(stages, order)}
 <h2>Per-stage table</h2>{_table(stages, order)}

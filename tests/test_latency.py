@@ -1,0 +1,402 @@
+"""Tail-latency observability tests (obs/latency.py + service wiring):
+the exact-partition invariant on per-request phase waterfalls, the
+streaming quantile sketch's error bound against a sorted oracle,
+per-tenant isolation under concurrency, event re-derivation
+bit-equality, the /latency + dashboard + CLI surfaces, and the level-0
+no-op contract."""
+
+import json
+import math
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from dryad_tpu.obs import trace
+from dryad_tpu.obs.latency import (PHASES, LatencyTracker, PhaseClock,
+                                   QuantileSketch, latency_from_events,
+                                   render_text, render_waterfall)
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _detach_tracer():
+    yield
+    trace.install(None)
+
+
+# -- the exact-partition invariant -------------------------------------------
+
+
+def test_phase_clock_exact_partition():
+    """Segments are integer-microsecond offsets from t0, so consecutive
+    differences telescope: sum(seg_us) == wall_us EXACTLY — not float
+    luck, arithmetic."""
+    ph = PhaseClock()
+    for p in ("precheck", "bind", "queue"):
+        time.sleep(0.001)
+        ph.mark(p)
+    ph.mark_once("dispatch")
+    ph.mark_once("dispatch")            # repeat is a no-op
+    time.sleep(0.003)
+    ph.mark("run")
+    ph.mark("fetch")
+    segs, wall = ph.segments()
+    assert [p for p, _ in segs] == \
+        ["precheck", "bind", "queue", "dispatch", "run", "fetch"]
+    assert sum(us for _, us in segs) == wall
+    assert all(us >= 0 for _, us in segs)
+    assert wall > 0
+
+
+def test_waterfall_compile_carve_preserves_partition():
+    """The compile carve moves microseconds from the run segment into a
+    compile segment — the partition survives by construction, including
+    the degenerate carve-everything case."""
+    ph = PhaseClock()
+    ph.mark("bind")
+    time.sleep(0.005)
+    ph.mark("run")
+    ph.mark("fetch")
+    _, wall = ph.segments()
+    wf = ph.waterfall(job="j-1", tenant="acme", ok=True,
+                      compile_s=0.002, trace="t-1")
+    assert wf["event"] == "latency_waterfall"
+    assert wf["wall_us"] == wall
+    assert sum(p["us"] for p in wf["phases"]) == wf["wall_us"]
+    names = [p["phase"] for p in wf["phases"]]
+    assert names == ["bind", "compile", "run", "fetch"]
+    carved = dict((p["phase"], p["us"]) for p in wf["phases"])
+    assert carved["compile"] == 2000
+    assert wf["job"] == "j-1" and wf["tenant"] == "acme"
+    assert wf["trace"] == "t-1"
+    # compile_s larger than the run segment: carve is capped, the run
+    # segment drops to zero, the sum still holds
+    wf2 = ph.waterfall(ok=False, compile_s=999.0)
+    assert sum(p["us"] for p in wf2["phases"]) == wf2["wall_us"] == wall
+    by = dict((p["phase"], p["us"]) for p in wf2["phases"])
+    assert by["run"] == 0 and wf2["ok"] is False
+
+
+# -- streaming percentiles vs the sorted oracle ------------------------------
+
+
+def test_quantile_sketch_error_bound_vs_sorted_oracle():
+    """Within the covered range an estimate lands in the TRUE order
+    statistic's geometric bucket (counts are exact), so it is within
+    the bucket ratio of the truth: 0.8*true <= est <= 1.25*true."""
+    rng = random.Random(7)
+    vals = [rng.uniform(0.002, 30.0) for _ in range(500)]
+    sk = QuantileSketch()
+    for v in vals:
+        sk.observe(v)
+    s = sorted(vals)
+    n = len(s)
+    assert sk.count == n
+    for q in (0.10, 0.50, 0.90, 0.95, 0.99):
+        est = sk.quantile(q)
+        true = s[max(0, math.ceil(q * n) - 1)]
+        assert 0.8 * true - 1e-9 <= est <= 1.25 * true + 1e-9, (q, est,
+                                                                true)
+        assert sk.vmin <= est <= sk.vmax
+    assert sk.mean == pytest.approx(sum(vals) / n)
+
+
+def test_quantile_sketch_determinism_and_edges():
+    a, b = QuantileSketch(), QuantileSketch()
+    for v in (0.5, 1.5, 0.01, 80.0, 0.5):
+        a.observe(v)
+        b.observe(v)
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert a.quantile(q) == b.quantile(q)      # bit-identical
+    assert QuantileSketch().quantile(0.5) == 0.0   # empty
+    assert QuantileSketch().mean == 0.0
+    one = QuantileSketch()
+    one.observe(5.0)
+    # clamping to the observed min/max makes a single sample exact
+    assert one.quantile(0.5) == 5.0
+    assert one.quantile(0.99) == 5.0
+    big = QuantileSketch()
+    big.observe(500.0)                              # beyond the bounds
+    assert big.quantile(0.9) == 500.0
+
+
+# -- service wiring -----------------------------------------------------------
+
+
+def _make_service(tmp_dir, slots=2):
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+    return JobService(ServiceConfig(service_dir=tmp_dir, slots=slots))
+
+
+def _serve(svc):
+    from dryad_tpu.service.http import Client, serve
+    srv, port = serve(svc)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, Client(f"http://127.0.0.1:{port}")
+
+
+def test_service_job_records_waterfall_end_to_end():
+    d = tempfile.mkdtemp(prefix="lat-svc-")
+    svc = _make_service(d, slots=1)
+    try:
+        def work(env):
+            time.sleep(0.01)
+            return {"ok": True}
+
+        jid = svc.submit_callable(work, tenant="acme")
+        assert svc.wait(jid, timeout=60)["state"] == "done"
+        job = svc.job(jid)
+        wf = job.waterfall
+        assert wf is not None and wf["ok"] is True
+        # THE invariant: the segments partition the wall exactly
+        assert sum(p["us"] for p in wf["phases"]) == wf["wall_us"]
+        names = [p["phase"] for p in wf["phases"]]
+        assert {"queue", "run", "fetch"} <= set(names)
+        assert all(n in PHASES for n in names)
+        # the settled record is IN the job's event log (job-tagged)
+        logged = [e for e in job.log.events
+                  if e.get("event") == "latency_waterfall"]
+        assert len(logged) == 1
+        assert logged[0]["job"] == jid
+        assert logged[0]["wall_us"] == wf["wall_us"]
+        # ... and the daemon's live tracker folded it
+        snap = svc.latency_snapshot()
+        row = snap["acme"]
+        assert row["count"] == 1 and row["ok"] == 1
+        assert row["exemplar"]["job"] == jid
+        assert row["p50_s"] > 0 and row["max_s"] >= 0.01
+        # live metric families engaged
+        mt = svc.metrics_text()
+        assert "dryad_request_seconds" in mt
+        assert 'tenant="acme"' in mt
+        assert "dryad_queue_wait_seconds" in mt
+        # the viewer renders the waterfall section from the archive
+        from dryad_tpu.utils.viewer import job_report_html
+        html = job_report_html(job.log.events)
+        assert "Latency waterfall" in html
+        # render helpers stay total
+        assert "acme" in render_text(svc.latency)
+        assert "total" in render_waterfall(wf)
+    finally:
+        svc.close()
+
+
+def test_per_tenant_isolation_two_concurrent_jobs():
+    """Two tenants' jobs run CONCURRENTLY on the shared fleet: each
+    tenant's percentile row counts exactly its own request, each
+    exemplar points at its own tenant's job, and each job's log holds
+    ONLY its own waterfall (the PR 8 isolation discipline)."""
+    d = tempfile.mkdtemp(prefix="lat-iso-")
+    svc = _make_service(d, slots=2)
+    try:
+        both = threading.Barrier(2, timeout=30)
+
+        def work(env):
+            both.wait()                 # prove true concurrency
+            time.sleep(0.01)
+            return {"ok": True}
+
+        ja = svc.submit_callable(work, tenant="ta")
+        jb = svc.submit_callable(work, tenant="tb")
+        assert svc.wait(ja, timeout=60)["state"] == "done"
+        assert svc.wait(jb, timeout=60)["state"] == "done"
+        snap = svc.latency_snapshot()
+        assert snap["ta"]["count"] == 1 and snap["tb"]["count"] == 1
+        assert snap["ta"]["exemplar"]["job"] == ja
+        assert snap["tb"]["exemplar"]["job"] == jb
+        for jid in (ja, jb):
+            wfs = [e for e in svc.job(jid).log.events
+                   if e.get("event") == "latency_waterfall"]
+            assert [w["job"] for w in wfs] == [jid]
+            assert sum(p["us"] for p in wfs[0]["phases"]) \
+                == wfs[0]["wall_us"]
+    finally:
+        svc.close()
+
+
+def test_latency_from_events_bit_equal_rederivation():
+    """The two-derivations rule: folding the archived waterfall records
+    in order rebuilds the daemon's live snapshot BIT-IDENTICALLY."""
+    d = tempfile.mkdtemp(prefix="lat-rederive-")
+    svc = _make_service(d, slots=1)
+    try:
+        def work(env):
+            time.sleep(0.005)
+            return {"ok": True}
+
+        jids = [svc.submit_callable(work, tenant="acme")
+                for _ in range(3)]
+        for jid in jids:
+            assert svc.wait(jid, timeout=60)["state"] == "done"
+        events = [e for jid in jids for e in svc.job(jid).log.events]
+        rederived = latency_from_events(events)
+        assert rederived.snapshot() == svc.latency.snapshot()
+        assert rederived.row("acme") == svc.latency.row("acme")
+        assert rederived.row("nope") is None
+    finally:
+        svc.close()
+
+
+def test_latency_http_endpoint_and_dashboard():
+    d = tempfile.mkdtemp(prefix="lat-http-")
+    svc = _make_service(d, slots=1)
+    srv, cl = _serve(svc)
+    try:
+        jid = svc.submit_callable(lambda env: {"ok": True},
+                                  tenant="acme")
+        assert svc.wait(jid, timeout=60)["state"] == "done"
+        snap = cl.latency()
+        assert snap["acme"]["count"] == 1
+        assert snap["acme"]["exemplar"]["job"] == jid
+        assert snap == svc.latency_snapshot()
+        html = svc.dashboard_html()
+        assert "p99&nbsp;phase" in html and "p50&nbsp;s" in html
+        assert snap["acme"]["dominant"] in html
+    finally:
+        svc.close()
+        srv.shutdown()
+
+
+def test_level0_builds_zero_events_but_tracker_still_records(monkeypatch):
+    """The level-0 no-op contract: at DRYAD_LOGGING_LEVEL=0 a completed
+    job's log holds ZERO events (no waterfall, no phase marks), yet the
+    settled payload still drives the live tracker — same split as the
+    SLO gauges."""
+    monkeypatch.setenv("DRYAD_LOGGING_LEVEL", "0")
+    d = tempfile.mkdtemp(prefix="lat-lvl0-")
+    svc = _make_service(d, slots=1)
+    try:
+        jid = svc.submit_callable(lambda env: {"ok": True},
+                                  tenant="quiet")
+        assert svc.wait(jid, timeout=60)["state"] == "done"
+        job = svc.job(jid)
+        assert job.log.events == []          # zero events built
+        assert job.waterfall is not None     # payload still settled
+        assert sum(p["us"] for p in job.waterfall["phases"]) \
+            == job.waterfall["wall_us"]
+        assert svc.latency_snapshot()["quiet"]["count"] == 1
+    finally:
+        svc.close()
+
+
+# -- event levels + derived metrics ------------------------------------------
+
+
+def test_latency_event_levels_registered():
+    from dryad_tpu.utils.events import _LEVELS
+    assert _LEVELS["latency_waterfall"] == 1
+    assert _LEVELS["latency_phase"] == 2
+
+
+def _wf(job, tenant, segs, ok=True, trace=None):
+    wf = {"event": "latency_waterfall", "ok": ok,
+          "wall_us": sum(us for _, us in segs),
+          "wall_s": round(sum(us for _, us in segs) / 1e6, 6),
+          "phases": [{"phase": p, "us": us} for p, us in segs],
+          "job": job, "tenant": tenant}
+    if trace:
+        wf["trace"] = trace
+    return wf
+
+
+def test_metrics_from_events_request_and_queue_wait_families():
+    from dryad_tpu.obs.metrics import FAMILIES, metrics_from_events
+    assert FAMILIES["request_seconds"][0] == "dryad_request_seconds"
+    assert FAMILIES["queue_wait"][0] == "dryad_queue_wait_seconds"
+    events = [_wf("j-1", "acme", [("bind", 1000), ("queue", 2000),
+                                  ("run", 50000), ("fetch", 100)]),
+              _wf("j-2", "acme", [("queue", 500), ("run", 9500)])]
+    text = metrics_from_events(events).render()
+    assert "dryad_request_seconds" in text
+    assert 'tenant="acme"' in text
+    assert 'phase="run"' in text and 'phase="queue"' in text
+    assert "dryad_queue_wait_seconds" in text
+
+
+def test_tracker_aggregation_and_dominant_phase():
+    tr = LatencyTracker(window=2)
+    tr.record(_wf("j-1", "a", [("queue", 1000), ("run", 9000)],
+                  trace="t-1"))
+    tr.record(_wf("j-2", "a", [("queue", 8000), ("run", 4000)]))
+    row = tr.row("a")
+    assert row["count"] == 2 and row["ok"] == 2
+    assert row["dominant"] == "run"              # 13ms run vs 9ms queue
+    assert row["exemplar"]["job"] == "j-2"       # slowest in window
+    phases = {p["phase"]: p for p in row["phases"]}
+    assert phases["run"]["total_s"] == pytest.approx(0.013)
+    assert sum(p["share"] for p in row["phases"]) == pytest.approx(
+        1.0, abs=0.01)
+    # garbage in, nothing out
+    tr.record({})
+    tr.record({"event": "job_done"})
+    assert tr.row("a")["count"] == 2
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_obs_cli_latency(tmp_path, capsys):
+    from dryad_tpu.obs.__main__ import OBS_COMMANDS, main
+    assert "latency" in OBS_COMMANDS
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        for wf in (_wf("j-1", "acme", [("queue", 2000),
+                                       ("run", 48000)], trace="t-1"),
+                   _wf("j-2", "beta", [("run", 5000)])):
+            f.write(json.dumps(wf) + "\n")
+        f.write(json.dumps({"event": "job_done", "job": "j-1"}) + "\n")
+    assert main(["latency", path]) == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "beta" in out and "dominant" in out
+    # --job renders that one job's waterfall bar
+    assert main(["latency", path, "--job", "j-1"]) == 0
+    out = capsys.readouterr().out
+    assert "j-1" in out and "beta" not in out
+    # --json round-trips the snapshot
+    assert main(["latency", path, "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["acme"]["count"] == 1
+    # exit-code contract: 2 on missing file, no waterfalls, no match
+    assert main(["latency", str(tmp_path / "nope.jsonl")]) == 2
+    empty = str(tmp_path / "nowf.jsonl")
+    with open(empty, "w") as f:
+        f.write(json.dumps({"event": "job_done"}) + "\n")
+    assert main(["latency", empty]) == 2
+    assert main(["latency", path, "--job", "ghost"]) == 2
+
+
+# -- bench smoke --------------------------------------------------------------
+
+
+def test_bench_smoke_latency(tmp_path):
+    """The --smoke-latency capture runs end to end: percentiles over
+    per-request waterfall walls under concurrent tenants, and the p99
+    exemplar's trace id resolves to a real recorded trace."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    out_path = str(tmp_path / "BENCH_latency.json")
+    os.environ["BENCH_TREND_PATH"] = str(tmp_path / "BENCH_trend.jsonl")
+    try:
+        out = bench.smoke_latency(out_path=out_path, n_lines=400,
+                                  k_tenants=2, jobs_per_tenant=1,
+                                  reps=1, quiet=True)
+    finally:
+        os.environ.pop("BENCH_TREND_PATH", None)
+    assert os.path.exists(out_path)
+    assert out["k_tenants"] == 2 and out["requests"] == 2
+    assert out["p99_s"] >= out["p50_s"] > 0
+    assert out["dominant_phase"] in PHASES
+    assert set(out["per_tenant"]) == {"tenant0", "tenant1"}
+    assert out["exemplar"]["job"]
+    assert out["exemplar_trace_resolves"] is True
+    trend = [json.loads(line)
+             for line in open(str(tmp_path / "BENCH_trend.jsonl"))]
+    assert trend and trend[-1]["app"] == "bench-smoke-latency"

@@ -71,6 +71,11 @@ def test_every_emitted_event_kind_is_registered():
     assert _LEVELS["analyze_report"] == 1
     assert _LEVELS["slo_breach"] == 1
     assert _LEVELS["regression_suspect"] == 1
+    # tail-latency observability (obs/latency.py): the settled
+    # per-request waterfall is the record the post-hoc derivations
+    # rebuild from (job-lifecycle grade); per-mark internals are chatter
+    assert _LEVELS["latency_waterfall"] == 1
+    assert _LEVELS["latency_phase"] == 2
     # continuous queries (dryad_tpu/inc): registrations, per-refresh
     # summaries (the record SSE followers of a standing id consume),
     # state commits, and full-rescan fallbacks are all job-lifecycle
