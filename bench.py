@@ -1612,6 +1612,124 @@ def smoke_latency(out_path="BENCH_latency.json", n_lines=None,
     return out
 
 
+def smoke_durable(out_path="BENCH_durable.json", n_lines=None,
+                  k_jobs=None, reps=None, quiet=False):
+    """Durability smoke (``python bench.py --smoke-durable``, also
+    rides ``--smoke``): K wordcount jobs submitted to a durable daemon
+    that is CRASHED mid-fleet (the test/bench kill hook — journal cut
+    first, exactly what SIGKILL leaves) and restarted; vs the SAME K
+    jobs run uninterrupted.  ``reps`` repetitions run INTERLEAVED
+    (uninterrupted, crashed, uninterrupted, ...) and both headline
+    walls are MEDIANS (the PR-4 protocol).  Reports the journal-replay
+    recovery wall, how many jobs came back resumed/readmitted, and the
+    end-to-end submit→complete overhead a crash+restart costs — with
+    oracle-identical results required (a recovered job's output must
+    equal its uninterrupted twin's).  Written to ``BENCH_durable.json``
+    + appended to ``BENCH_trend.jsonl`` (app ``bench-smoke-durable``)."""
+    import statistics
+    import tempfile
+
+    from dryad_tpu.service.daemon import JobService
+    from dryad_tpu.service.tenancy import ServiceConfig
+
+    n_lines = n_lines or int(os.environ.get("BENCH_DURABLE_LINES",
+                                            "2000"))
+    k_jobs = k_jobs or int(os.environ.get("BENCH_DURABLE_JOBS", "3"))
+    reps = max(1, reps or int(os.environ.get("BENCH_DURABLE_REPS",
+                                             "3")))
+    job_params = [{"n_lines": n_lines, "seed": i} for i in range(k_jobs)]
+
+    def run_fleet(svc, jids=None):
+        jids = jids or [svc.submit("wordcount", p,
+                                   tenant=f"tenant{i % 2}")
+                        for i, p in enumerate(job_params)]
+        rows = [svc.wait(j, timeout=600) for j in jids]
+        assert all(r["state"] == "done" for r in rows), rows
+        return jids, [r.get("result") for r in rows]
+
+    plain_walls, crash_walls, recovery_walls = [], [], []
+    plain_results = crashed_results = None
+    recovered = 0
+    rec = None
+    for _ in range(reps):
+        # -- uninterrupted twin
+        with tempfile.TemporaryDirectory(prefix="bench-dur-") as d:
+            svc = JobService(ServiceConfig(service_dir=d, slots=2))
+            try:
+                t0 = time.time()
+                _, plain_results = run_fleet(svc)
+                plain_walls.append(time.time() - t0)
+            finally:
+                svc.close()
+        # -- crashed + recovered
+        with tempfile.TemporaryDirectory(prefix="bench-dur-") as d:
+            cfg = lambda: ServiceConfig(service_dir=d,  # noqa: E731
+                                        slots=2, durable_spill=True)
+            t0 = time.time()
+            svc = JobService(cfg())
+            jids = [svc.submit("wordcount", p, tenant=f"tenant{i % 2}")
+                    for i, p in enumerate(job_params[:-1])]
+            svc.wait(jids[0], timeout=600)   # some work settles...
+            # ...one more lands just before the lights go out (so the
+            # recovered fleet is never empty, however fast the box)...
+            jids.append(svc.submit("wordcount", job_params[-1],
+                                   tenant=f"tenant{(len(jids)) % 2}"))
+            svc.crash()                      # ...then the daemon dies
+            svc2 = JobService(cfg())         # successor adopts
+            try:
+                rec = svc2.recovery
+                recovery_walls.append(rec["wall_s"])
+                recovered += rec["resumed"] + rec["readmitted"]
+                _, crashed_results = run_fleet(svc2, jids)
+                crash_walls.append(time.time() - t0)
+            finally:
+                svc2.close()
+    # jobs terminal before the crash serve an archived row (no result
+    # payload retained) — compare wherever both sides have one
+    results_match = all(
+        c == p for c, p in zip(crashed_results, plain_results)
+        if c is not None)
+    plain_s = statistics.median(plain_walls)
+    crash_s = statistics.median(crash_walls)
+    out = {
+        "metric": "durable smoke (K jobs through a crashed+recovered "
+                  "daemon vs uninterrupted)",
+        "k_jobs": k_jobs,
+        "lines_per_job": n_lines,
+        "reps": reps,
+        "wall_s_uninterrupted": round(plain_s, 4),
+        "wall_s_crashed": round(crash_s, 4),
+        "wall_s_uninterrupted_all": [round(w, 4) for w in plain_walls],
+        "wall_s_crashed_all": [round(w, 4) for w in crash_walls],
+        "crash_overhead_pct": (round(100.0 * (crash_s - plain_s)
+                                     / plain_s, 1)
+                               if plain_s > 0 else None),
+        "recovery_wall_s": round(statistics.median(recovery_walls), 4),
+        "jobs_recovered": recovered,
+        "last_recovery": {k: rec[k] for k in
+                          ("records", "resumed", "readmitted",
+                           "failed", "terminal_indexed")},
+        "results_match": results_match,
+    }
+    assert results_match, out
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    trend_path = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), "BENCH_trend.jsonl")
+    with open(trend_path, "a") as f:
+        f.write(json.dumps({
+            "ts": round(time.time(), 3), "app": "bench-smoke-durable",
+            "wall_s": round(crash_s, 4),
+            "uninterrupted_wall_s": round(plain_s, 4),
+            "crash_overhead_pct": out["crash_overhead_pct"],
+            "recovery_wall_s": out["recovery_wall_s"],
+            "jobs_recovered": recovered,
+            "k_jobs": k_jobs, "lines": n_lines, "reps": reps}) + "\n")
+    if not quiet:
+        print(json.dumps(out))
+    return out
+
+
 def main():
     import jax
 
@@ -2202,6 +2320,9 @@ if __name__ == "__main__":
     elif "--smoke-latency" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke-latency"]
         smoke_latency(out_path=args[0] if args else "BENCH_latency.json")
+    elif "--smoke-durable" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--smoke-durable"]
+        smoke_durable(out_path=args[0] if args else "BENCH_durable.json")
     elif "--smoke" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--smoke"]
         obs_out = args[0] if args else "BENCH_obs.json"
@@ -2228,6 +2349,8 @@ if __name__ == "__main__":
         smoke_reuse(out_path=os.path.join(base, "BENCH_reuse.json"),
                     quiet=True)
         smoke_latency(out_path=os.path.join(base, "BENCH_latency.json"),
+                      quiet=True)
+        smoke_durable(out_path=os.path.join(base, "BENCH_durable.json"),
                       quiet=True)
     else:
         main()

@@ -129,6 +129,11 @@ CODES = {
     "DTA912": "job service: tenant failure budget exhausted",
     "DTA913": "job service: daemon is draining/stopped — submission "
               "refused",
+    # durable service (dryad_tpu/service/durable): raised at daemon
+    # START, refusing to recover over bad durable state rather than
+    # silently restoring a partial view
+    "DTA914": "job service: write-ahead journal corrupt or its format "
+              "version unsupported — recovery refused",
 }
 
 # codes that have NO static-analyzer rule, by design: data-dependent
@@ -137,7 +142,7 @@ CODES = {
 # carried by a static rule or listed here.
 RUNTIME_ONLY_CODES = frozenset({"DTA901", "DTA902", "DTA903", "DTA904",
                                 "DTA905", "DTA910", "DTA911", "DTA912",
-                                "DTA913"})
+                                "DTA913", "DTA914"})
 
 
 @dataclasses.dataclass(frozen=True)

@@ -636,7 +636,8 @@ class Executor:
             bindings: Optional[Dict[str, PData]] = None,
             spill_dir: Optional[str] = None,
             cost_report=None, event_log=None, job=None,
-            failure_budget: Optional[int] = None) -> PData:
+            failure_budget: Optional[int] = None,
+            checkpoint=None, pause=None) -> PData:
         """Execute a graph with lineage-tracked recovery (exec.recovery.Run).
         With spill_dir, stage outputs are durably materialized.  With
         JobConfig.profile_dir, the whole run is captured in a
@@ -670,10 +671,13 @@ class Executor:
                            spill_dir=spill_dir,
                            cost_report=cost_report,
                            event=event_log, job=job,
-                           failure_budget=failure_budget).output()
+                           failure_budget=failure_budget,
+                           checkpoint=checkpoint,
+                           pause=pause).output()
         return Run(self, graph, bindings, spill_dir=spill_dir,
                    cost_report=cost_report, event=event_log,
-                   job=job, failure_budget=failure_budget).output()
+                   job=job, failure_budget=failure_budget,
+                   checkpoint=checkpoint, pause=pause).output()
 
     def _check_cost(self, stage: Stage, scale: int, rows_total: int,
                     out_bytes: int, report=None, event=None) -> None:

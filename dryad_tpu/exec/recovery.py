@@ -29,16 +29,35 @@ ONE executor can never interleave their streams.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, Optional
 
 from dryad_tpu.exec.data import PData
 from dryad_tpu.plan.stages import StageGraph
 
-__all__ = ["Run", "FailureBudgetExceeded"]
+__all__ = ["Run", "FailureBudgetExceeded", "HandoffPause"]
+
+# spill save/restore runs EAGER device ops (store segmentation gathers)
+# outside any compiled stage; concurrent eager dispatch from multiple
+# fleet threads can wedge the CPU client, and the writes are disk-bound
+# anyway — one process-wide ticket serializes them
+_SPILL_IO_LOCK = threading.Lock()
 
 
 class FailureBudgetExceeded(RuntimeError):
     pass
+
+
+class HandoffPause(RuntimeError):
+    """Raised at a stage boundary when the run's ``pause`` event is
+    set: the daemon is draining for a rolling upgrade.  Every settled
+    stage is already spilled + checkpointed, so the successor daemon
+    resumes from exactly this boundary (service/durable)."""
+
+    def __init__(self, sid: int):
+        self.stage = sid
+        super().__init__(f"run paused at stage {sid} boundary for "
+                         f"daemon handoff")
 
 
 class Run:
@@ -49,7 +68,8 @@ class Run:
                  spill_dir: Optional[str] = None,
                  failure_budget: Optional[int] = None,
                  spill_compression: Optional[str] = None,
-                 cost_report=None, event=None, job=None):
+                 cost_report=None, event=None, job=None,
+                 checkpoint=None, pause=None):
         cfg = getattr(executor, "config", None)
         self.ex = executor
         self.graph = graph
@@ -57,6 +77,12 @@ class Run:
         self.spill_dir = spill_dir
         self.cost_report = cost_report
         self.job = job
+        # durable-service hooks (service/durable): ``checkpoint(run,
+        # sid)`` snapshots driver state after each stage boundary;
+        # ``pause`` (a threading.Event) stops the run AT a boundary —
+        # settled work spilled, the rest resumable by a successor
+        self.checkpoint = checkpoint
+        self.pause = pause
         # per-job event sink: explicit ``event`` wins over the executor's
         # process default; with a job id every event is tagged so streams
         # from concurrent jobs sharing one executor never interleave
@@ -336,6 +362,8 @@ class Run:
     def _compute(self, sid: int) -> None:
         """Run one ready stage (all inputs materialized) and fire the
         adaptive boundary hook."""
+        if self.pause is not None and self.pause.is_set():
+            raise HandoffPause(sid)
         stage = self.graph.stage(sid)
         from dryad_tpu.obs import trace
         # one span per stage execution (compile + run attempts; on the
@@ -352,6 +380,8 @@ class Run:
                                      job=self.job)
         self._results[sid] = out
         self._save_spill(sid, out)
+        if self.checkpoint is not None:
+            self.checkpoint(self, sid)
         # progress percentage pushed to the event stream (the reference
         # pushes it to the launcher, DrGraph.cpp:109-110); the settled
         # stage rides along so live consumers (the service dashboard's
@@ -414,8 +444,9 @@ class Run:
         if not self.spill_dir:
             return
         from dryad_tpu.io.store import write_store
-        write_store(self._spill_path(sid), pd,
-                    compression=self.spill_compression)
+        with _SPILL_IO_LOCK:
+            write_store(self._spill_path(sid), pd,
+                        compression=self.spill_compression)
         if self.adapt is not None:
             # adaptive runs may reshape a stage before it executes; a
             # later resume replans WITHOUT the rewrite (no stats yet),
@@ -453,6 +484,7 @@ class Run:
         if not ok:
             return None
         from dryad_tpu.io.store import read_store
-        pd = read_store(p, self.ex.mesh)
+        with _SPILL_IO_LOCK:
+            pd = read_store(p, self.ex.mesh)
         self._event({"event": "stage_restored", "stage": sid})
         return pd

@@ -180,7 +180,7 @@ class StandingManager:
     """Registry + scheduler (see module docstring).  Owned by an
     in-process JobService; ``start()`` spins the tick thread."""
 
-    def __init__(self, service):
+    def __init__(self, service, load: bool = True):
         self.service = service
         self.dir = os.path.join(service.root, "standing")
         self.state_dir = os.path.join(service.root, "inc_state")
@@ -192,7 +192,10 @@ class StandingManager:
         self._seq = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._load()
+        # a durable daemon passes load=False and calls restore() from
+        # the ONE journal-replay pass instead (service/durable/recover)
+        if load:
+            self.restore({})
 
     # -- registration ------------------------------------------------------
 
@@ -235,30 +238,51 @@ class StandingManager:
         return sid
 
     def _persist(self, sq: StandingQuery) -> None:
-        path = os.path.join(self.dir, f"{sq.id}.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"id": sq.id, "tenant": sq.tenant,
-                       "priority": sq.priority, "query": sq.query,
-                       "emit_every": sq.emit_every,
-                       "created_ts": sq.created_ts}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        from dryad_tpu.utils.atomic import atomic_write_json
+        rec = {"id": sq.id, "tenant": sq.tenant,
+               "priority": sq.priority, "query": sq.query,
+               "emit_every": sq.emit_every,
+               "created_ts": sq.created_ts}
+        atomic_write_json(os.path.join(self.dir, f"{sq.id}.json"), rec)
+        # unified recovery (service/durable): the registration also
+        # lands in the service journal so ONE replay pass restores
+        # queued jobs AND standing queries together
+        j = getattr(self.service, "journal", None)
+        if j is not None:
+            j.standing_registered(rec)
 
-    def _load(self) -> None:
-        """Restart resume: recompile each persisted registration
-        against the CURRENT catalog.  One that no longer compiles (its
-        table was dropped) stays on disk but is skipped with a service
-        error event — never a daemon-killing raise."""
-        from dryad_tpu import sql as _sql
+    def _disk_regs(self) -> Dict[str, Dict[str, Any]]:
+        """{sid: registration record} from the persisted JSON files."""
+        out: Dict[str, Dict[str, Any]] = {}
         for name in sorted(os.listdir(self.dir)):
             if not name.endswith(".json"):
                 continue
             try:
                 with open(os.path.join(self.dir, name)) as f:
                     rec = json.load(f)
-                sid = rec["id"]
+                out[rec["id"]] = rec
+            except Exception as e:
+                self.service.log({"event": "service_error",
+                                  "where": "standing_load",
+                                  "file": name, "error": repr(e)})
+        return out
+
+    def restore(self, journal_regs: Dict[str, Dict[str, Any]]) -> int:
+        """Restart resume: recompile each persisted registration — the
+        on-disk JSON files merged with the journal's net-of-cancels
+        view (``journal_regs``, which wins per id) — against the
+        CURRENT catalog.  One that no longer compiles (its table was
+        dropped) stays on disk but is skipped with a service error
+        event — never a daemon-killing raise.  Returns the count
+        actually resumed."""
+        from dryad_tpu import sql as _sql
+        regs = self._disk_regs()
+        regs.update(journal_regs or {})
+        n = 0
+        for sid, rec in sorted(regs.items(),
+                               key=lambda kv: (kv[1].get("created_ts")
+                                               or 0.0, kv[0])):
+            try:
                 tail = sid.rsplit("-", 1)[-1]
                 if tail.isdigit():
                     self._seq = max(self._seq, int(tail))
@@ -272,10 +296,12 @@ class StandingManager:
                               priority=int(rec.get("priority", 0)),
                               persist=False, sid=sid,
                               created_ts=rec.get("created_ts"))
+                n += 1
             except Exception as e:
                 self.service.log({"event": "service_error",
                                   "where": "standing_load",
-                                  "file": name, "error": repr(e)})
+                                  "file": f"{sid}.json", "error": repr(e)})
+        return n
 
     # -- scheduling --------------------------------------------------------
 
@@ -327,7 +353,7 @@ class StandingManager:
             job = svc._new_job("inc-refresh", sq.tenant, sq.priority, 1,
                                run_local=run_local)
             sq.inflight = job.id
-            svc._admit(job)
+            svc._admit(job, kind="refresh")
         except (ServiceRejected, ServiceStoppedError):
             # over quota (or stopping): the registration stands, the
             # refresh just waits for the next due tick
@@ -381,6 +407,9 @@ class StandingManager:
             os.unlink(os.path.join(self.dir, f"{sid}.json"))
         except OSError:
             pass
+        j = getattr(self.service, "journal", None)
+        if j is not None:
+            j.standing_cancelled(sid)
         self.service.log({"event": "standing_query_cancelled",
                           "job": sid, "tenant": sq.tenant,
                           "refreshes": sq.refreshes})

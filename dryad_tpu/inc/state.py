@@ -62,15 +62,11 @@ def commit_state(path: str, watermark: int,
     """Atomically publish ``(watermark, columns)`` — see module
     docstring.  ``columns`` values are numpy arrays (string columns as
     ``S`` dtype) of equal length."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from dryad_tpu.utils.atomic import atomic_write
     arrays = {_META: np.frombuffer(
         json.dumps({"watermark": int(watermark),
                     "columns": sorted(columns)}).encode(), np.uint8)}
     for name, arr in columns.items():
         arrays[_COL + name] = np.asarray(arr)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
+    with atomic_write(path, "wb") as f:
         np.savez(f, **arrays)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)

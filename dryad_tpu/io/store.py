@@ -319,12 +319,9 @@ def append_store(path: str, pd: PData) -> int:
         capacity=max(int(meta.get("capacity", 1)), max(new_counts)),
         generation=gen,
         part_generations=gens + [gen] * len(new_counts))
-    tmp = os.path.join(path, "meta.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(new_meta, f, indent=1)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(path, "meta.json"))
+    from dryad_tpu.utils.atomic import atomic_write_json
+    atomic_write_json(os.path.join(path, "meta.json"), new_meta,
+                      indent=1)
     return gen
 
 

@@ -112,6 +112,14 @@ FAMILIES = {
                         "segment's share)"),
     "queue_wait": ("dryad_queue_wait_seconds",
                    "admission queue wait, enqueue to first dispatch"),
+    # durable service (service/durable): what one journal replay did
+    # with the jobs it found (outcome = resumed | readmitted | failed),
+    # and how long the whole recovery pass took
+    "jobs_recovered": ("dryad_jobs_recovered_total",
+                       "jobs restored by journal replay, by outcome"),
+    "recovery_seconds": ("dryad_recovery_seconds",
+                         "wall of the last journal-replay recovery "
+                         "pass"),
 }
 
 
@@ -436,6 +444,17 @@ def metrics_from_events(events, registry: Optional[Registry] = None,
             C("jobs", e).inc()
         elif k == "job_failed":
             C("jobs_failed", e).inc()
+        elif k in ("job_resumed", "job_readmitted"):
+            # derived mirror of recovery's live jobs_recovered counter
+            # (recover.py counts fail-with-forensics under job_failed's
+            # own record, so only the two success outcomes appear here)
+            family_counter(r, "jobs_recovered",
+                           outcome=("resumed" if k == "job_resumed"
+                                    else "readmitted")).inc()
+        elif k == "journal_replay":
+            if e.get("wall_s") is not None:
+                family_gauge(r, "recovery_seconds"
+                             ).set(float(e["wall_s"]))
         elif k == "progress" and e.get("pct") is not None:
             # the derived mirror of the service's live progress gauge:
             # the LAST progress record wins (gauge semantics)

@@ -59,6 +59,11 @@ class AdmissionQueue:
         self._tenants = {}
         # wakes fleet loops blocked in next_unit(wait=...)
         self._ready = threading.Condition(self._lock)
+        # optional write-ahead journal (service/durable): when the
+        # daemon sets it, every on_done charge is journaled so a
+        # restart restores each tenant's fair-share virtual time and
+        # failure count instead of zeroing them
+        self.journal = None
 
     def _state(self, tenant: str) -> _TenantState:
         st = self._tenants.get(tenant)
@@ -87,19 +92,23 @@ class AdmissionQueue:
             if queued >= q.max_queued_jobs:
                 raise QueueFullError(tenant, queued, q.max_queued_jobs)
 
-    def submit(self, job) -> None:
+    def submit(self, job, force: bool = False) -> None:
         """Admit ``job`` or raise a typed rejection (QueueFullError /
-        FailureBudgetError) with ZERO work started."""
+        FailureBudgetError) with ZERO work started.  ``force`` skips
+        the quota walls: recovery re-admitting journaled jobs must
+        never re-reject work the daemon already accepted (the quotas
+        were enforced at original admission)."""
         with self._lock:
             q = self._quota_of(job.tenant)
             st = self._state(job.tenant)
-            if q.failure_budget and st.failures > q.failure_budget:
-                raise FailureBudgetError(job.tenant, st.failures,
-                                         q.failure_budget)
-            queued = sum(1 for j in st.jobs if j.state == "queued")
-            if queued >= q.max_queued_jobs:
-                raise QueueFullError(job.tenant, queued,
-                                     q.max_queued_jobs)
+            if not force:
+                if q.failure_budget and st.failures > q.failure_budget:
+                    raise FailureBudgetError(job.tenant, st.failures,
+                                             q.failure_budget)
+                queued = sum(1 for j in st.jobs if j.state == "queued")
+                if queued >= q.max_queued_jobs:
+                    raise QueueFullError(job.tenant, queued,
+                                         q.max_queued_jobs)
             # WFQ idle catch-up: a tenant returning from idle must not
             # cash in the virtual time it "saved" while absent (it would
             # monopolize the fleet until it caught up) — fast-forward it
@@ -221,6 +230,14 @@ class AdmissionQueue:
             if not ok:
                 st.failures += 1
             self._ready.notify_all()
+        # journal the charge OUTSIDE the queue lock (the journal has
+        # its own lock and fsyncs; fair-share picking must not wait on
+        # the disk)
+        if self.journal is not None:
+            try:
+                self.journal.tenant_charge(job.tenant, wall_s, ok=ok)
+            except Exception:
+                pass      # a full disk must not take the fleet down
 
     def requeue(self, job, task_idx: int) -> None:
         """Return a dispatched-but-lost unit (worker death/timeout) to
@@ -261,3 +278,13 @@ class AdmissionQueue:
     def reset_failures(self, tenant: str) -> None:
         with self._lock:
             self._state(tenant).failures = 0
+
+    def restore_tenant(self, tenant: str, used_slot_s: float = 0.0,
+                       failures: int = 0) -> None:
+        """Recovery: re-seed a tenant's ledgers from the replayed
+        journal.  FLOORS, not increments — replaying twice (or racing a
+        live charge) must never double-charge a budget."""
+        with self._lock:
+            st = self._state(tenant)
+            st.used_slot_s = max(st.used_slot_s, float(used_slot_s))
+            st.failures = max(st.failures, int(failures))

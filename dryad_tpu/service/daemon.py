@@ -56,6 +56,11 @@ def _now() -> float:
     return time.time()
 
 
+def _pkg_version() -> str:
+    import dryad_tpu
+    return getattr(dryad_tpu, "__version__", "dev")
+
+
 class JobService:
     """See module docstring.  ``config`` is a ServiceConfig; ``cluster``
     (optional) a started ClusterBackend whose workers serve the fleet —
@@ -98,6 +103,24 @@ class JobService:
         self._scan_lock = threading.Lock()
         self._scan_cap = 16
         self.admission = AdmissionQueue(config.quota)
+        # durability (service/durable): the write-ahead journal records
+        # every admission/terminal/charge BEFORE the daemon acts on it;
+        # opening it replays whatever the previous daemon left behind
+        # (recover(self) below turns that into restored state).  A
+        # corrupt journal raises JournalError (DTA914) HERE — the
+        # daemon refuses to start over bad durable state.  ``_archive``
+        # is the read-surface index of pre-restart terminal jobs.
+        self._archive: Dict[str, dict] = {}
+        self.journal = None
+        self.recovery: Optional[dict] = None
+        if getattr(config, "durable", True):
+            from dryad_tpu.service.durable import Journal
+            self.journal = Journal(
+                os.path.join(root, "durable"),
+                fsync=getattr(config, "journal_fsync", True),
+                compact_every=getattr(config, "journal_compact_every",
+                                      512))
+            self.admission.journal = self.journal
         # per-tenant SLO tracking (obs/slo.py): every terminal job folds
         # into the tenant's rolling window; attainment/burn served at
         # GET /slo + the dashboard tenant table, slo_breach emitted on
@@ -150,10 +173,16 @@ class JobService:
         # resumed registrations begin refreshing immediately.
         if cluster is None:
             from dryad_tpu.inc.standing import StandingManager
-            self.standing = StandingManager(self)
+            # with a journal, registrations restore in the ONE unified
+            # recovery pass below instead of the manager's own dir scan
+            self.standing = StandingManager(self,
+                                            load=self.journal is None)
             self.standing.start()
         else:
             self.standing = None
+        if self.journal is not None:
+            from dryad_tpu.service.durable import recover
+            self.recovery = recover(self)
 
     @property
     def slots(self) -> int:
@@ -177,10 +206,29 @@ class JobService:
         self.log({"event": "job_rejected", "tenant": job.tenant,
                   "app": job.app, "code": err.code, "error": str(err)})
 
-    def _admit(self, job: ServiceJob) -> str:
+    def _journal_rejected(self, job: ServiceJob, err) -> None:
+        """Terminal-journal a zero-work rejection so an admitted-but-
+        refused id can never resurrect as a live job at recovery.
+        ("rejected" is terminal in the journal but excluded from the
+        archive index — as far as tenants see, the job never existed.)"""
+        if self.journal is not None:
+            try:
+                self.journal.job_terminal(job.id, "rejected",
+                                          error=str(err))
+            except Exception:
+                pass
+
+    def _admit(self, job: ServiceJob, kind: str = "app") -> str:
+        # write-ahead FIRST: the journal must know the job before any
+        # daemon state does, or a crash in this window loses it
+        if self.journal is not None:
+            from dryad_tpu.service.durable.recover import job_spec
+            job.journal = self.journal
+            self.journal.job_admitted(job_spec(job, kind))
         try:
             self.admission.submit(job)
         except ServiceRejected as e:
+            self._journal_rejected(job, e)
             self._reject_teardown(job, e)
             raise
         with self._jobs_lock:
@@ -194,8 +242,11 @@ class JobService:
             with self._jobs_lock:
                 self.jobs.pop(job.id, None)
             err = ServiceStoppedError()
+            self._journal_rejected(job, err)
             self._reject_teardown(job, err)
             raise err
+        if self.journal is not None:
+            self.journal.job_queued(job.id, job.seq)
         job.event({"event": "job_submitted", "tenant": job.tenant,
                    "app": job.app, "priority": job.priority,
                    "tasks": job.n_tasks})
@@ -310,7 +361,7 @@ class JobService:
                             payload={"plan": plan_json,
                                      "sources": list(per_task_sources)},
                             combine=combine)
-        return self._admit(job)
+        return self._admit(job, kind="tasks")
 
     def submit_callable(self, fn: Callable, tenant: str = "default",
                         priority: int = 0, app: str = "callable") -> str:
@@ -330,7 +381,7 @@ class JobService:
 
         job = self._new_job(app, tenant, priority, 1,
                             run_local=run_local)
-        return self._admit(job)
+        return self._admit(job, kind="callable")
 
     # -- SQL submission (dryad_tpu/sql front end) --------------------------
 
@@ -421,7 +472,7 @@ class JobService:
                       "tenant": tenant, "code": "DTA501",
                       "fingerprint": semfp})
             family_counter(REGISTRY, "plan_reuse", tenant=tenant).inc()
-        return self._admit(job)
+        return self._admit(job, kind="sql")
 
     def explain_sql(self, query: str) -> str:
         """EXPLAIN a query against the service catalog WITHOUT running
@@ -580,7 +631,8 @@ class JobService:
             from dryad_tpu.exec.data import (maybe_shrink_for_collect,
                                              pdata_to_host)
             pd = service.executor.run(_graph, cost_report=_cost,
-                                      event_log=job, job=job.id)
+                                      event_log=job, job=job.id,
+                                      **service._durable_run_kw(job))
             table = pdata_to_host(
                 maybe_shrink_for_collect(pd, config=job.config))
             return _sql_combine(_limit)([table])
@@ -694,7 +746,8 @@ class JobService:
             # .level) — a bound method would hide the log's level from
             # span gating and add a redundant copy per event
             pd = service.executor.run(graph, cost_report=cost_rep,
-                                      event_log=job, job=job.id)
+                                      event_log=job, job=job.id,
+                                      **service._durable_run_kw(job))
             table = pdata_to_host(
                 maybe_shrink_for_collect(pd, config=job.config))
             return service_app.combine([table])
@@ -718,13 +771,31 @@ class JobService:
         raise KeyError(f"unknown job {job_id!r}")
 
     def status(self, job_id: str, with_result: bool = False) -> dict:
-        return self.job(job_id).to_row(with_result=with_result)
+        """Status row for a live job, a standing query, or a job that
+        went terminal before a daemon restart (the recovery pass
+        indexed those from the journal + persisted job dirs — 404 only
+        for ids this service dir has never seen)."""
+        try:
+            return self.job(job_id).to_row(with_result=with_result)
+        except KeyError:
+            row = self._archive.get(job_id)
+            if row is not None:
+                return dict(row)
+            raise
 
     def result(self, job_id: str):
         return self.job(job_id).result
 
     def wait(self, job_id: str, timeout: Optional[float] = None) -> dict:
-        job = self.job(job_id)
+        try:
+            job = self.job(job_id)
+        except KeyError:
+            # terminal before a restart: already settled, nothing to
+            # wait for — serve the archived row (result not retained)
+            row = self._archive.get(job_id)
+            if row is not None:
+                return dict(row)
+            raise
         job.wait(timeout)
         return job.to_row(with_result=True)
 
@@ -745,12 +816,115 @@ class JobService:
 
     def list_jobs(self) -> List[dict]:
         with self._jobs_lock:
-            return [j.to_row() for j in self.jobs.values()]
+            rows = [j.to_row() for j in self.jobs.values()]
+            live = {r["job"] for r in rows}
+        # pre-restart terminal jobs (recovery's archive index): listed
+        # after the live table, marked {"archived": true}
+        rows.extend(dict(r) for jid, r in self._archive.items()
+                    if jid not in live)
+        return rows
 
     def standing_rows(self) -> List[dict]:
         """Status rows of every registered standing query
         (``GET /standing``); empty on the cluster fleet."""
         return self.standing.rows() if self.standing is not None else []
+
+    # -- durability (service/durable) --------------------------------------
+
+    def _durable_run_kw(self, job: ServiceJob) -> dict:
+        """Per-run durability hooks for in-process query jobs: the
+        handoff pause event always (it costs one Event check per stage
+        boundary); spill + driver checkpoint only with
+        ``durable_spill`` (resume-from-lineage needs every stage's
+        output on disk)."""
+        kw = {"pause": getattr(job, "pause", None)}
+        if getattr(self.config, "durable_spill", False):
+            from dryad_tpu.service.durable import JobCheckpoint
+            kw["spill_dir"] = os.path.join(job.dir, "spill")
+            kw["checkpoint"] = JobCheckpoint(
+                os.path.join(job.dir, "checkpoint.json"), job=job.id)
+        return kw
+
+    def _restore_job(self, spec: dict, n_tasks: int, run_local=None,
+                     payload=None, combine=None,
+                     admit: bool = True) -> ServiceJob:
+        """Recovery: rebuild one journaled job under its ORIGINAL id
+        and seq (fair-share order preserved) and re-admit it past the
+        quota walls it already passed once.  ``admit=False`` builds the
+        job without queueing it (the fail-with-forensics path)."""
+        job = ServiceJob(spec["id"], spec["tenant"], spec["app"],
+                         int(spec.get("seq", 0)),
+                         int(spec.get("priority", 0)), n_tasks,
+                         os.path.join(self.jobs_dir, spec["id"]),
+                         self.job_config, history_dir=self.history_dir,
+                         params=dict(spec.get("params") or {}),
+                         run_local=run_local, payload=payload,
+                         combine=combine)
+        job.journal = self.journal
+        with self._jobs_lock:
+            self.jobs[job.id] = job
+        if admit:
+            self.admission.submit(job, force=True)
+            if self.journal is not None:
+                self.journal.job_queued(job.id, job.seq)
+            self._fleet.wake()
+        return job
+
+    def handoff(self) -> dict:
+        """Rolling upgrade, outgoing-daemon side: stop admitting
+        (DTA913), pause running in-process jobs at their next
+        checkpointed stage boundary, stop the fleet, and mark the
+        journal ready for adoption.  Jobs are NOT failed — the
+        successor daemon opening the same service dir adopts the
+        journal and resumes/readmits them (stale lowerings are
+        impossible: the plan-cache key salts in config + package
+        version).  Returns a summary for the operator."""
+        if self._stopping:
+            return {"paused": 0, "queued": 0, "already_stopped": True}
+        self._stopping = True
+        self.log({"event": "handoff_started", "ver": _pkg_version()})
+        if self.standing is not None:
+            self.standing.stop()
+        paused = queued = 0
+        with self._jobs_lock:
+            jobs = list(self.jobs.values())
+        for j in jobs:
+            if j.state == "running":
+                j.pause.set()
+                paused += 1
+            elif j.state == "queued":
+                queued += 1
+        self._fleet.stop()
+        if self.journal is not None:
+            self.journal.handoff_ready()
+            # NOT a clean close: the successor must see live state to
+            # adopt, and the journal keeps the epoch open on purpose
+            self.journal.close(clean=False)
+        self.log({"event": "handoff_ready", "paused": paused,
+                  "queued": queued})
+        self.log.close()
+        return {"paused": paused, "queued": queued,
+                "journal": (self.journal.dir
+                            if self.journal is not None else None)}
+
+    def crash(self) -> None:
+        """TEST/BENCH hook: die the way SIGKILL would — no terminal
+        journaling, no clean journal close, no job teardown, the LOCK
+        file left in place.  In-memory job objects wind down (threads
+        must not leak into the test process) but nothing they do past
+        this point reaches the journal, exactly like a killed daemon."""
+        self._stopping = True
+        if self.journal is not None:
+            self.journal.close(clean=False, release_lock=False)
+        if self.standing is not None:
+            self.standing.stop()
+        for j in list(self.jobs.values()):
+            j.pause.set()        # stop in-flight runs at a boundary
+        if isinstance(self._fleet, _LocalFleet):
+            self._fleet.stop(timeout=None)
+        else:
+            self._fleet.stop()
+        self.log.close()
 
     # -- per-tenant SLOs (obs/slo.py) --------------------------------------
 
@@ -930,6 +1104,10 @@ class JobService:
                                       "in flight")
                 self.admission.retire(j)
                 self._job_terminal(j)
+        # clean close LAST: every terminal transition above journaled
+        # first, so a restart over this dir recovers nothing live
+        if self.journal is not None:
+            self.journal.close(clean=True)
         self.log({"event": "service_stopped"})
         self.log.close()
         if self._own_cluster and self.cluster is not None:
@@ -988,12 +1166,20 @@ class _LocalFleet:
     def wake(self) -> None:
         pass          # workers poll the admission queue's condition
 
-    def stop(self) -> None:
+    def stop(self, timeout: Optional[float] = 10) -> None:
+        """``timeout=None`` joins to completion — crash() needs the
+        worker threads fully wound down before a successor daemon can
+        start in the SAME process (two fleets computing the same job
+        concurrently is an in-process artifact no real SIGKILL has)."""
         self._stop.set()
         for t in self._threads:
-            t.join(timeout=10)
+            while t.is_alive():
+                t.join(timeout=10 if timeout is None else timeout)
+                if timeout is not None:
+                    break
 
     def _worker(self) -> None:
+        from dryad_tpu.exec.recovery import HandoffPause
         svc = self.service
         while not self._stop.is_set():
             unit = svc.admission.next_unit(wait=0.2)
@@ -1017,6 +1203,19 @@ class _LocalFleet:
             ok, err = True, None
             try:
                 res = fn(svc, job)
+            except HandoffPause as hp:
+                # rolling upgrade: the run stopped AT a stage boundary
+                # with its settled work spilled + checkpointed.  Charge
+                # the measured wall (fair-share currency), leave the
+                # job RUNNING and un-retired — the successor daemon
+                # adopts it from the journal and resumes from spill.
+                wall = _now() - t0
+                svc.admission.on_done(job, idx, wall, ok=True)
+                ev = {"event": "handoff_paused", "stage": hp.stage,
+                      "wall_s": round(wall, 4)}
+                job.event(dict(ev))
+                svc.log(dict(ev, job=job.id, tenant=job.tenant))
+                continue
             except Exception:
                 ok, err = False, traceback.format_exc()
             wall = _now() - t0

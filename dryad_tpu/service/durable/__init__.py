@@ -1,0 +1,24 @@
+"""Durable job service: write-ahead journal, crash recovery, and
+rolling upgrades.
+
+The reference punts job lifetime to YARN Application-Master restarts —
+the Graph Manager dies with its job, and Dryad's fault model only ever
+re-executes *vertices*, never the manager itself (PAPER.md layer 2).
+This package goes beyond that: the daemon journals its OWN state
+(admission / queue / tenant / in-flight, ``journal.py``), snapshots
+each job's driver state at stage boundaries (``checkpoint.py``), and
+on startup replays the journal to re-admit queued jobs fair-share-
+order-preserved and RESUME running jobs from lineage + spill instead
+of restarting them from scratch (``recover.py``).  A drain-and-handoff
+protocol (``JobService.handoff``) lets a new daemon version adopt the
+journal mid-flight — the rolling upgrade the one-GM-per-job model
+cannot express.  Proven under injected faults by ``dryad_tpu/chaos``.
+"""
+
+from dryad_tpu.service.durable.checkpoint import JobCheckpoint
+from dryad_tpu.service.durable.journal import (JOURNAL_VERSION, Journal,
+                                               JournalError, ReplayState)
+from dryad_tpu.service.durable.recover import recover
+
+__all__ = ["Journal", "JournalError", "JobCheckpoint", "ReplayState",
+           "JOURNAL_VERSION", "recover"]

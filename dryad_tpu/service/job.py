@@ -88,6 +88,13 @@ class ServiceJob:
         self.payload = payload
         self.combine = combine
         self.run_local = run_local
+        # durability hooks (service/durable): the daemon sets
+        # ``journal`` on admitted jobs so dispatch/terminal transitions
+        # land in the write-ahead journal; ``pause`` is the rolling-
+        # upgrade handoff signal the in-process Run checks at stage
+        # boundaries (exec/recovery.HandoffPause)
+        self.journal = None
+        self.pause = threading.Event()
         # per-request phase waterfall (obs/latency.py): the daemon
         # hands in the clock it started at submit ENTRY so the
         # precheck/bind/cache segments measured before this object
@@ -215,6 +222,25 @@ class ServiceJob:
                 self.started_ts = time.time()
                 self.event({"event": "job_started", "tenant": self.tenant,
                             "app": self.app, "tasks": self.n_tasks})
+                self._journal("job_dispatched")
+
+    def _journal(self, what: str) -> None:
+        """Write-ahead a lifecycle transition (no-op without a journal;
+        a journal write failure must never wedge the job)."""
+        j = self.journal
+        if j is None:
+            return
+        try:
+            if what == "job_dispatched":
+                j.job_dispatched(self.id)
+            else:
+                wall = (round(self.finished_ts
+                              - (self.started_ts or self.submitted_ts),
+                              4) if self.finished_ts else None)
+                j.job_terminal(self.id, self.state, error=self.error,
+                               wall_s=wall)
+        except Exception:
+            pass
 
     def task_result(self, idx: int, table: Any) -> bool:
         """Record one task's table; True when the job just completed."""
@@ -266,6 +292,7 @@ class ServiceJob:
                                       or "unknown")[:2000]})
             self._settle_waterfall(self.state == "done")
             self._release_inputs()
+        self._journal("terminal")
         self.log.close()
         self._done.set()
         self._notify()          # stream followers see the terminal state
@@ -292,6 +319,7 @@ class ServiceJob:
             self.finished_ts = time.time()
             self.event({"event": "job_cancelled", "tenant": self.tenant})
             self._release_inputs()
+        self._journal("terminal")
         self.log.close()
         self._done.set()
         self._notify()

@@ -150,6 +150,21 @@ class ServiceConfig:
     # state without bound).  Their directories and history archives
     # remain on disk — the dashboard's archive table still lists them.
     max_terminal_jobs: int = 256
+    # durability (service/durable): with ``durable`` on, every
+    # admission/terminal/charge lands in the write-ahead journal under
+    # ``<service_dir>/durable/`` BEFORE the daemon acts on it, and a
+    # restarted daemon replays it — queued jobs re-admitted in order,
+    # running jobs resumed, terminal jobs indexed for the read surfaces.
+    # ``durable_spill`` additionally gives every in-process job a
+    # per-stage spill dir + driver checkpoint (resume re-executes only
+    # unsettled stages) — off by default because it writes every
+    # stage's output to disk.  ``journal_fsync`` trades append
+    # durability for latency; ``journal_compact_every`` is the
+    # checkpoint-compaction period in records.
+    durable: bool = True
+    durable_spill: bool = False
+    journal_fsync: bool = True
+    journal_compact_every: int = 512
 
     def quota(self, tenant: str) -> TenantQuota:
         return self.tenants.get(tenant, self.default_quota)

@@ -193,24 +193,6 @@ class FileCache:
 
     def put(self, key: str, data: bytes, job: Optional[str] = None) -> None:
         """Commit ``data`` under ``key`` atomically (rename commit)."""
-        p = self._path(key)
-        d = os.path.dirname(p)
-        os.makedirs(d, exist_ok=True)
+        from dryad_tpu.utils.atomic import atomic_write_bytes
         blob = _FC_MAGIC + hashlib.sha256(data).digest() + data
-        # unique temp name in the SAME directory: os.replace is only
-        # atomic within a filesystem, and a shared suffix would let two
-        # writers scribble into one temp file
-        tmp = os.path.join(
-            d, f".tmp-{os.getpid()}-{threading.get_ident()}-"
-               f"{os.urandom(4).hex()}")
-        try:
-            with open(tmp, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, p)
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        atomic_write_bytes(self._path(key), blob)

@@ -53,6 +53,14 @@ _LEVELS = {
     "job_submitted": 1, "job_started": 1, "job_cancelled": 1,
     "job_rejected": 1, "service_started": 1, "service_stopped": 1,
     "service_error": 0,
+    # durable service (dryad_tpu/service/durable + chaos): the journal
+    # replay summary, each recovered job's disposition, the rolling-
+    # upgrade handoff protocol steps, and an injected chaos fault are
+    # all job-lifecycle grade — an operator reading a post-restart log
+    # at level 1 must see exactly what recovery did
+    "journal_replay": 1, "job_resumed": 1, "job_readmitted": 1,
+    "handoff_started": 1, "handoff_ready": 1, "handoff_adopted": 1,
+    "handoff_paused": 1, "chaos_fault": 1,
     # live service observability (dryad_tpu/obs/{analyze,slo}.py,
     # obs/history.py regression watch): an EXPLAIN ANALYZE annotation,
     # an SLO error-budget breach, and a cross-run perf-regression

@@ -1,0 +1,416 @@
+"""Durable job service tests (service/durable + chaos).
+
+Covers the write-ahead journal (append/replay roundtrip, checkpoint
+compaction, torn-tail truncation vs DTA914 refusal, exactly-once
+terminal folding), crash recovery through the real daemon (re-admitted
+queues in original order, resumed running jobs, archive-backed status
+for pre-restart terminal jobs, tenant ledgers restored as floors —
+never double-charged, unrecoverable payloads failed WITH forensics),
+the rolling-upgrade handoff (pause at a checkpointed stage boundary,
+successor adoption, spill-restored resume), the chaos harness
+acceptance (SIGKILL a real daemon process mid-fleet with a running +
+queued + standing job, restart, zero lost jobs, oracle-identical
+results), and the bench --smoke-durable mode.
+"""
+
+import json
+import os
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from dryad_tpu.chaos import FaultPlan, check_invariants, run_scenario
+from dryad_tpu.chaos.faults import chop_tail, torn_tail
+from dryad_tpu.obs.metrics import metrics_from_events
+from dryad_tpu.service import APPS, JobService, ServiceConfig
+from dryad_tpu.service.durable import (JOURNAL_VERSION, Journal,
+                                       JournalError, ReplayState)
+from dryad_tpu.service.durable.journal import (TERMINAL_STATES,
+                                               _read_records)
+from dryad_tpu.utils.atomic import atomic_write_json
+
+
+def _spec(jid, tenant="t", seq=1, kind="app", params=None):
+    return {"id": jid, "tenant": tenant, "app": "wordcount",
+            "seq": seq, "priority": 0, "n_tasks": 1, "kind": kind,
+            "params": params or {"n_lines": 8}, "recoverable": True,
+            "submitted_ts": 0.0}
+
+
+def _wc_oracle(params):
+    tasks = APPS["wordcount"].make_tasks(dict(params), 4)
+    c = Counter()
+    for t in tasks:
+        for line in t["line"]:
+            c.update(line.split())
+    return c
+
+
+def _check_wc(result, params):
+    oracle = _wc_oracle(params)
+    assert result["total_words"] == sum(oracle.values())
+    assert result["words"] == dict(sorted(oracle.items()))
+
+
+# -- journal unit tests ------------------------------------------------------
+
+def test_journal_roundtrip_and_epoch_flags(tmp_path):
+    d = str(tmp_path / "durable")
+    j = Journal(d, fsync=False)
+    j.job_admitted(_spec("a-1", tenant="alice", seq=1))
+    j.job_queued("a-1", 1)
+    j.job_dispatched("a-1")
+    j.tenant_charge("alice", 1.5, ok=True)
+    j.job_terminal("a-1", "done", wall_s=1.5)
+    j.job_admitted(_spec("b-2", tenant="bob", seq=2))
+    j.job_queued("b-2", 2)
+    j.standing_registered({"id": "carol-standing-1", "sql": "..."})
+    j.close(clean=True)
+
+    j2 = Journal(d, fsync=False)
+    st = j2.recovered
+    assert j2.was_clean and not j2.was_torn and j2.was_handoff is None
+    assert st.jobs["a-1"]["phase"] == "done"
+    assert st.jobs["b-2"]["phase"] == "queued"
+    assert [e["id"] for e in st.live_jobs()] == ["b-2"]
+    assert st.tenants["alice"]["used_slot_s"] == pytest.approx(1.5)
+    assert "carol-standing-1" in st.standing
+    assert st.seq == 2 and st.epochs == 2
+    # a dirty close leaves the next epoch marked unclean
+    j2.close(clean=False)
+    j3 = Journal(d, fsync=False)
+    assert not j3.was_clean
+    j3.close()
+
+
+def test_journal_compaction_never_double_folds(tmp_path):
+    d = str(tmp_path / "durable")
+    j = Journal(d, fsync=False, compact_every=8)
+    for i in range(1, 30):
+        j.job_admitted(_spec(f"j-{i}", tenant="alice", seq=i))
+        j.tenant_charge("alice", 0.25)
+        j.job_terminal(f"j-{i}", "done")
+    assert os.path.exists(j.ckpt_path)
+    j.close(clean=True)
+    # the journal file holds only the post-compaction suffix...
+    recs, torn = _read_records(j.path)
+    assert not torn and len(recs) < 30
+    # ...and replay (checkpoint + suffix) yields EXACT totals: the
+    # monotone record counter keeps compacted records from re-folding
+    j2 = Journal(d, fsync=False)
+    st = j2.recovered
+    assert st.tenants["alice"]["used_slot_s"] == pytest.approx(29 * 0.25)
+    assert sum(1 for e in st.jobs.values() if e["phase"] == "done") == 29
+    assert not st.live_jobs() and not st.dup_terminals
+    j2.close()
+
+
+def test_journal_torn_tail_truncated_not_fatal(tmp_path):
+    d = str(tmp_path / "durable")
+    j = Journal(d, fsync=False)
+    j.job_admitted(_spec("a-1"))
+    j.job_queued("a-1", 1)
+    j.close(clean=False, release_lock=False)   # a crash, effectively
+    torn_tail(j.path, nbytes=32)               # power cut mid-append
+    j2 = Journal(d, fsync=False)
+    assert j2.was_torn
+    assert j2.recovered.jobs["a-1"]["phase"] == "queued"
+    # the torn bytes are physically gone — the NEXT reopen is clean
+    j2.close(clean=True)
+    j3 = Journal(d, fsync=False)
+    assert not j3.was_torn and j3.was_clean
+    j3.close()
+    # chopping the tail mid-record (the other torn-write shape) is
+    # equally tolerated
+    j4 = Journal(d, fsync=False)
+    j4.job_admitted(_spec("b-2", seq=2))
+    j4.close(clean=False, release_lock=False)
+    chop_tail(j4.path, 10)
+    j5 = Journal(d, fsync=False)
+    assert j5.was_torn
+    j5.close()
+
+
+def test_journal_garbage_before_tail_refused(tmp_path):
+    d = str(tmp_path / "durable")
+    j = Journal(d, fsync=False)
+    j.job_admitted(_spec("a-1"))
+    j.close(clean=True)
+    with open(j.path) as f:
+        lines = f.readlines()
+    lines.insert(1, "NOT JSON AT ALL\n")       # garbage BEFORE the tail
+    with open(j.path, "w") as f:
+        f.writelines(lines)
+    with pytest.raises(JournalError) as ei:
+        Journal(d, fsync=False)
+    assert ei.value.code == "DTA914"
+
+
+def test_journal_version_mismatch_refused(tmp_path):
+    d = str(tmp_path / "durable")
+    Journal(d, fsync=False).close(clean=True)
+    atomic_write_json(os.path.join(d, "checkpoint.json"),
+                      {"journal_version": JOURNAL_VERSION + 99})
+    with pytest.raises(JournalError) as ei:
+        Journal(d, fsync=False)
+    assert ei.value.code == "DTA914"
+
+
+def test_replay_exactly_once_and_rejected_never_resurrects():
+    st = ReplayState()
+    st.fold({"rec": "job_admitted", "n": 1, "spec": _spec("a-1")})
+    st.fold({"rec": "job_terminal", "n": 2, "id": "a-1",
+             "state": "done"})
+    st.fold({"rec": "job_terminal", "n": 3, "id": "a-1",
+             "state": "failed"})          # double terminal = violation
+    assert st.dup_terminals == ["a-1"]
+    assert st.jobs["a-1"]["phase"] == "done"   # first terminal wins
+    # a journaled zero-work rejection is terminal: never re-admitted
+    st.fold({"rec": "job_admitted", "n": 4,
+             "spec": _spec("r-2", seq=2)})
+    st.fold({"rec": "job_terminal", "n": 5, "id": "r-2",
+             "state": "rejected"})
+    assert "rejected" in TERMINAL_STATES
+    assert not st.live_jobs()
+
+
+# -- crash recovery through the real daemon ----------------------------------
+
+def test_crash_recovery_readmits_completes_and_archives(tmp_path):
+    d = str(tmp_path / "svc")
+    pa = {"n_lines": 64, "seed": 1}
+    pb = {"n_lines": 96, "seed": 2}
+    pc = {"n_lines": 128, "seed": 3}
+    svc = JobService(ServiceConfig(service_dir=d, slots=1))
+    ja = svc.submit("wordcount", pa, tenant="alice")
+    ra = svc.wait(ja, timeout=300)
+    assert ra["state"] == "done"
+    _check_wc(ra["result"], pa)
+    jb = svc.submit("wordcount", pb, tenant="alice")
+    jc = svc.submit("wordcount", pc, tenant="bob")
+    svc.crash()                            # die like SIGKILL would
+
+    svc2 = JobService(ServiceConfig(service_dir=d, slots=1))
+    rec = svc2.recovery
+    assert rec["failed"] == 0 and not rec["clean"]
+    assert rec["resumed"] + rec["readmitted"] == 2
+    # restart blindness fix: the pre-crash terminal job still resolves
+    row = svc2.status(ja)
+    assert row["state"] == "done" and row["archived"]
+    assert ja in {r["job"] for r in svc2.list_jobs()}
+    assert svc2.wait(ja, timeout=5)["state"] == "done"
+    with pytest.raises(KeyError):
+        svc2.status("never-seen-id")
+    # the recovered fleet drains to oracle-identical results
+    rb = svc2.wait(jb, timeout=300)
+    rc = svc2.wait(jc, timeout=300)
+    assert rb["state"] == "done" and rc["state"] == "done"
+    _check_wc(rb["result"], pb)
+    _check_wc(rc["result"], pc)
+    # recovery is observable: events survive into derived metrics
+    text = metrics_from_events(svc2.log.events).render()
+    assert "dryad_jobs_recovered_total" in text
+    assert "dryad_recovery_seconds" in text
+    svc2.close()
+    # post-drain journal: nothing lost, nothing double-terminal
+    inv = check_invariants(os.path.join(d, "durable"))
+    assert inv["ok"], inv
+    # clean shutdown -> the next start has nothing to recover
+    svc3 = JobService(ServiceConfig(service_dir=d, slots=1))
+    assert svc3.recovery["clean"]
+    assert svc3.recovery["resumed"] == svc3.recovery["readmitted"] == 0
+    svc3.close()
+
+
+def test_tenant_ledger_restored_as_floor_not_double_charged(tmp_path):
+    d = str(tmp_path / "svc")
+    svc = JobService(ServiceConfig(service_dir=d, slots=1))
+    jid = svc.submit("wordcount", {"n_lines": 64}, tenant="alice")
+    assert svc.wait(jid, timeout=300)["state"] == "done"
+    used = svc.admission._tenants["alice"].used_slot_s
+    assert used > 0
+    svc.crash()
+    svc2 = JobService(ServiceConfig(service_dir=d, slots=1))
+    restored = svc2.admission._tenants["alice"].used_slot_s
+    assert restored == pytest.approx(used, rel=1e-3)
+    svc2.close()
+    # a THIRD start (clean close this time) still does not double it
+    svc3 = JobService(ServiceConfig(service_dir=d, slots=1))
+    assert svc3.admission._tenants["alice"].used_slot_s \
+        == pytest.approx(used, rel=1e-3)
+    svc3.close()
+
+
+def test_queued_jobs_readmitted_in_original_order(tmp_path):
+    d = str(tmp_path / "svc")
+    svc = JobService(ServiceConfig(service_dir=d, slots=1))
+    params = {"n_lines": 64, "seed": 5}
+    jids = [svc.submit("wordcount", params, tenant="alice")
+            for _ in range(3)]
+    svc.crash()                            # nothing finished yet
+    svc2 = JobService(ServiceConfig(service_dir=d, slots=1))
+    seqs = [e["seq"] for e in svc2.log.events
+            if e["event"] in ("job_resumed", "job_readmitted")]
+    assert len(seqs) == 3 and seqs == sorted(seqs)
+    for jid in jids:
+        row = svc2.wait(jid, timeout=300)
+        assert row["state"] == "done"
+        _check_wc(row["result"], params)
+    svc2.close()
+
+
+def test_unrecoverable_job_fails_with_forensics(tmp_path):
+    d = str(tmp_path / "svc")
+    svc = JobService(ServiceConfig(service_dir=d, slots=1))
+    jb = svc.submit("wordcount", {"n_lines": 64}, tenant="alice")
+    # a driver callable journals no rebuild spec: queued at crash time,
+    # it CANNOT come back — but it must fail loudly, not vanish
+    jc = svc.submit_callable(lambda env: {"x": 1}, tenant="bob")
+    svc.crash()
+    svc2 = JobService(ServiceConfig(service_dir=d, slots=1))
+    assert svc2.recovery["failed"] == 1
+    row = svc2.status(jc)
+    assert row["state"] == "failed"
+    assert "lost across daemon restart" in row["error"]
+    assert "job dir" in row["error"]       # the forensics trailer
+    assert svc2.wait(jb, timeout=300)["state"] == "done"
+    svc2.close()
+    inv = check_invariants(os.path.join(d, "durable"))
+    assert inv["ok"], inv                  # failed IS terminal: not lost
+
+
+# -- rolling upgrade ---------------------------------------------------------
+
+def _join_fixture(tmp_path):
+    """Three stores -> the 3-way join lowers to three stages, so the
+    handoff has real interior checkpointed stage boundaries."""
+    from dryad_tpu.api import Context
+    from dryad_tpu import sql
+    ctx = Context(install_trace=False)
+    n, keys = 24000, 256
+    root = str(tmp_path)
+    ctx.from_columns({"k": (np.arange(n) % keys).astype(np.int32),
+                      "v": np.arange(n, dtype=np.int32)}
+                     ).to_store(os.path.join(root, "a"))
+    ctx.from_columns({"k": np.arange(keys, dtype=np.int32),
+                      "w": (np.arange(keys) * 3).astype(np.int32)}
+                     ).to_store(os.path.join(root, "b"))
+    ctx.from_columns({"k": np.arange(keys, dtype=np.int32),
+                      "u": (np.arange(keys) * 7).astype(np.int32)}
+                     ).to_store(os.path.join(root, "c"))
+    cat = sql.Catalog()
+    for name in ("a", "b", "c"):
+        cat.register_store(name, os.path.join(root, name))
+    q = ("SELECT a.k, SUM(a.v + b.w + c.u) AS s FROM a "
+         "JOIN b ON a.k = b.k JOIN c ON a.k = c.k "
+         "GROUP BY a.k ORDER BY s DESC LIMIT 16")
+    return cat, q
+
+
+def test_handoff_rolling_upgrade_resumes_from_spill(tmp_path):
+    cat, q = _join_fixture(tmp_path)
+    d = str(tmp_path / "svc")
+    cfg = lambda: ServiceConfig(service_dir=d, slots=1,  # noqa: E731
+                                durable_spill=True)
+    svc = JobService(cfg(), catalog=cat)
+    j1 = svc.submit_sql(q, tenant="alice")
+    j2 = svc.submit_sql(q, tenant="bob")
+    evp = os.path.join(svc.jobs[j1].dir, "events.jsonl")
+
+    def spilled():
+        try:
+            with open(evp) as f:
+                return sum(1 for line in f if
+                           json.loads(line).get("event")
+                           == "stage_spilled")
+        except OSError:
+            return 0
+    deadline = time.time() + 120
+    while spilled() < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert spilled() >= 1, "first stage never settled"
+    h = svc.handoff()                      # old daemon stops admitting
+    with pytest.raises(Exception):
+        svc.submit_sql(q, tenant="alice")  # DTA913 after handoff
+
+    svc2 = JobService(cfg(), catalog=cat)  # the successor adopts
+    rec = svc2.recovery
+    assert rec["failed"] == 0
+    assert rec["resumed"] + rec["readmitted"] == 2
+    r1 = svc2.wait(j1, timeout=300)
+    r2 = svc2.wait(j2, timeout=300)
+    oracle = svc2.wait(svc2.submit_sql(q, tenant="alice"),
+                       timeout=300)["result"]
+    for r in (r1, r2):
+        assert r["state"] == "done", (r["state"], r.get("error"))
+        if "result" in r:
+            assert r["result"] == oracle
+    # the paused job RESTORED its settled stages instead of redoing
+    # them (unless it slipped to done before the pause landed)
+    if j1 in svc2.jobs and h["paused"]:
+        kinds = [json.loads(line).get("event") for line in open(evp)]
+        assert kinds.count("stage_restored") >= 1
+    evs = [e["event"] for e in svc2.log.events]
+    assert "handoff_adopted" in evs and "journal_replay" in evs
+    svc2.close()
+
+
+# -- chaos acceptance --------------------------------------------------------
+
+def test_fault_plans_are_deterministic():
+    assert FaultPlan(5).to_json() == FaultPlan(5).to_json()
+    assert FaultPlan.from_json(FaultPlan(5).to_json()).to_json() \
+        == FaultPlan(5).to_json()
+    assert any(FaultPlan(s).to_json() != FaultPlan(5).to_json()
+               for s in (6, 7, 8))
+
+
+def test_chaos_sigkill_acceptance(tmp_path):
+    """The ISSUE acceptance scenario: SIGKILL a real daemon process
+    holding a running job past its first settled stage, a queued job,
+    and a standing query; restart; zero lost jobs, oracle-identical
+    results, only unsettled stages re-executed."""
+    report = run_scenario(seed=3, workdir=str(tmp_path / "chaos"),
+                          timeout=300)
+    assert report["ok"], json.dumps(report, indent=2, default=str)
+    assert report["spills_at_kill"] >= 1       # past a settled stage
+    assert report["stages_restored"] >= 1      # ...which was NOT redone
+    assert report["recovery"]["resumed"] >= 1
+    assert report["recovery"]["readmitted"] >= 1
+    assert report["standing_recovered"]
+    inv = report["invariants"]
+    assert not inv["lost"] and not inv["dup_terminals"] \
+        and not inv["diverged"]
+
+
+@pytest.mark.slow
+def test_chaos_torn_tail_scenario(tmp_path):
+    """Seed 5: kill after TWO settled stages, then tear the journal
+    tail — recovery truncates the torn record and still loses nothing."""
+    assert FaultPlan(5).torn_tail
+    report = run_scenario(seed=5, workdir=str(tmp_path / "chaos"),
+                          timeout=300)
+    assert report["ok"], json.dumps(report, indent=2, default=str)
+    assert report["torn_injected"] and report["recovery"]["torn"]
+
+
+# -- bench ridealong ---------------------------------------------------------
+
+def test_bench_smoke_durable(tmp_path, monkeypatch):
+    import bench
+    monkeypatch.setenv("BENCH_DURABLE_LINES", "64")
+    monkeypatch.setenv("BENCH_DURABLE_JOBS", "3")
+    monkeypatch.setenv("BENCH_DURABLE_REPS", "1")
+    monkeypatch.setenv("BENCH_TREND_PATH",
+                       str(tmp_path / "BENCH_trend.jsonl"))
+    out = bench.smoke_durable(
+        out_path=str(tmp_path / "BENCH_durable.json"), quiet=True)
+    assert out["results_match"]
+    assert out["recovery_wall_s"] >= 0
+    assert out["jobs_recovered"] >= 1
+    assert os.path.exists(tmp_path / "BENCH_durable.json")
+    trend = [json.loads(line)
+             for line in open(tmp_path / "BENCH_trend.jsonl")]
+    assert trend[-1]["app"] == "bench-smoke-durable"

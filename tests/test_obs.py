@@ -85,6 +85,17 @@ def test_every_emitted_event_kind_is_registered():
     assert _LEVELS["inc_refresh"] == 1
     assert _LEVELS["inc_state_write"] == 1
     assert _LEVELS["inc_fallback_rescan"] == 1
+    # durable service (service/durable + chaos): recovery and rolling-
+    # upgrade transitions are the forensic record of a restart — every
+    # one is job-lifecycle grade and must survive level 1
+    assert _LEVELS["journal_replay"] == 1
+    assert _LEVELS["job_resumed"] == 1
+    assert _LEVELS["job_readmitted"] == 1
+    assert _LEVELS["handoff_started"] == 1
+    assert _LEVELS["handoff_ready"] == 1
+    assert _LEVELS["handoff_adopted"] == 1
+    assert _LEVELS["handoff_paused"] == 1
+    assert _LEVELS["chaos_fault"] == 1
 
 
 # -- satellite: EventLog lifecycle -------------------------------------------
